@@ -1,0 +1,109 @@
+"""Benchmark entry point (driver-run, real TPU).
+
+Primary metric, round 1: p50 TTFT for a 1024-token prefill on the flagship
+Llama-3.2-1B-class model, single chip. The north star (BASELINE.json) is
+Llama-3-8B < 200 ms p50 TTFT on v5e-8 (8 chips); 1B on 1 chip carries the same
+per-chip FLOP/byte load, so 200 ms is the comparable target and
+``vs_baseline = 200 / p50_ttft_ms`` (>1.0 beats the target). The JSON line also
+reports decode throughput (tokens/sec/chip) as a secondary metric. Later rounds
+switch this to the full multi-round-qa run through the HTTP stack.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    import dataclasses
+
+    from production_stack_tpu.engine.runner import ModelRunner, StepInput
+    from production_stack_tpu.models import llama
+
+    platform = jax.default_backend()
+    on_tpu = platform not in ("cpu",)
+    if on_tpu:
+        cfg = llama.PRESETS["llama-3.2-1b"]
+        prefill_len, decode_batch, ctx_pages = 1024, 16, 64  # 1024-token contexts
+        page_size = 16
+        num_pages = decode_batch * ctx_pages + ctx_pages
+    else:  # tiny fallback so the benchmark is runnable anywhere
+        cfg = dataclasses.replace(llama.PRESETS["llama-debug"])
+        prefill_len, decode_batch, ctx_pages, page_size = 64, 4, 8, 8
+        num_pages = decode_batch * ctx_pages + ctx_pages
+
+    runner = ModelRunner(cfg, num_pages=num_pages, page_size=page_size, seed=0)
+    rng = np.random.RandomState(0)
+
+    # --- TTFT: single-request prefill of `prefill_len` tokens + sample ---
+    max_pages = prefill_len // page_size
+    ttft_inp = StepInput(
+        input_ids=rng.randint(0, cfg.vocab_size, (1, prefill_len)),
+        positions=np.arange(prefill_len)[None],
+        page_table=np.arange(max_pages)[None] + decode_batch * ctx_pages,
+        kv_lens=np.full((1,), prefill_len),
+        temperature=np.zeros(1),
+        top_k=np.zeros(1, int),
+        top_p=np.ones(1),
+    )
+    ids, _ = runner.step(ttft_inp)  # compile
+    jax.block_until_ready(ids)
+    ttfts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        ids, _ = runner.step(ttft_inp)
+        np.asarray(ids)  # TTFT ends when the host holds the first token
+        ttfts.append((time.perf_counter() - t0) * 1000)
+    p50_ttft = float(np.percentile(ttfts, 50))
+    p99_ttft = float(np.percentile(ttfts, 99))
+
+    # --- decode throughput: batch of decode_batch sequences at ~1k context ---
+    B = decode_batch
+    ctx = ctx_pages * page_size - 1
+    pt = np.arange(B * ctx_pages).reshape(B, ctx_pages)
+    dec = StepInput(
+        input_ids=rng.randint(0, cfg.vocab_size, (B, 1)),
+        positions=np.full((B, 1), ctx),
+        page_table=pt,
+        kv_lens=np.full((B,), ctx + 1),
+        temperature=np.full(B, 0.7),
+        top_k=np.full(B, 40),
+        top_p=np.full(B, 0.95),
+    )
+    ids, _ = runner.step(dec)  # compile
+    jax.block_until_ready(ids)
+    steps = 50
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ids, _ = runner.step(dec)
+    jax.block_until_ready(ids)
+    dt = time.perf_counter() - t0
+    decode_tps = B * steps / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "p50_ttft_ms_1k_prefill_flagship_1chip",
+                "value": round(p50_ttft, 2),
+                "unit": "ms",
+                "vs_baseline": round(200.0 / p50_ttft, 3),
+                "extras": {
+                    "p99_ttft_ms": round(p99_ttft, 2),
+                    "decode_tokens_per_sec_per_chip": round(decode_tps, 1),
+                    "decode_batch": B,
+                    "decode_context": ctx + 1,
+                    "platform": platform,
+                    "model": "llama-3.2-1b-class (random weights)",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""production_stack_tpu — a TPU-native LLM serving stack.
+
+A ground-up re-design of the capabilities of the vLLM Production Stack reference
+(router + helm + operator + observability around a CUDA serving engine) for TPU:
+
+- ``engine``   — JAX/XLA/Pallas serving engine: paged KV cache in HBM, ragged paged
+  attention, continuous batching with shape bucketing, prefix caching, OpenAI API.
+- ``models``   — model families (Llama, OPT, Qwen2, Mixtral-style MoE) as pure
+  functional JAX, scanned over layers for fast compiles.
+- ``ops``      — TPU kernels: RoPE, RMSNorm, paged/flash attention (XLA reference +
+  Pallas TPU implementations), sampling.
+- ``parallel`` — mesh construction, sharding rules (dp/tp/sp/ep/pp), ring attention
+  over ICI, pipeline parallelism, KV transfer between meshes.
+- ``router``   — L7 request router: service discovery, round-robin / session /
+  prefix-aware / KV-aware / disaggregated-prefill routing, stats, Prometheus metrics.
+- ``kvoffload``— tiered KV cache (HBM -> host DRAM -> disk -> remote cache server)
+  plus the global KV-index controller used by KV-aware routing.
+
+The reference stack delegates model execution to vLLM; here the engine is first-party
+(see SURVEY.md section "Critical framing").
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,111 @@
+"""ModelRunner — owns device state (params, KV page pools) and the jitted step.
+
+One compiled program per (batch_bucket, chunk_bucket, pages_bucket) triple; the
+scheduler quantizes work to those buckets so XLA never sees a new shape in
+steady state. KV pools are donated every call, so XLA updates pages in place
+(no pool-sized copies per token).
+
+This is the layer the reference delegates to vLLM's model executor; the serving
+contract above it (engine/api_server.py) matches the stack's expectations
+(SURVEY.md §1 L4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from production_stack_tpu.models import llama
+from production_stack_tpu.ops.sampling import sample
+from production_stack_tpu.parallel import shardings
+from production_stack_tpu.parallel.mesh import make_mesh
+
+
+@dataclasses.dataclass
+class StepInput:
+    """Host-side batch description, already bucketed by the scheduler."""
+
+    input_ids: Any      # [B, T] int32
+    positions: Any      # [B, T] int32, -1 pad
+    page_table: Any     # [B, max_pages] int32
+    kv_lens: Any        # [B] int32 (including this step's tokens)
+    temperature: Any    # [B] float32
+    top_k: Any          # [B] int32
+    top_p: Any          # [B] float32
+
+
+class ModelRunner:
+    """Holds params + KV pools on device and runs jitted prefill/decode steps."""
+
+    def __init__(
+        self,
+        cfg: llama.LlamaConfig,
+        *,
+        mesh: Optional[Mesh] = None,
+        params: Optional[dict] = None,
+        num_pages: int = 512,
+        page_size: int = 16,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.mesh = mesh if mesh is not None else make_mesh()
+
+        if params is None:
+            params = llama.init_params(cfg, jax.random.key(seed))
+        pspecs = shardings.param_specs_for(params)
+        self.params = shardings.shard_tree(params, pspecs, self.mesh)
+        kp, vp = llama.init_kv_pages(cfg, num_pages, page_size)
+        kv_sh = NamedSharding(self.mesh, shardings.KV_PAGES_SPEC)
+        self.k_pages = jax.device_put(kp, kv_sh)
+        self.v_pages = jax.device_put(vp, kv_sh)
+        self._rng = jax.random.key(seed)
+
+        self._row_sh = NamedSharding(self.mesh, P("dp", None))
+        self._vec_sh = NamedSharding(self.mesh, P("dp"))
+        self._step = jax.jit(
+            functools.partial(_step_fn, cfg),
+            donate_argnums=(1, 2),
+        )
+
+    def step(self, inp: StepInput) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Run one forward+sample step. Returns (token_ids [B], logits [B, V])."""
+        self._rng, key = jax.random.split(self._rng)
+        row = lambda x, dt: jax.device_put(jnp.asarray(x, dt), self._row_sh)
+        vec = lambda x, dt: jax.device_put(jnp.asarray(x, dt), self._vec_sh)
+        ids, logits, self.k_pages, self.v_pages = self._step(
+            self.params,
+            self.k_pages,
+            self.v_pages,
+            row(inp.input_ids, jnp.int32),
+            row(inp.positions, jnp.int32),
+            row(inp.page_table, jnp.int32),
+            vec(inp.kv_lens, jnp.int32),
+            vec(inp.temperature, jnp.float32),
+            vec(inp.top_k, jnp.int32),
+            vec(inp.top_p, jnp.float32),
+            key,
+        )
+        return ids, logits
+
+    def reset_kv(self) -> None:
+        """Zero the page pools (sleep/wake support frees and re-creates them)."""
+        kp, vp = llama.init_kv_pages(self.cfg, self.num_pages, self.page_size)
+        kv_sh = NamedSharding(self.mesh, shardings.KV_PAGES_SPEC)
+        self.k_pages = jax.device_put(kp, kv_sh)
+        self.v_pages = jax.device_put(vp, kv_sh)
+
+
+def _step_fn(cfg, params, k_pages, v_pages, input_ids, positions, page_table,
+             kv_lens, temperature, top_k, top_p, key):
+    logits, k_pages, v_pages = llama.forward(
+        params, cfg, input_ids, positions, k_pages, v_pages, page_table, kv_lens
+    )
+    ids = sample(logits, key, temperature, top_k, top_p)
+    return ids, logits, k_pages, v_pages

@@ -1,0 +1,200 @@
+"""Llama family (Llama 2/3/3.x, and by config also Mistral/Qwen2-sans-bias) as
+pure functional JAX.
+
+TPU-first choices:
+- Layers are *stacked*: every per-layer weight is one array with a leading
+  ``[num_layers, ...]`` axis and the decoder runs as a single ``lax.scan``.
+  One layer gets traced/compiled instead of 32, and the KV page pools ride the
+  scan as per-layer slices ``xs``/``ys`` (compile time and HBM layout both win).
+- bfloat16 weights/activations, fp32 softmax/norm statistics.
+- No data-dependent Python control flow: padding is handled by -1 positions
+  (dropped KV writes, masked attention), so one compiled program serves any
+  ragged batch within a (batch, pages) bucket.
+
+Reference parity: the stack's engine contract serves `meta-llama/Llama-3.1-8B-
+Instruct` (reference README.md:20-46) and `facebook/opt-125m` (CPU smoke,
+tutorials/assets/values-01-minimal-example.yaml); see models/opt.py for the
+latter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from production_stack_tpu.ops.attention import flash_attention, gather_kv_pages, write_kv_pages
+from production_stack_tpu.ops.norms import rms_norm
+from production_stack_tpu.ops.rope import RopeScaling, apply_rope, rope_cos_sin
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[RopeScaling] = None
+    rms_norm_eps: float = 1e-5
+    max_model_len: int = 8192
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def from_hf_config(cfg: dict) -> "LlamaConfig":
+        """Build from a HuggingFace `config.json` dict (LlamaForCausalLM etc.)."""
+        scaling = None
+        rs = cfg.get("rope_scaling") or None
+        if rs and rs.get("rope_type", rs.get("type")) == "llama3":
+            scaling = RopeScaling(
+                factor=rs.get("factor", 8.0),
+                low_freq_factor=rs.get("low_freq_factor", 1.0),
+                high_freq_factor=rs.get("high_freq_factor", 4.0),
+                original_max_position=rs.get("original_max_position_embeddings", 8192),
+            )
+        hidden = cfg["hidden_size"]
+        heads = cfg["num_attention_heads"]
+        return LlamaConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=cfg.get("num_key_value_heads", heads),
+            head_dim=cfg.get("head_dim", hidden // heads),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling=scaling,
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_model_len=cfg.get("max_position_embeddings", 8192),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+        )
+
+
+# Small presets used by tests, the benchmark, and the graft entry.
+PRESETS: dict[str, LlamaConfig] = {
+    "llama-3-8b": LlamaConfig(),
+    "llama-3.2-1b": LlamaConfig(
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        rope_scaling=RopeScaling(factor=32.0),
+        tie_word_embeddings=True,
+    ),
+    "llama-debug": LlamaConfig(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,
+        rope_theta=10000.0,
+        max_model_len=256,
+    ),
+}
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
+    """Random-normal initialized parameter tree (layer-stacked)."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    NH, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    scale = H**-0.5
+    params = {
+        "embed": normal(k_embed, (cfg.vocab_size, H), scale),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), cfg.dtype),
+            "wq": normal(ks[0], (L, H, NH * D), scale),
+            "wk": normal(ks[1], (L, H, KH * D), scale),
+            "wv": normal(ks[2], (L, H, KH * D), scale),
+            "wo": normal(ks[3], (L, NH * D, H), (NH * D) ** -0.5),
+            "mlp_norm": jnp.ones((L, H), cfg.dtype),
+            "w_gate": normal(ks[4], (L, H, I), scale),
+            "w_up": normal(ks[5], (L, H, I), scale),
+            "w_down": normal(ks[6], (L, I, H), I**-0.5),
+        },
+        "final_norm": jnp.ones((H,), cfg.dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = normal(k_head, (H, cfg.vocab_size), scale)
+    return params
+
+
+def init_kv_pages(
+    cfg: LlamaConfig, num_pages: int, page_size: int, dtype=None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Layer-stacked page pools: [L, num_pages, page_size, KH, D]."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def forward(
+    params: dict,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lens: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One forward step (prefill chunk or decode) with paged KV.
+
+    Args:
+      input_ids:  [B, T] int32 (T=1 for decode; padded rows have position -1).
+      positions:  [B, T] absolute positions, -1 for padding.
+      k_pages/v_pages: [L, P, page_size, KH, D] pools (donate for in-place).
+      page_table: [B, max_pages] physical page ids per sequence.
+      kv_lens:    [B] total valid KV length *including* this step's tokens.
+
+    Returns (logits[B, V] for each sequence's last valid token,
+             k_pages, v_pages updated).
+    """
+    B, T = input_ids.shape
+    x = params["embed"][input_ids].astype(cfg.dtype)  # [B, T, H]
+    cos, sin = rope_cos_sin(
+        jnp.maximum(positions, 0), cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+
+    def layer(x, layer_in):
+        lp, kp, vp = layer_in  # per-layer params and page pools
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kp, vp = write_kv_pages(kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions)
+        kc, vc = gather_kv_pages(kp, vp, page_table)
+        attn = flash_attention(q, kc, vc, q_positions=positions, kv_lens=kv_lens)
+        x = x + attn.reshape(B, T, -1) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = lax.scan(layer, x, (params["layers"], k_pages, v_pages))
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # Select each sequence's last valid token before the vocab projection so the
+    # logits tensor is [B, V], not [B, T, V] (a 2 GB save at V=128k, T=1k).
+    last_idx = jnp.maximum(jnp.sum(positions >= 0, axis=1) - 1, 0)  # [B]
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, H]
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = (x_last @ head).astype(jnp.float32)
+    return logits, k_pages, v_pages

@@ -1,0 +1,177 @@
+"""Paged attention for TPU serving — XLA reference implementations.
+
+Design (TPU-first, cf. SURVEY.md §7 "hard parts" #1):
+
+- KV lives in a *page pool* per layer: ``k_pages/v_pages: [num_pages, page_size,
+  num_kv_heads, head_dim]`` in HBM. Sequences own pages through an integer
+  ``page_table: [batch, max_pages_per_seq]``. All shapes are static under jit;
+  the engine buckets batch and context so XLA compiles a handful of programs.
+- Writes are flat scatters with ``mode='drop'`` so padded tokens vanish without
+  branches (no dynamic control flow inside jit).
+- Attention is an online-softmax ("flash") computation scanned over KV blocks,
+  GQA-aware (einsum over grouped heads, no materialized head repeat). The same
+  code path serves chunked prefill (T tokens against S context) and decode
+  (T=1); decode first gathers the sequence's pages into a contiguous [B, S]
+  view. A Pallas kernel that streams pages HBM->VMEM without the gather
+  replaces this on TPU (ops/pallas/paged_attention.py); this module is the
+  always-correct fallback and the unit-test oracle.
+
+Reference behavior being matched: vLLM's PagedAttention + chunked prefill as
+configured by the reference stack (helm/templates/deployment-vllm-multi.yaml:128-141
+in /root/reference — the stack enables chunked prefill and prefix caching; the
+engine must make those real).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def write_kv_pages(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    page_table: jnp.ndarray,
+    positions: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new K/V tokens into the page pool.
+
+    Args:
+      k_pages, v_pages: [P, page_size, KH, D] page pools.
+      k_new, v_new:     [B, T, KH, D] fresh keys/values for this step.
+      page_table:       [B, max_pages] int32 page ids owned by each sequence.
+      positions:        [B, T] int32 absolute token positions; -1 marks padding
+                        (those writes are dropped).
+
+    Returns updated (k_pages, v_pages). Callers should donate the pools so XLA
+    updates them in place.
+    """
+    P, page_size, KH, D = k_pages.shape
+    B, T = positions.shape
+    page_idx = positions // page_size          # [B, T] which logical page
+    slot = positions % page_size               # [B, T] slot within page
+    phys_page = jnp.take_along_axis(
+        page_table, jnp.clip(page_idx, 0, page_table.shape[1] - 1), axis=1
+    )                                          # [B, T]
+    flat = phys_page * page_size + slot        # [B, T]
+    flat = jnp.where(positions >= 0, flat, P * page_size)  # OOB -> dropped
+    flat = flat.reshape(-1)
+    k_flat = k_pages.reshape(P * page_size, KH, D)
+    v_flat = v_pages.reshape(P * page_size, KH, D)
+    k_flat = k_flat.at[flat].set(k_new.reshape(B * T, KH, D), mode="drop")
+    v_flat = v_flat.at[flat].set(v_new.reshape(B * T, KH, D), mode="drop")
+    return k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape)
+
+
+def gather_kv_pages(
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Gather each sequence's pages into contiguous [B, S, KH, D] views,
+    S = max_pages * page_size (bucketed by the scheduler)."""
+    P, page_size, KH, D = k_pages.shape
+    B, max_pages = page_table.shape
+    k = k_pages[page_table]  # [B, max_pages, page_size, KH, D]
+    v = v_pages[page_table]
+    S = max_pages * page_size
+    return k.reshape(B, S, KH, D), v.reshape(B, S, KH, D)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    kv_lens: jnp.ndarray,
+    *,
+    sm_scale: float | None = None,
+    block_size: int = 512,
+) -> jnp.ndarray:
+    """Online-softmax attention scanned over KV blocks (GQA-aware).
+
+    Args:
+      q:           [B, T, NH, D] queries (chunk of T tokens; T=1 for decode).
+      k, v:        [B, S, KH, D] contiguous keys/values (gathered pages).
+      q_positions: [B, T] absolute position of each query token; -1 = padding.
+      kv_lens:     [B] valid KV length per sequence.
+      sm_scale:    softmax scale; defaults to D**-0.5.
+      block_size:  KV block per scan step (memory/compute tradeoff).
+
+    Returns [B, T, NH, D] in q.dtype. A KV index j is visible to query at
+    position p iff j <= p and j < kv_len (causal within the real sequence).
+    """
+    B, T, NH, D = q.shape
+    S, KH = k.shape[1], k.shape[2]
+    G = NH // KH
+    scale = sm_scale if sm_scale is not None else D**-0.5
+
+    bs = min(block_size, S)
+    num_blocks = -(-S // bs)
+    pad = num_blocks * bs - S
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+    qf = (q.astype(jnp.float32) * scale).reshape(B, T, KH, G, D)
+    kb = k.reshape(B, num_blocks, bs, KH, D).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(B, num_blocks, bs, KH, D).transpose(1, 0, 2, 3, 4)
+
+    m0 = jnp.full((B, T, KH, G), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, T, KH, G), jnp.float32)
+    acc0 = jnp.zeros((B, T, KH, G, D), jnp.float32)
+
+    def body(carry, inputs):
+        m, l, acc, start = carry[0], carry[1], carry[2], carry[3]
+        kblk, vblk = inputs
+        kf = kblk.astype(jnp.float32)
+        scores = jnp.einsum("btkgd,bskd->btkgs", qf, kf)  # [B,T,KH,G,bs]
+        idx = start + jnp.arange(bs)
+        visible = (idx[None, None, :] <= q_positions[:, :, None]) & (
+            idx[None, None, :] < kv_lens[:, None, None]
+        )  # [B, T, bs]
+        scores = jnp.where(visible[:, :, None, None, :], scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # Guard exp(NEG_INF - NEG_INF) for fully masked rows.
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(visible[:, :, None, None, :], p, 0.0)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "btkgs,bskd->btkgd", p, vblk.astype(jnp.float32)
+        )
+        return (m_new, l_new, acc_new, start + bs), None
+
+    (m, l, acc, _), _ = lax.scan(body, (m0, l0, acc0, jnp.int32(0)), (kb, vb))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(B, T, NH, D).astype(q.dtype)
+
+
+def paged_attention_decode(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    *,
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Decode-step attention: one query token per sequence against its pages.
+
+    q: [B, NH, D]; returns [B, NH, D]. XLA reference path (gather + flash);
+    the Pallas kernel streams pages directly and skips the gather.
+    """
+    k, v = gather_kv_pages(k_pages, v_pages, page_table)
+    out = flash_attention(
+        q[:, None],
+        k,
+        v,
+        q_positions=(seq_lens - 1)[:, None],
+        kv_lens=seq_lens,
+        sm_scale=sm_scale,
+    )
+    return out[:, 0]

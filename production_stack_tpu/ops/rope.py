@@ -1,0 +1,71 @@
+"""Rotary position embeddings (RoPE), including Llama-3 frequency scaling.
+
+Computed on the fly from integer positions (no precomputed cos/sin table kept in
+HBM): for serving, positions are ragged per sequence and a gather from a table is
+the same cost as recomputing sin/cos on the VPU, while recomputation avoids a
+max_position-sized table and keeps shapes static under jit.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3 style rope scaling (`rope_type: llama3` in HF configs)."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+def _inv_freq(head_dim: int, theta: float, scaling: Optional[RopeScaling]) -> jnp.ndarray:
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta**exponent)
+    if scaling is None:
+        return inv_freq
+    # Llama-3 NTK-by-parts scaling.
+    low_wavelen = scaling.original_max_position / scaling.low_freq_factor
+    high_wavelen = scaling.original_max_position / scaling.high_freq_factor
+    wavelen = 2.0 * math.pi / inv_freq
+    scaled = inv_freq / scaling.factor
+    smooth = (scaling.original_max_position / wavelen - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor
+    )
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    mid = (1.0 - smooth) * scaled + smooth * inv_freq
+    return jnp.where(wavelen > low_wavelen, scaled, jnp.where(wavelen < high_wavelen, inv_freq, mid))
+
+
+def rope_cos_sin(
+    positions: jnp.ndarray,
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: Optional[RopeScaling] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin of shape positions.shape + (head_dim // 2,), float32."""
+    inv_freq = _inv_freq(head_dim, theta, scaling)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate pairs (x[..., :d/2], x[..., d/2:]) — HF 'neox' convention used by
+    Llama/Qwen. x: [..., heads, head_dim]; cos/sin: [..., head_dim//2] broadcast
+    over the heads axis."""
+    dtype = x.dtype
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    c = cos[..., None, :]
+    s = sin[..., None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

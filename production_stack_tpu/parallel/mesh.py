@@ -1,0 +1,51 @@
+"""Device-mesh construction for serving.
+
+Axis convention (fixed names, used by every sharding rule in the stack):
+
+- ``dp``: data / replica parallelism — independent request batches. Router-level
+  DP (N engine pods) is above this; in-engine dp shards one engine's batch.
+- ``tp``: tensor parallelism over ICI within a slice (the reference's
+  ``--tensor-parallel-size``, helm deployment-vllm-multi.yaml:149-151 — here
+  executed by XLA collectives instead of NCCL).
+- ``sp``: sequence/context parallelism (ring attention) — absent in the
+  reference (SURVEY.md §2.3), first-class here.
+- ``ep``: expert parallelism for MoE models.
+
+Pipeline parallelism spans *stages* across hosts and is handled by
+``parallel.pipeline`` (stage meshes over DCN), not as a mesh axis here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "ep", "tp")
+
+
+def make_mesh(
+    tp: int = 1,
+    dp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh with axes (dp, sp, ep, tp).
+
+    ``tp`` is the innermost (fastest-varying) axis so tensor-parallel
+    collectives ride neighbouring ICI links; ``dp`` is outermost so replicas
+    can span hosts over DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * sp * ep * tp
+    if need > len(devices):
+        raise ValueError(f"mesh {dp}x{sp}x{ep}x{tp} needs {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, sp, ep, tp)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh()

@@ -1,0 +1,65 @@
+"""Sharding rules (GSPMD PartitionSpecs) for model parameters, KV page pools,
+and per-step batch inputs.
+
+Megatron-style tensor parallelism expressed declaratively: column-parallel
+projections shard their output dim on ``tp``, row-parallel shard their input
+dim; XLA inserts the (reduce-scatter/all-reduce) collectives. No NCCL —
+this is the TPU replacement for the reference's in-engine TP
+(SURVEY.md §2.3: "jax.sharding/pjit mesh over ICI within a slice").
+
+KV page pools shard the *kv-head* axis on ``tp`` so each chip holds only its
+heads' pages — the paged-attention gather then never crosses chips.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Llama-family parameter tree -> PartitionSpec (leading None = stacked layer axis).
+LLAMA_PARAM_SPECS = {
+    "embed": P("tp", None),            # vocab-sharded; GSPMD handles the gather
+    "layers": {
+        "attn_norm": P(None, None),
+        "wq": P(None, None, "tp"),     # column parallel
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),     # row parallel
+        "mlp_norm": P(None, None),
+        "w_gate": P(None, None, "tp"),
+        "w_up": P(None, None, "tp"),
+        "w_down": P(None, "tp", None),
+    },
+    "final_norm": P(None),
+    "lm_head": P(None, "tp"),
+}
+
+# [L, P, page_size, KH, D] pools: shard kv heads over tp.
+KV_PAGES_SPEC = P(None, None, None, "tp", None)
+
+BATCH_SPECS = {
+    "input_ids": P("dp", None),
+    "positions": P("dp", None),
+    "page_table": P("dp", None),
+    "kv_lens": P("dp"),
+    "logits": P("dp", "tp"),
+}
+
+
+def param_specs_for(params: dict) -> dict:
+    """LLAMA_PARAM_SPECS restricted to the keys present (tied embeddings drop
+    lm_head)."""
+    specs = {k: v for k, v in LLAMA_PARAM_SPECS.items() if k in params}
+    return specs
+
+
+def shard_tree(tree, specs, mesh: Mesh):
+    """Device_put a pytree with per-leaf PartitionSpecs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,28 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The environment pins JAX to the single-TPU 'axon' platform via sitecustomize;
+tests instead exercise multi-chip sharding (dp/tp/sp meshes) on 8 virtual CPU
+devices, mirroring how the driver validates `dryrun_multichip`. Set
+PSTPU_TEST_TPU=1 to run the suite against the real chip instead.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+if not os.environ.get("PSTPU_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    return devs
