@@ -1,0 +1,342 @@
+"""OpenAI-compatible HTTP server for the TPU engine (aiohttp).
+
+Implements the serving-engine contract the reference stack expects of vLLM
+(SURVEY.md §1 L4): OpenAI API, Prometheus `/metrics` with `vllm:*`-compatible
+metric names (so the reference's router scraper, Grafana dashboards, and
+prometheus-adapter autoscaling rules work unchanged — stats/engine_stats.py:63-76
+in /root/reference), `/health`, `/v1/models`, `/tokenize`, `/detokenize`, and
+the sleep/wake endpoints used for pod hibernation
+(service_discovery.py:383-408 in /root/reference).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from production_stack_tpu import __version__
+from production_stack_tpu.engine.config import EngineConfig, add_engine_args, config_from_args
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.scheduler import SamplingParams
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def _sampling_params(body: dict, default_max: int = 256) -> SamplingParams:
+    stop = body.get("stop") or []
+    if isinstance(stop, str):
+        stop = [stop]
+    return SamplingParams(
+        max_tokens=int(body.get("max_tokens") or body.get("max_completion_tokens") or default_max),
+        temperature=float(body.get("temperature", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        top_p=float(body.get("top_p", 1.0)),
+        stop=list(stop),
+        ignore_eos=bool(body.get("ignore_eos", False)),
+        seed=body.get("seed"),
+    )
+
+
+def _usage(out) -> dict:
+    return {
+        "prompt_tokens": out.prompt_tokens,
+        "completion_tokens": out.completion_tokens,
+        "total_tokens": out.prompt_tokens + out.completion_tokens,
+        "prompt_tokens_details": {"cached_tokens": out.cached_tokens},
+    }
+
+
+class EngineServer:
+    def __init__(self, cfg: EngineConfig, engine: Optional[LLMEngine] = None):
+        self.cfg = cfg
+        self.engine = engine or LLMEngine(cfg)
+        self.start_time = time.time()
+
+    # -- handlers -----------------------------------------------------------
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.Response(text="")
+
+    async def version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": __version__})
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": self.cfg.name,
+                        "object": "model",
+                        "created": int(self.start_time),
+                        "owned_by": "production-stack-tpu",
+                        "max_model_len": self.cfg.max_model_len,
+                    }
+                ],
+            }
+        )
+
+    async def tokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        text = body.get("prompt")
+        if text is None and "messages" in body:
+            text = self.engine.tokenizer.apply_chat_template(body["messages"])
+        ids = self.engine.tokenizer.encode(text or "")
+        return web.json_response(
+            {"tokens": ids, "count": len(ids), "max_model_len": self.cfg.max_model_len}
+        )
+
+    async def detokenize(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        return web.json_response({"prompt": self.engine.tokenizer.decode(body.get("tokens", []))})
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        s = self.engine.stats()
+        m = self.cfg.name
+        lines = []
+
+        def emit(name: str, kind: str, value, help_: str = ""):
+            lines.append(f"# HELP vllm:{name} {help_ or name}")
+            lines.append(f"# TYPE vllm:{name} {kind}")
+            lines.append(f'vllm:{name}{{model_name="{m}"}} {value}')
+
+        emit("num_requests_running", "gauge", s["num_requests_running"])
+        emit("num_requests_waiting", "gauge", s["num_requests_waiting"])
+        emit("gpu_cache_usage_perc", "gauge", s["gpu_cache_usage_perc"])
+        emit("gpu_prefix_cache_hit_rate", "gauge", s["gpu_prefix_cache_hit_rate"])
+        emit("gpu_prefix_cache_hits_total", "counter", s["gpu_prefix_cache_hits_total"])
+        emit("gpu_prefix_cache_queries_total", "counter", s["gpu_prefix_cache_queries_total"])
+        emit("prompt_tokens_total", "counter", s["prompt_tokens_total"])
+        emit("generation_tokens_total", "counter", s["generation_tokens_total"])
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            messages = body.get("messages", [])
+            if not isinstance(messages, list):
+                raise ValueError("'messages' must be a list")
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": {"message": f"invalid request: {e}"}}, status=400)
+        prompt = self.engine.tokenizer.apply_chat_template(messages)
+        return await self._generate(request, body, prompt, chat=True)
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": {"message": f"invalid request: {e}"}}, status=400)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        return await self._generate(request, body, prompt, chat=False)
+
+    async def _generate(
+        self, request: web.Request, body: dict, prompt: str, chat: bool
+    ) -> web.StreamResponse:
+        if self.engine.is_sleeping:
+            return web.json_response({"error": "engine is sleeping"}, status=503)
+        model = body.get("model", self.cfg.name)
+        req_id = request.headers.get("X-Request-Id") or f"req-{uuid.uuid4().hex[:16]}"
+        params = _sampling_params(body)
+        stream = bool(body.get("stream", False))
+        created = int(time.time())
+        kind = "chat.completion" if chat else "text_completion"
+        oid = ("chatcmpl-" if chat else "cmpl-") + req_id
+
+        # Tokenize and validate *before* streaming starts — generate() is an
+        # async generator, so errors inside it would surface after the 200.
+        prompt_ids = self.engine.tokenizer.encode(prompt)
+        if len(prompt_ids) + 1 > self.cfg.max_model_len:
+            return web.json_response(
+                {
+                    "error": {
+                        "message": (
+                            f"prompt has {len(prompt_ids)} tokens, "
+                            f"max_model_len is {self.cfg.max_model_len}"
+                        )
+                    }
+                },
+                status=400,
+            )
+        gen = self.engine.generate(req_id, prompt_token_ids=prompt_ids, params=params)
+
+        if not stream:
+            text, finish_reason, last = [], None, None
+            async for out in gen:
+                text.append(out.text_delta)
+                last = out
+                if out.finished:
+                    finish_reason = out.finish_reason
+            full = "".join(text)
+            if chat:
+                choice = {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": full},
+                    "finish_reason": finish_reason,
+                }
+            else:
+                choice = {"index": 0, "text": full, "finish_reason": finish_reason}
+            return web.json_response(
+                {
+                    "id": oid,
+                    "object": kind,
+                    "created": created,
+                    "model": model,
+                    "choices": [choice],
+                    "usage": _usage(last) if last else {},
+                },
+                headers={"X-Request-Id": req_id},
+            )
+
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Request-Id": req_id,
+            },
+        )
+        await resp.prepare(request)
+
+        async def send(obj: dict):
+            await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
+
+        if chat:
+            await send(
+                {
+                    "id": oid, "object": "chat.completion.chunk", "created": created,
+                    "model": model,
+                    "choices": [{"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}],
+                }
+            )
+        last = None
+        try:
+            async for out in gen:
+                last = out
+                if out.text_delta or out.finished:
+                    if chat:
+                        choice = {
+                            "index": 0,
+                            "delta": {"content": out.text_delta} if out.text_delta else {},
+                            "finish_reason": out.finish_reason,
+                        }
+                        await send(
+                            {
+                                "id": oid, "object": "chat.completion.chunk",
+                                "created": created, "model": model, "choices": [choice],
+                            }
+                        )
+                    else:
+                        await send(
+                            {
+                                "id": oid, "object": "text_completion", "created": created,
+                                "model": model,
+                                "choices": [
+                                    {
+                                        "index": 0, "text": out.text_delta,
+                                        "finish_reason": out.finish_reason,
+                                    }
+                                ],
+                            }
+                        )
+            if last is not None:
+                await send(
+                    {
+                        "id": oid, "object": f"{kind}.chunk" if chat else kind,
+                        "created": created, "model": model, "choices": [],
+                        "usage": _usage(last),
+                    }
+                )
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            self.engine.abort(req_id)
+            raise
+        await resp.write_eof()
+        return resp
+
+    async def sleep(self, request: web.Request) -> web.Response:
+        if not self.cfg.enable_sleep_mode:
+            return web.json_response({"error": "sleep mode disabled"}, status=400)
+        level = int(request.query.get("level", "1"))
+        self.engine.sleep(level)
+        return web.Response(text="")
+
+    async def wake_up(self, request: web.Request) -> web.Response:
+        if not self.cfg.enable_sleep_mode:
+            return web.json_response({"error": "sleep mode disabled"}, status=400)
+        self.engine.wake_up()
+        return web.Response(text="")
+
+    async def is_sleeping(self, request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": self.engine.is_sleeping})
+
+    async def load_lora_adapter(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        return web.json_response(
+            {"status": "accepted", "lora_name": body.get("lora_name")},
+        )
+
+    async def unload_lora_adapter(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        return web.json_response(
+            {"status": "accepted", "lora_name": body.get("lora_name")},
+        )
+
+    # -- app ---------------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        r = app.router
+        r.add_get("/health", self.health)
+        r.add_get("/ping", self.health)
+        r.add_get("/version", self.version)
+        r.add_get("/v1/models", self.models)
+        r.add_get("/metrics", self.metrics)
+        r.add_post("/tokenize", self.tokenize)
+        r.add_post("/detokenize", self.detokenize)
+        r.add_post("/v1/chat/completions", self.chat_completions)
+        r.add_post("/v1/completions", self.completions)
+        r.add_post("/sleep", self.sleep)
+        r.add_post("/wake_up", self.wake_up)
+        r.add_get("/is_sleeping", self.is_sleeping)
+        r.add_post("/v1/load_lora_adapter", self.load_lora_adapter)
+        r.add_post("/v1/unload_lora_adapter", self.unload_lora_adapter)
+        return app
+
+
+async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
+    server = EngineServer(cfg, engine)
+    server.engine.start()
+    app = server.build_app()
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, cfg.host, cfg.port)
+    await site.start()
+    logger.info("engine API listening on %s:%d (model=%s)", cfg.host, cfg.port, cfg.name)
+    return server, runner
+
+
+def main():
+    p = argparse.ArgumentParser("tpu-engine")
+    add_engine_args(p)
+    args = p.parse_args()
+    cfg = config_from_args(args)
+
+    async def _run():
+        await serve(cfg)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Engine configuration + CLI.
+
+Flag surface mirrors what the reference stack passes to `vllm serve`
+(helm/templates/deployment-vllm-multi.yaml:96-186, ray-cluster.yaml:520-605 in
+/root/reference): tensor/pipeline parallel sizes, chunked prefill, prefix
+caching, max len, sleep mode — plus TPU-specific knobs (page count, buckets).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "llama-debug"          # preset name or local HF directory
+    served_model_name: Optional[str] = None
+    tokenizer: Optional[str] = None     # defaults to model dir when it is a path
+    host: str = "0.0.0.0"
+    port: int = 8100
+    max_num_seqs: int = 64
+    max_model_len: int = 4096
+    page_size: int = 16
+    num_pages: Optional[int] = None     # default: sized from kv_cache_memory_gb
+    kv_cache_memory_gb: float = 4.0
+    prefill_chunk: int = 512
+    prefill_batch: int = 4
+    enable_prefix_caching: bool = True
+    enable_chunked_prefill: bool = True
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    enable_sleep_mode: bool = False
+    seed: int = 0
+    # KV offload (LMCache-equivalent) wiring
+    kv_offload_cpu_gb: float = 0.0
+    kv_offload_dir: Optional[str] = None
+    kv_remote_url: Optional[str] = None
+    kv_controller_url: Optional[str] = None
+    kv_instance_id: Optional[str] = None
+    # disaggregated prefill role: none | producer | consumer
+    kv_role: str = "none"
+    kv_transfer_port: int = 55555
+    kv_peer_url: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return self.served_model_name or self.model
+
+
+def add_engine_args(p: argparse.ArgumentParser) -> None:
+    for f in dataclasses.fields(EngineConfig):
+        flag = "--" + f.name.replace("_", "-")
+        ftype = str(f.type)
+        if ftype == "bool" or isinstance(f.default, bool):
+            p.add_argument(flag, action=argparse.BooleanOptionalAction, default=f.default)
+        else:
+            typ = str
+            if "int" in ftype or isinstance(f.default, int):
+                typ = int
+            elif "float" in ftype or isinstance(f.default, float):
+                typ = float
+            p.add_argument(flag, type=typ, default=f.default)
+
+
+def config_from_args(args: argparse.Namespace) -> EngineConfig:
+    kwargs = {
+        f.name: getattr(args, f.name)
+        for f in dataclasses.fields(EngineConfig)
+        if hasattr(args, f.name)
+    }
+    return EngineConfig(**kwargs)

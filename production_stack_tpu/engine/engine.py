@@ -1,0 +1,292 @@
+"""LLMEngine — continuous-batching serving core.
+
+Execution model: one daemon thread owns the device (scheduler + ModelRunner)
+and spins the step loop; the asyncio side (HTTP handlers) submits sequences
+through a thread-safe inbox and receives ``RequestOutput`` items on per-request
+asyncio queues. This is the TPU-native equivalent of the vLLM engine process
+the reference stack treats as a black box (SURVEY.md §1 L4 contract).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import queue as queue_mod
+import threading
+import time
+from typing import AsyncIterator, Optional
+
+import numpy as np
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.kv_manager import KVPageManager
+from production_stack_tpu.engine.model_loader import load_model
+from production_stack_tpu.engine.runner import ModelRunner, StepInput
+from production_stack_tpu.engine.scheduler import SamplingParams, ScheduledBatch, Scheduler, Sequence
+from production_stack_tpu.engine.tokenizer import load_tokenizer
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    seq_id: str
+    text_delta: str
+    token_ids: list[int]
+    finished: bool
+    finish_reason: Optional[str] = None
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    cached_tokens: int = 0
+
+
+class LLMEngine:
+    def __init__(self, cfg: EngineConfig, mesh=None):
+        self.cfg = cfg
+        model_cfg, params = load_model(cfg.model, seed=cfg.seed, max_model_len=cfg.max_model_len)
+        self.model_cfg = model_cfg
+        self.tokenizer = load_tokenizer(
+            cfg.tokenizer or (cfg.model if "/" in cfg.model or cfg.model.startswith(".") else None)
+        )
+        page_bytes = (
+            2 * model_cfg.num_layers * cfg.page_size * model_cfg.num_kv_heads
+            * model_cfg.head_dim * 2  # k+v, bf16
+        )
+        num_pages = cfg.num_pages or max(64, int(cfg.kv_cache_memory_gb * 1e9 / page_bytes))
+        from production_stack_tpu.parallel.mesh import make_mesh
+
+        if mesh is None:
+            mesh = make_mesh(tp=cfg.tensor_parallel_size, dp=cfg.data_parallel_size)
+        self.runner = ModelRunner(
+            model_cfg, mesh=mesh, params=params,
+            num_pages=num_pages, page_size=cfg.page_size, seed=cfg.seed,
+        )
+        self.kv = KVPageManager(num_pages, cfg.page_size)
+        self.scheduler = Scheduler(
+            self.kv,
+            max_num_seqs=cfg.max_num_seqs,
+            max_model_len=cfg.max_model_len,
+            prefill_chunk=cfg.prefill_chunk if cfg.enable_chunked_prefill else 10**9,
+            prefill_batch=cfg.prefill_batch,
+            enable_prefix_caching=cfg.enable_prefix_caching,
+        )
+        self._inbox: queue_mod.Queue = queue_mod.Queue()
+        self._outputs: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
+        self._texts: dict[str, str] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._sleeping = False
+        self._sleep_level = 0
+        self._saved_params = None
+        self._lock = threading.Lock()
+        # serving stats (scraped by /metrics)
+        self.total_prompt_tokens = 0
+        self.total_generation_tokens = 0
+        self.num_preemptions = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run_loop, daemon=True, name="engine-loop")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._inbox.put(None)
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    # -- request api (asyncio side) -----------------------------------------
+
+    async def generate(
+        self,
+        seq_id: str,
+        prompt: Optional[str] = None,
+        prompt_token_ids: Optional[list[int]] = None,
+        params: Optional[SamplingParams] = None,
+    ) -> AsyncIterator[RequestOutput]:
+        params = params or SamplingParams()
+        if prompt_token_ids is None:
+            prompt_token_ids = self.tokenizer.encode(prompt or "")
+        if not prompt_token_ids:
+            prompt_token_ids = [self.tokenizer.bos_token_id]
+        if len(prompt_token_ids) + 1 > self.cfg.max_model_len:
+            raise ValueError(
+                f"prompt has {len(prompt_token_ids)} tokens, max_model_len is "
+                f"{self.cfg.max_model_len}"
+            )
+        if self._sleeping:
+            raise RuntimeError("engine is sleeping")
+        out_q: asyncio.Queue = asyncio.Queue()
+        loop = asyncio.get_running_loop()
+        with self._lock:
+            self._outputs[seq_id] = (loop, out_q)
+            self._texts[seq_id] = ""
+        seq = Sequence(seq_id=seq_id, prompt_ids=list(prompt_token_ids), params=params)
+        self._inbox.put(seq)
+        try:
+            while True:
+                item = await out_q.get()
+                yield item
+                if item.finished:
+                    break
+        finally:
+            with self._lock:
+                self._outputs.pop(seq_id, None)
+                self._texts.pop(seq_id, None)
+            self._inbox.put(("abort", seq_id))
+
+    def abort(self, seq_id: str) -> None:
+        self._inbox.put(("abort", seq_id))
+
+    # -- engine loop (device thread) ----------------------------------------
+
+    def _drain_inbox(self, block: bool) -> None:
+        timeout = 0.5 if block else None
+        while True:
+            try:
+                item = self._inbox.get(block=block, timeout=timeout)
+            except queue_mod.Empty:
+                return
+            block = False
+            if item is None:
+                return
+            if isinstance(item, tuple) and item[0] == "abort":
+                for s in self.scheduler.waiting + self.scheduler.running:
+                    if s.seq_id == item[1] and not s.finished:
+                        self.scheduler._finish(s, "abort")
+            else:
+                self._inbox_accept(item)
+
+    def _inbox_accept(self, seq: Sequence) -> None:
+        self.scheduler.add(seq)
+
+    def _run_loop(self) -> None:
+        logger.info("engine loop started (model=%s)", self.cfg.name)
+        while not self._stop.is_set():
+            if self._sleeping:
+                time.sleep(0.05)
+                self._drain_inbox(block=False)
+                continue
+            self._drain_inbox(block=not self.scheduler.has_work())
+            batch = self.scheduler.schedule()
+            if batch is None:
+                continue
+            try:
+                ids, _ = self.runner.step(
+                    StepInput(
+                        batch.input_ids, batch.positions, batch.page_table,
+                        batch.kv_lens, batch.temperature, batch.top_k, batch.top_p,
+                    )
+                )
+                tokens = np.asarray(ids)
+            except Exception:
+                logger.exception("engine step failed; aborting batch")
+                for s in batch.seqs:
+                    if not s.finished:
+                        self.scheduler._finish(s, "error")
+                        self._emit(s, "", error=True)
+                continue
+            events = self.scheduler.apply_step(
+                batch, tokens, self.tokenizer.eos_token_id
+            )
+            if batch.kind == "prefill":
+                for s, c in zip(batch.seqs, batch.chunk_sizes):
+                    self.total_prompt_tokens += c
+            for s, tok in events:
+                self.total_generation_tokens += 1
+                self._process_token(s)
+        logger.info("engine loop exited")
+
+    def _process_token(self, seq: Sequence) -> None:
+        """Detokenize incrementally, check stop strings, emit the delta."""
+        full = self.tokenizer.decode(seq.output_ids)
+        prev = self._texts.get(seq.seq_id, "")
+        delta = full[len(prev):] if full.startswith(prev) else full
+        for stop in seq.params.stop:
+            idx = full.find(stop)
+            if idx >= 0:
+                delta = full[len(prev): idx]
+                if not seq.finished:
+                    self.scheduler._finish(seq, "stop")
+                break
+        with self._lock:
+            self._texts[seq.seq_id] = prev + delta
+        self._emit(seq, delta)
+
+    def _emit(self, seq: Sequence, delta: str, error: bool = False) -> None:
+        with self._lock:
+            entry = self._outputs.get(seq.seq_id)
+        if entry is None:
+            return
+        loop, out_q = entry
+        out = RequestOutput(
+            seq_id=seq.seq_id,
+            text_delta=delta,
+            token_ids=[seq.output_ids[-1]] if seq.output_ids else [],
+            finished=seq.finished,
+            finish_reason=("error" if error else seq.finish_reason) if seq.finished else None,
+            prompt_tokens=len(seq.prompt_ids),
+            completion_tokens=len(seq.output_ids),
+            cached_tokens=seq.num_cached,
+        )
+        loop.call_soon_threadsafe(out_q.put_nowait, out)
+
+    # -- sleep / wake (engine contract: /sleep /wake_up /is_sleeping) -------
+
+    def sleep(self, level: int = 1) -> None:
+        """Free HBM without killing the process. Level 1 drops the KV pools;
+        level 2 additionally moves weights to host DRAM (SURVEY.md §7 hard
+        part #5)."""
+        if self._sleeping:
+            return
+        self._sleeping = True
+        self._sleep_level = level
+        for s in list(self.scheduler.running) + list(self.scheduler.waiting):
+            self.scheduler._finish(s, "abort")
+            self._emit(s, "")
+        self.runner.k_pages = None
+        self.runner.v_pages = None
+        if level >= 2:
+            import jax
+
+            self._saved_params = jax.device_get(self.runner.params)
+            self.runner.params = None
+        import gc
+
+        gc.collect()
+
+    def wake_up(self) -> None:
+        if not self._sleeping:
+            return
+        if self._sleep_level >= 2 and self._saved_params is not None:
+            from production_stack_tpu.parallel import shardings
+
+            pspecs = shardings.param_specs_for(self._saved_params)
+            self.runner.params = shardings.shard_tree(
+                self._saved_params, pspecs, self.runner.mesh
+            )
+            self._saved_params = None
+        self.runner.reset_kv()
+        self.kv = KVPageManager(self.kv.num_pages, self.kv.page_size)
+        self.scheduler.kv = self.kv
+        self._sleeping = False
+
+    @property
+    def is_sleeping(self) -> bool:
+        return self._sleeping
+
+    # -- stats --------------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "num_requests_running": self.scheduler.num_running(),
+            "num_requests_waiting": self.scheduler.num_waiting(),
+            "gpu_cache_usage_perc": self.kv.usage(),
+            "gpu_prefix_cache_hits_total": self.kv.prefix_hits,
+            "gpu_prefix_cache_queries_total": self.kv.prefix_queries,
+            "gpu_prefix_cache_hit_rate": self.kv.hit_rate(),
+            "prompt_tokens_total": self.total_prompt_tokens,
+            "generation_tokens_total": self.total_generation_tokens,
+        }

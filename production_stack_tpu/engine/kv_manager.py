@@ -1,0 +1,134 @@
+"""Host-side KV page accounting: allocator + chunk-hash prefix cache.
+
+The device holds the page *pools* (engine/runner.py); this module decides which
+physical pages each sequence owns. Prefix caching is page-granular and keyed by
+a rolling blake2b chain over full pages of token ids — the same chunk-hash
+scheme the router's prefix trie and the KV-index controller use, so routing,
+engine cache, and offload tiers agree on identity (SURVEY.md §7 hard part #3:
+"chunk hashing consistent between router trie, engine prefix cache, and
+KV-index controller").
+
+Reference parity: vLLM's `--enable-prefix-caching` + LMCache chunk reuse, as
+enabled by helm/templates/deployment-vllm-multi.yaml:137-141 in /root/reference.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+def chunk_hash(prev_hash: bytes, tokens: Sequence[int]) -> bytes:
+    h = hashlib.blake2b(prev_hash, digest_size=16)
+    h.update(b"".join(int(t).to_bytes(4, "little", signed=True) for t in tokens))
+    return h.digest()
+
+
+def prefix_hashes(tokens: Sequence[int], page_size: int) -> list[bytes]:
+    """Hash chain over full pages of `tokens` (len // page_size entries)."""
+    out, h = [], b""
+    for i in range(len(tokens) // page_size):
+        h = chunk_hash(h, tokens[i * page_size : (i + 1) * page_size])
+        out.append(h)
+    return out
+
+
+@dataclass
+class PageInfo:
+    ref_count: int = 0
+    hash: Optional[bytes] = None  # set once the page is full + hashable
+
+
+class KVPageManager:
+    """Reference-counted page allocator with an LRU prefix cache.
+
+    - ``allocate(n)`` / ``free(pages)``: plain paged allocation.
+    - ``match_prefix(tokens)``: longest cached page-aligned prefix -> shared
+      (ref-counted) pages. Cached pages with ref_count 0 live in an LRU pool
+      and are evicted only when a fresh allocation needs them.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.pages = [PageInfo() for _ in range(num_pages)]
+        self.free_list: list[int] = list(range(num_pages - 1, -1, -1))
+        self.hash_to_page: dict[bytes, int] = {}
+        # pages with ref_count==0 but still holding reusable KV, LRU order
+        self.evictable: OrderedDict[int, None] = OrderedDict()
+        self.prefix_queries = 0
+        self.prefix_hits = 0  # counted in pages
+
+    # -- allocation ---------------------------------------------------------
+
+    def num_free(self) -> int:
+        return len(self.free_list) + len(self.evictable)
+
+    def usage(self) -> float:
+        return 1.0 - self.num_free() / self.num_pages
+
+    def allocate(self, n: int) -> Optional[list[int]]:
+        if self.num_free() < n:
+            return None
+        out = []
+        for _ in range(n):
+            if self.free_list:
+                pid = self.free_list.pop()
+            else:  # evict oldest reusable page
+                pid, _ = self.evictable.popitem(last=False)
+                info = self.pages[pid]
+                if info.hash is not None:
+                    self.hash_to_page.pop(info.hash, None)
+                    info.hash = None
+            self.pages[pid].ref_count = 1
+            out.append(pid)
+        return out
+
+    def free(self, page_ids: Sequence[int]) -> None:
+        for pid in page_ids:
+            info = self.pages[pid]
+            info.ref_count -= 1
+            assert info.ref_count >= 0, f"double free of page {pid}"
+            if info.ref_count == 0:
+                if info.hash is not None:
+                    self.evictable[pid] = None  # keep KV for reuse
+                else:
+                    self.free_list.append(pid)
+
+    # -- prefix cache -------------------------------------------------------
+
+    def match_prefix(self, tokens: Sequence[int]) -> tuple[list[int], int]:
+        """Longest cached prefix of `tokens` (page-aligned).
+
+        Returns (shared_page_ids, num_cached_tokens). Increments ref counts of
+        the returned pages (caller owns them until `free`).
+        """
+        hashes = prefix_hashes(tokens, self.page_size)
+        self.prefix_queries += max(len(hashes), 1)
+        shared: list[int] = []
+        for h in hashes:
+            pid = self.hash_to_page.get(h)
+            if pid is None:
+                break
+            info = self.pages[pid]
+            if info.ref_count == 0:
+                self.evictable.pop(pid, None)
+            info.ref_count += 1
+            shared.append(pid)
+        self.prefix_hits += len(shared)
+        return shared, len(shared) * self.page_size
+
+    def register_filled(self, tokens: Sequence[int], page_ids: Sequence[int]) -> None:
+        """Record hashes for fully-written pages of a sequence so later
+        requests can share them. Called after prefill completes."""
+        hashes = prefix_hashes(tokens, self.page_size)
+        for h, pid in zip(hashes, page_ids):
+            info = self.pages[pid]
+            if info.hash is None and h not in self.hash_to_page:
+                info.hash = h
+                self.hash_to_page[h] = pid
+
+    def hit_rate(self) -> float:
+        return self.prefix_hits / self.prefix_queries if self.prefix_queries else 0.0

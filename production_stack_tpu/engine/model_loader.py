@@ -1,0 +1,94 @@
+"""Load model weights.
+
+Two paths:
+- preset name (llama-debug / llama-3.2-1b / llama-3-8b ...): seeded random
+  init — used by tests, benchmarks, and hermetic environments.
+- local HuggingFace directory (config.json + *.safetensors): production path;
+  weights live on a PVC exactly like the reference's HF_HOME cache
+  (helm/templates/deployment-vllm-multi.yaml:191-196 in /root/reference).
+
+HF Llama layout is mapped onto the layer-stacked tree models/llama.py uses
+(per-layer tensors stacked on a leading [L] axis for the scan).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.models import llama
+
+
+def is_hf_dir(path: str) -> bool:
+    return os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json"))
+
+
+def load_model(model: str, seed: int = 0, max_model_len: int | None = None):
+    """Returns (LlamaConfig, params)."""
+    if is_hf_dir(model):
+        return load_llama_from_hf(model)
+    if model in llama.PRESETS:
+        cfg = llama.PRESETS[model]
+        if max_model_len:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, max_model_len=max_model_len)
+        return cfg, llama.init_params(cfg, jax.random.key(seed))
+    raise ValueError(
+        f"model '{model}' is neither a preset ({sorted(llama.PRESETS)}) nor a local HF dir"
+    )
+
+
+def _safetensor_shards(path: str):
+    from safetensors import safe_open
+
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors in {path}")
+    tensors: dict[str, Any] = {}
+    for fname in files:
+        with safe_open(os.path.join(path, fname), framework="np") as f:
+            for key in f.keys():
+                tensors[key] = f.get_tensor(key)
+    return tensors
+
+
+def load_llama_from_hf(path: str) -> tuple[llama.LlamaConfig, dict]:
+    with open(os.path.join(path, "config.json")) as f:
+        hf_cfg = json.load(f)
+    cfg = llama.LlamaConfig.from_hf_config(hf_cfg)
+    t = _safetensor_shards(path)
+    L = cfg.num_layers
+    dt = cfg.dtype
+
+    def get(name: str) -> np.ndarray:
+        return np.asarray(t[name])
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        ws = [get(fmt.format(i)) for i in range(L)]
+        arr = np.stack([w.T if transpose else w for w in ws])
+        return jnp.asarray(arr, dt)
+
+    params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dt),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", transpose=False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+        "final_norm": jnp.asarray(get("model.norm.weight"), dt),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dt)
+    return cfg, params

@@ -67,8 +67,8 @@ class ModelRunner:
         self.v_pages = jax.device_put(vp, kv_sh)
         self._rng = jax.random.key(seed)
 
-        self._row_sh = NamedSharding(self.mesh, P("dp", None))
-        self._vec_sh = NamedSharding(self.mesh, P("dp"))
+        self._row_sh = NamedSharding(self.mesh, shardings.BATCH_SPECS["input_ids"])
+        self._vec_sh = NamedSharding(self.mesh, shardings.BATCH_SPECS["kv_lens"])
         self._step = jax.jit(
             functools.partial(_step_fn, cfg),
             donate_argnums=(1, 2),

@@ -1,0 +1,317 @@
+"""Continuous-batching scheduler with shape bucketing.
+
+Every jit shape is quantized: decode batches to power-of-two buckets, prefill
+chunks to a small set of lengths, page tables to power-of-two widths — so XLA
+compiles a bounded set of programs and steady-state serving never retraces
+(SURVEY.md §7 hard part #1).
+
+Policy (one device program per step, prefill-prioritized):
+- If any admitted sequence still has uncomputed prompt tokens, run one chunked
+  prefill step for up to ``prefill_batch`` such sequences (shortest-first to
+  release TTFT quickly).
+- Otherwise run one decode step over all running sequences.
+- Admission: a waiting sequence is admitted when its prompt's non-cached pages
+  fit in the allocator (prefix-cache hits make admission cheaper — KV reuse).
+
+The reference gets this behavior from vLLM (continuous batching + chunked
+prefill, enabled at helm/templates/deployment-vllm-multi.yaml:128-135); here it
+is first-party.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from production_stack_tpu.engine.kv_manager import KVPageManager
+
+
+@dataclass
+class SamplingParams:
+    max_tokens: int = 128
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop: list[str] = field(default_factory=list)
+    ignore_eos: bool = False
+    seed: Optional[int] = None
+
+
+@dataclass
+class Sequence:
+    seq_id: str
+    prompt_ids: list[int]
+    params: SamplingParams
+    arrival_time: float = field(default_factory=time.monotonic)
+    output_ids: list[int] = field(default_factory=list)
+    pages: list[int] = field(default_factory=list)
+    num_computed: int = 0          # prompt tokens already prefilled (incl. cached)
+    num_cached: int = 0            # tokens served from the prefix cache
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    first_token_time: Optional[float] = None
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def in_prefill(self) -> bool:
+        return self.num_computed < len(self.prompt_ids)
+
+
+@dataclass
+class ScheduledBatch:
+    kind: str                      # "prefill" | "decode"
+    seqs: list[Sequence]
+    # padded device inputs
+    input_ids: np.ndarray
+    positions: np.ndarray
+    page_table: np.ndarray
+    kv_lens: np.ndarray
+    temperature: np.ndarray
+    top_k: np.ndarray
+    top_p: np.ndarray
+    # how many tokens of each seq this step computes (prefill chunking)
+    chunk_sizes: list[int] = field(default_factory=list)
+
+
+def _bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class Scheduler:
+    DECODE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+    CHUNK_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
+    PAGE_BUCKETS = (2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+    def __init__(
+        self,
+        kv: KVPageManager,
+        *,
+        max_num_seqs: int = 64,
+        max_model_len: int = 4096,
+        prefill_chunk: int = 512,
+        prefill_batch: int = 4,
+        enable_prefix_caching: bool = True,
+    ):
+        self.kv = kv
+        self.max_num_seqs = max_num_seqs
+        self.max_model_len = max_model_len
+        self.prefill_chunk = prefill_chunk
+        self.prefill_batch = prefill_batch
+        self.enable_prefix_caching = enable_prefix_caching
+        self.waiting: list[Sequence] = []
+        self.running: list[Sequence] = []
+
+    # -- api ----------------------------------------------------------------
+
+    def add(self, seq: Sequence) -> None:
+        self.waiting.append(seq)
+
+    def abort(self, seq_id: str) -> None:
+        for q in (self.waiting, self.running):
+            for s in q:
+                if s.seq_id == seq_id and not s.finished:
+                    self._finish(s, "abort")
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    def num_running(self) -> int:
+        return len(self.running)
+
+    # -- internals ----------------------------------------------------------
+
+    def _pages_needed(self, tokens: int) -> int:
+        return -(-tokens // self.kv.page_size)
+
+    def _try_admit(self) -> None:
+        while self.waiting and len(self.running) < self.max_num_seqs:
+            seq = self.waiting[0]
+            if self.enable_prefix_caching:
+                shared, cached = self.kv.match_prefix(seq.prompt_ids)
+                # never serve the *entire* prompt from cache: the last token
+                # must be recomputed to produce logits
+                if cached >= len(seq.prompt_ids):
+                    drop = self._pages_needed(1)
+                    for pid in shared[-drop:]:
+                        self.kv.free([pid])
+                    shared = shared[:-drop]
+                    cached = len(shared) * self.kv.page_size
+            else:
+                shared, cached = [], 0
+            need = self._pages_needed(
+                min(len(seq.prompt_ids) + 16, self.max_model_len + 1)
+            ) - len(shared)
+            fresh = self.kv.allocate(max(need, 0))
+            if fresh is None:
+                self.kv.free(shared)
+                return
+            seq.pages = shared + fresh
+            seq.num_cached = cached
+            seq.num_computed = cached
+            self.waiting.pop(0)
+            self.running.append(seq)
+
+    def _ensure_decode_page(self, seq: Sequence) -> bool:
+        """Make sure the next token has a slot; grow the page list if needed."""
+        need = self._pages_needed(seq.num_tokens + 1) - len(seq.pages)
+        if need <= 0:
+            return True
+        extra = self.kv.allocate(need)
+        if extra is None:
+            return False
+        seq.pages.extend(extra)
+        return True
+
+    def _finish(self, seq: Sequence, reason: str) -> None:
+        seq.finished = True
+        seq.finish_reason = reason
+        if self.enable_prefix_caching:
+            self.kv.register_filled(
+                seq.prompt_ids + seq.output_ids, seq.pages
+            )
+        self.kv.free(seq.pages)
+        seq.pages = []
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+
+    # -- step planning ------------------------------------------------------
+
+    def schedule(self) -> Optional[ScheduledBatch]:
+        self._try_admit()
+        prefilling = [s for s in self.running if s.in_prefill]
+        if prefilling:
+            prefilling.sort(key=lambda s: len(s.prompt_ids) - s.num_computed)
+            return self._plan_prefill(prefilling[: self.prefill_batch])
+        if self.running:
+            return self._plan_decode(self.running)
+        return None
+
+    def _plan_prefill(self, seqs: list[Sequence]) -> ScheduledBatch:
+        chunks = [
+            min(len(s.prompt_ids) - s.num_computed, self.prefill_chunk) for s in seqs
+        ]
+        T = _bucket(max(chunks), self.CHUNK_BUCKETS)
+        B = _bucket(len(seqs), self.DECODE_BATCH_BUCKETS)
+        max_pages = _bucket(
+            max(self._pages_needed(s.num_computed + c) for s, c in zip(seqs, chunks)),
+            self.PAGE_BUCKETS,
+        )
+        input_ids = np.zeros((B, T), np.int32)
+        positions = np.full((B, T), -1, np.int32)
+        page_table = np.zeros((B, max_pages), np.int32)
+        kv_lens = np.zeros((B,), np.int32)
+        temperature = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        for i, (s, c) in enumerate(zip(seqs, chunks)):
+            lo = s.num_computed
+            input_ids[i, :c] = s.prompt_ids[lo : lo + c]
+            positions[i, :c] = np.arange(lo, lo + c)
+            pages = s.pages[:max_pages]
+            page_table[i, : len(pages)] = pages
+            kv_lens[i] = lo + c
+            temperature[i] = s.params.temperature
+            top_k[i] = s.params.top_k
+            top_p[i] = s.params.top_p
+        return ScheduledBatch(
+            "prefill", list(seqs), input_ids, positions, page_table, kv_lens,
+            temperature, top_k, top_p, chunk_sizes=chunks,
+        )
+
+    def _plan_decode(self, seqs: list[Sequence]) -> Optional[ScheduledBatch]:
+        ready = []
+        for s in list(seqs):
+            if s not in self.running or s.finished:
+                continue  # preempted or finished earlier in this pass
+            ok = self._ensure_decode_page(s)
+            while not ok:
+                # out of KV pages: preempt the newest other running sequence;
+                # if there is none, preempt s itself
+                others = [x for x in self.running if x is not s]
+                if not others:
+                    self._preempt(s)
+                    break
+                victim = max(others, key=lambda x: x.arrival_time)
+                self._preempt(victim)
+                if victim in ready:
+                    ready.remove(victim)
+                ok = self._ensure_decode_page(s)
+            if ok:
+                ready.append(s)
+        if not ready:
+            return None
+        B = _bucket(len(ready), self.DECODE_BATCH_BUCKETS)
+        max_pages = _bucket(
+            max(self._pages_needed(s.num_tokens + 1) for s in ready), self.PAGE_BUCKETS
+        )
+        input_ids = np.zeros((B, 1), np.int32)
+        positions = np.full((B, 1), -1, np.int32)
+        page_table = np.zeros((B, max_pages), np.int32)
+        kv_lens = np.zeros((B,), np.int32)
+        temperature = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        for i, s in enumerate(ready):
+            last = (s.prompt_ids + s.output_ids)[-1]
+            input_ids[i, 0] = last
+            positions[i, 0] = s.num_tokens - 1
+            pages = s.pages[:max_pages]
+            page_table[i, : len(pages)] = pages
+            kv_lens[i] = s.num_tokens
+            temperature[i] = s.params.temperature
+            top_k[i] = s.params.top_k
+            top_p[i] = s.params.top_p
+        return ScheduledBatch(
+            "decode", ready, input_ids, positions, page_table, kv_lens,
+            temperature, top_k, top_p,
+        )
+
+    def _preempt(self, seq: Sequence) -> None:
+        """Return a running sequence to the waiting queue, dropping its KV."""
+        self.kv.free(seq.pages)
+        seq.pages = []
+        seq.num_computed = 0
+        seq.num_cached = 0
+        if seq in self.running:
+            self.running.remove(seq)
+        self.waiting.insert(0, seq)
+
+    # -- result application -------------------------------------------------
+
+    def apply_step(self, batch: ScheduledBatch, token_ids: np.ndarray, eos_token_id: int):
+        """Apply sampled tokens; returns list of (seq, new_token or None)."""
+        events = []
+        for i, s in enumerate(batch.seqs):
+            if s.finished:
+                continue
+            if batch.kind == "prefill":
+                c = batch.chunk_sizes[i]
+                s.num_computed += c
+                if s.in_prefill:
+                    continue  # more prompt chunks to go
+                if s.first_token_time is None:
+                    s.first_token_time = time.monotonic()
+            tok = int(token_ids[i])
+            s.output_ids.append(tok)
+            events.append((s, tok))
+            if (not s.params.ignore_eos) and tok == eos_token_id:
+                self._finish(s, "stop")
+            elif len(s.output_ids) >= s.params.max_tokens:
+                self._finish(s, "length")
+            elif s.num_tokens >= self.max_model_len:
+                self._finish(s, "length")
+        return events

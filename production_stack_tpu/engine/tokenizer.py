@@ -1,0 +1,72 @@
+"""Tokenizer abstraction.
+
+Serving pods load a HuggingFace tokenizer from the model directory (mounted
+PVC — the reference caches weights on a PVC the same way, tutorials/03). In
+hermetic environments (tests, random-weight benchmarks) a built-in byte-level
+tokenizer is used so the whole stack runs with zero downloads.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: token = byte value; specials above 255."""
+
+    bos_token_id = 256
+    eos_token_id = 257
+    pad_token_id = 258
+    vocab_size = 512
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8", errors="replace"))
+        return ([self.bos_token_id] + ids) if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: list[dict]) -> str:
+        parts = [f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}\n" for m in messages]
+        parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+class HFTokenizer:
+    """Wrapper over a local HuggingFace tokenizer directory."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.bos_token_id = self._tok.bos_token_id
+        self.eos_token_id = self._tok.eos_token_id
+        self.pad_token_id = self._tok.pad_token_id or self._tok.eos_token_id
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: list[dict]) -> str:
+        try:
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        except Exception:
+            parts = [f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}\n" for m in messages]
+            parts.append("<|assistant|>\n")
+            return "".join(parts)
+
+
+def load_tokenizer(model_path: Optional[str]):
+    """HF tokenizer if `model_path` holds one locally, else the byte tokenizer."""
+    if model_path:
+        try:
+            return HFTokenizer(model_path)
+        except Exception:
+            pass
+    return ByteTokenizer()

@@ -52,13 +52,17 @@ def write_kv_pages(
     """
     P, page_size, KH, D = k_pages.shape
     B, T = positions.shape
+    max_pages = page_table.shape[1]
     page_idx = positions // page_size          # [B, T] which logical page
     slot = positions % page_size               # [B, T] slot within page
     phys_page = jnp.take_along_axis(
-        page_table, jnp.clip(page_idx, 0, page_table.shape[1] - 1), axis=1
+        page_table, jnp.clip(page_idx, 0, max_pages - 1), axis=1
     )                                          # [B, T]
     flat = phys_page * page_size + slot        # [B, T]
-    flat = jnp.where(positions >= 0, flat, P * page_size)  # OOB -> dropped
+    # Padding (-1) AND positions beyond the owned pages both route to the OOB
+    # sentinel and are dropped — never silently clipped into the last page.
+    valid = (positions >= 0) & (page_idx < max_pages)
+    flat = jnp.where(valid, flat, P * page_size)
     flat = flat.reshape(-1)
     k_flat = k_pages.reshape(P * page_size, KH, D)
     v_flat = v_pages.reshape(P * page_size, KH, D)

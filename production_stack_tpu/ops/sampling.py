@@ -31,7 +31,8 @@ def sample(
 
     Args:
       logits:      [B, V] float logits.
-      key:         PRNG key (one per step; folded per batch row internally).
+      key:         PRNG key for this step (categorical draws are independent
+                   per batch row).
       temperature: [B] float; 0 => greedy for that row.
       top_k:       [B] int; 0 or >=CANDIDATES => no top-k truncation.
       top_p:       [B] float in (0, 1]; 1 => no nucleus truncation.
