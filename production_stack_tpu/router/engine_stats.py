@@ -1,0 +1,128 @@
+"""Engine statistics scraper.
+
+Parity: src/vllm_router/stats/engine_stats.py in /root/reference —
+EngineStats.from_vllm_scrape :42-85, EngineStatsScraper (interval worker)
+:88-218. Scrapes each engine's Prometheus /metrics text and extracts the
+`vllm:*` gauges our TPU engine also emits (engine/api_server.py), so the same
+scraper works against vLLM pods and TPU pods.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Optional
+
+import aiohttp
+
+from production_stack_tpu.router.utils import SingletonMeta
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclasses.dataclass
+class EngineStats:
+    num_running_requests: int = 0
+    num_queuing_requests: int = 0
+    gpu_prefix_cache_hit_rate: float = 0.0
+    gpu_prefix_cache_hits_total: float = 0.0
+    gpu_prefix_cache_queries_total: float = 0.0
+    gpu_cache_usage_perc: float = 0.0
+
+    _FIELDS = {
+        "vllm:num_requests_running": "num_running_requests",
+        "vllm:num_requests_waiting": "num_queuing_requests",
+        "vllm:gpu_prefix_cache_hit_rate": "gpu_prefix_cache_hit_rate",
+        "vllm:gpu_prefix_cache_hits_total": "gpu_prefix_cache_hits_total",
+        "vllm:gpu_prefix_cache_queries_total": "gpu_prefix_cache_queries_total",
+        "vllm:gpu_cache_usage_perc": "gpu_cache_usage_perc",
+    }
+
+    @staticmethod
+    def from_scrape(text: str) -> "EngineStats":
+        """Parse Prometheus exposition text, summing across label sets."""
+        vals: dict[str, float] = {}
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                name_part, value = line.rsplit(None, 1)
+                name = name_part.split("{")[0]
+                if name in EngineStats._FIELDS:
+                    vals[name] = vals.get(name, 0.0) + float(value)
+            except ValueError:
+                continue
+        stats = EngineStats()
+        for metric, attr in EngineStats._FIELDS.items():
+            if metric in vals:
+                setattr(stats, attr, type(getattr(stats, attr))(vals[metric]))
+        # derive hit rate from counters when the gauge is absent (vLLM v1)
+        if stats.gpu_prefix_cache_queries_total > 0 and stats.gpu_prefix_cache_hit_rate == 0:
+            stats.gpu_prefix_cache_hit_rate = (
+                stats.gpu_prefix_cache_hits_total / stats.gpu_prefix_cache_queries_total
+            )
+        return stats
+
+
+class EngineStatsScraper(metaclass=SingletonMeta):
+    def __init__(self, scrape_interval: float = 15.0):
+        self.scrape_interval = scrape_interval
+        self.engine_stats: dict[str, EngineStats] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        from production_stack_tpu.router.service_discovery import get_service_discovery
+
+        while True:
+            try:
+                endpoints = get_service_discovery().get_endpoint_info()
+                results = await asyncio.gather(
+                    *[self._scrape_one(ep.url) for ep in endpoints]
+                )
+                fresh = {
+                    ep.url: st for ep, st in zip(endpoints, results) if st is not None
+                }
+                self.engine_stats.update(fresh)
+                for url in list(self.engine_stats):
+                    if url not in {ep.url for ep in endpoints}:
+                        del self.engine_stats[url]
+            except Exception:
+                logger.exception("engine stats scrape failed")
+            await asyncio.sleep(self.scrape_interval)
+
+    async def _scrape_one(self, url: str) -> Optional[EngineStats]:
+        from production_stack_tpu.router.request_service import get_client_session
+
+        try:
+            session = await get_client_session()
+            async with session.get(
+                f"{url}/metrics", timeout=aiohttp.ClientTimeout(total=5)
+            ) as resp:
+                return EngineStats.from_scrape(await resp.text())
+        except Exception:
+            return None
+
+    def get_engine_stats(self) -> dict[str, EngineStats]:
+        return dict(self.engine_stats)
+
+    def get_health(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+
+def initialize_engine_stats_scraper(scrape_interval: float = 15.0) -> EngineStatsScraper:
+    return EngineStatsScraper(scrape_interval)
+
+
+def get_engine_stats_scraper() -> EngineStatsScraper:
+    return EngineStatsScraper()

@@ -1,0 +1,112 @@
+"""Router CLI parser.
+
+Parity: src/vllm_router/parsers/parser.py in /root/reference (flag surface
+:96-320, JSON config seeding :44-52, validation :69-93).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def load_initial_config_from_config_json_if_required(argv: list[str]) -> list[str]:
+    """`--config <file.json>` seeds defaults; explicit CLI flags win."""
+    if "--config" not in argv:
+        return argv
+    idx = argv.index("--config")
+    path = argv[idx + 1]
+    with open(path) as f:
+        cfg = json.load(f)
+    seeded = []
+    for k, v in cfg.items():
+        flag = "--" + k.replace("_", "-")
+        if flag in argv:
+            continue
+        if isinstance(v, bool):
+            if v:
+                seeded.append(flag)
+        else:
+            seeded.extend([flag, str(v)])
+    return argv[:idx] + argv[idx + 2 :] + seeded
+
+
+def parse_args(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    argv = load_initial_config_from_config_json_if_required(argv)
+    p = argparse.ArgumentParser("tpu-router")
+    p.add_argument("--config", type=str, default=None, help="JSON config seeding defaults")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--service-discovery", choices=["static", "k8s"], default="static")
+    p.add_argument("--static-backends", type=str, default=None,
+                   help="comma-separated engine URLs")
+    p.add_argument("--static-models", type=str, default=None,
+                   help="comma-separated model names (one per backend)")
+    p.add_argument("--static-aliases", type=str, default=None)
+    p.add_argument("--static-model-labels", type=str, default=None)
+    p.add_argument("--static-model-types", type=str, default=None)
+    p.add_argument("--static-backend-health-checks", action="store_true")
+    p.add_argument("--health-check-interval", type=float, default=10.0)
+    p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--k8s-label-selector", default="")
+    p.add_argument("--k8s-port", default="8000")
+    p.add_argument("--routing-logic", default="roundrobin",
+                   choices=["roundrobin", "session", "kvaware", "prefixaware",
+                            "disaggregated_prefill"])
+    p.add_argument("--session-key", type=str, default=None)
+    p.add_argument("--kv-controller-url", type=str, default=None)
+    p.add_argument("--tokenizer", type=str, default=None)
+    p.add_argument("--prefill-model-labels", type=str, default=None)
+    p.add_argument("--decode-model-labels", type=str, default=None)
+    p.add_argument("--model-aliases", type=str, default=None, help="JSON dict")
+    p.add_argument("--engine-stats-interval", type=float, default=15.0)
+    p.add_argument("--request-stats-window", type=float, default=60.0)
+    p.add_argument("--log-stats", action="store_true")
+    p.add_argument("--log-stats-interval", type=float, default=10.0)
+    p.add_argument("--dynamic-config-json", type=str, default=None)
+    p.add_argument("--enable-batch-api", action="store_true")
+    p.add_argument("--file-storage-path", type=str, default="/tmp/tpu_router_files")
+    p.add_argument("--batch-db-path", type=str, default="/tmp/tpu_router_batches.sqlite")
+    p.add_argument("--callbacks", type=str, default=None,
+                   help="path.py:instance of CustomCallbackHandler")
+    p.add_argument("--feature-gates", type=str, default="",
+                   help="e.g. SemanticCache=true,PIIDetection=true")
+    p.add_argument("--semantic-cache-threshold", type=float, default=0.92)
+    p.add_argument("--pii-policy", type=str, default="redact",
+                   choices=["redact", "block"])
+    p.add_argument("--sentry-dsn", type=str, default=None)
+    args = p.parse_args(argv)
+    validate_args(args)
+    return args
+
+
+def validate_args(args) -> None:
+    if args.service_discovery == "static":
+        if not args.static_backends:
+            raise ValueError("static discovery requires --static-backends")
+        if not args.static_models:
+            raise ValueError("static discovery requires --static-models")
+        n_backends = len(args.static_backends.split(","))
+        n_models = len(args.static_models.split(","))
+        if n_backends != n_models:
+            raise ValueError(
+                f"--static-backends ({n_backends}) and --static-models ({n_models}) "
+                "must have the same length"
+            )
+    if args.routing_logic == "session" and not args.session_key:
+        raise ValueError("session routing requires --session-key")
+    if args.routing_logic == "kvaware" and not args.kv_controller_url:
+        raise ValueError("kvaware routing requires --kv-controller-url")
+    if args.routing_logic == "disaggregated_prefill" and not (
+        args.prefill_model_labels and args.decode_model_labels
+    ):
+        raise ValueError(
+            "disaggregated_prefill requires --prefill-model-labels and "
+            "--decode-model-labels"
+        )

@@ -1,0 +1,49 @@
+"""PII detection middleware (experimental, behind --feature-gates PIIDetection=true).
+
+Parity: src/vllm_router/experimental/pii/ in /root/reference —
+check_pii_content middleware.py:43-154, RegexAnalyzer analyzers/regex.py:22
+(Presidio analyzer is optional there and absent here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+PATTERNS: dict[str, re.Pattern] = {
+    "EMAIL": re.compile(r"[a-zA-Z0-9_.+-]+@[a-zA-Z0-9-]+\.[a-zA-Z0-9-.]+"),
+    "PHONE": re.compile(r"\+?\d[\d\s().-]{7,}\d"),
+    "SSN": re.compile(r"\b\d{3}-\d{2}-\d{4}\b"),
+    "CREDIT_CARD": re.compile(r"\b(?:\d[ -]*?){13,16}\b"),
+    "IP_ADDRESS": re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),
+    "API_KEY": re.compile(r"\b(?:sk|pk|rk)-[A-Za-z0-9]{16,}\b"),
+}
+
+
+@dataclasses.dataclass
+class PIIMatch:
+    kind: str
+    start: int
+    end: int
+    text: str
+
+
+class RegexAnalyzer:
+    def analyze(self, text: str) -> list[PIIMatch]:
+        out = []
+        for kind, pat in PATTERNS.items():
+            for m in pat.finditer(text):
+                out.append(PIIMatch(kind, m.start(), m.end(), m.group()))
+        return out
+
+
+def check_pii_content(text: str) -> list[PIIMatch]:
+    return RegexAnalyzer().analyze(text)
+
+
+def redact(text: str, matches: Optional[list[PIIMatch]] = None) -> str:
+    matches = matches if matches is not None else check_pii_content(text)
+    for m in sorted(matches, key=lambda m: -m.start):
+        text = text[: m.start] + f"[{m.kind}]" + text[m.end :]
+    return text

@@ -1,0 +1,287 @@
+"""Request proxying: the router's data plane.
+
+Parity: src/vllm_router/services/request_service/request.py in /root/reference —
+process_request (streaming proxy + stats hooks) :54-138, route_general_request
+(discovery, alias/sleep filtering, routing, response headers) :141-304,
+disaggregated prefill two-phase flow :307-439, sleep/wake proxying :442-514.
+
+The "Routing request <id> ... to <url> at <t>" log line format is load-bearing:
+the reference's e2e tests assert on it (tests/e2e/test-routing.py) and ours do
+too (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.router.routing_logic import (
+    DisaggregatedPrefillRouter,
+    get_routing_logic,
+)
+from production_stack_tpu.router.engine_stats import get_engine_stats_scraper
+from production_stack_tpu.router.request_stats import get_request_stats_monitor
+from production_stack_tpu.router.service_discovery import EndpointInfo, get_service_discovery
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+_client_session: Optional[aiohttp.ClientSession] = None
+
+
+async def get_client_session() -> aiohttp.ClientSession:
+    """Shared connection-pooled client (parity: httpx_client.py)."""
+    global _client_session
+    if _client_session is None or _client_session.closed:
+        _client_session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10),
+            connector=aiohttp.TCPConnector(limit=0),
+        )
+    return _client_session
+
+
+async def close_client_session() -> None:
+    global _client_session
+    if _client_session and not _client_session.closed:
+        await _client_session.close()
+    _client_session = None
+
+
+def _filter_headers(headers) -> dict:
+    hop = {"host", "content-length", "transfer-encoding", "connection"}
+    return {k: v for k, v in headers.items() if k.lower() not in hop}
+
+
+async def process_request(
+    request: web.Request,
+    body: bytes,
+    backend_url: str,
+    endpoint: str,
+    request_id: str,
+    *,
+    is_streaming: bool,
+    capture_body: Optional[object] = None,
+) -> web.StreamResponse:
+    """Proxy `body` to backend and stream the response back, firing request
+    stats callbacks (parity request.py:54-138).
+
+    `capture_body(status, bytes)` — optional async callback fired with the full
+    response once the proxy completes (semantic-cache store, post_request
+    callbacks)."""
+    monitor = get_request_stats_monitor()
+    monitor.on_new_request(backend_url, request_id)
+    session = await get_client_session()
+    resp: Optional[web.StreamResponse] = None
+    captured: list[bytes] = []
+    try:
+        async with session.post(
+            f"{backend_url}{endpoint}",
+            data=body,
+            headers=_filter_headers(request.headers),
+        ) as backend_resp:
+            resp = web.StreamResponse(
+                status=backend_resp.status,
+                headers={
+                    **_filter_headers(backend_resp.headers),
+                    "X-Request-Id": request_id,
+                },
+            )
+            await resp.prepare(request)
+            first = True
+            async for chunk in backend_resp.content.iter_any():
+                if first:
+                    monitor.on_request_response(backend_url, request_id)
+                    first = False
+                else:
+                    monitor.on_token(backend_url, request_id)
+                if capture_body is not None:
+                    captured.append(chunk)
+                await resp.write(chunk)
+            await resp.write_eof()
+            if capture_body is not None:
+                await capture_body(backend_resp.status, b"".join(captured))
+            return resp
+    except (aiohttp.ClientError, ConnectionResetError) as e:
+        logger.error("backend %s failed for request %s: %s", backend_url, request_id, e)
+        if resp is None or not resp.prepared:
+            return web.json_response({"error": f"backend error: {e}"}, status=502)
+        # headers already sent: terminate the stream instead of sending a
+        # second response on the same connection
+        try:
+            await resp.write_eof()
+        except Exception:
+            pass
+        return resp
+    finally:
+        # fires on success, backend error, AND client disconnect (CancelledError)
+        monitor.on_request_complete(backend_url, request_id)
+
+
+async def route_general_request(
+    request: web.Request,
+    endpoint: str,
+    *,
+    model_aliases: Optional[dict] = None,
+    capture_body: Optional[object] = None,
+    body_override: Optional[bytes] = None,
+) -> web.StreamResponse:
+    """Parse, filter endpoints by model + sleep state, route, proxy.
+    Parity request.py:141-304."""
+    in_router_time = time.time()
+    body = body_override if body_override is not None else await request.read()
+    request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
+    try:
+        request_json = json.loads(body) if body else {}
+    except json.JSONDecodeError:
+        return web.json_response({"error": "invalid JSON body"}, status=400)
+
+    router = get_routing_logic()
+    if isinstance(router, DisaggregatedPrefillRouter):
+        return await route_disaggregated_prefill_request(
+            request, endpoint, request_json, request_id
+        )
+
+    requested_model = request_json.get("model")
+    if model_aliases and requested_model in model_aliases:
+        requested_model = model_aliases[requested_model]
+        request_json["model"] = requested_model
+        body = json.dumps(request_json).encode()
+
+    endpoints = get_service_discovery().get_endpoint_info()
+    endpoints = [ep for ep in endpoints if not ep.sleep]
+    if requested_model:
+        matching = [ep for ep in endpoints if requested_model in ep.model_names]
+        if endpoints and not matching:
+            return web.json_response(
+                {"error": f"model {requested_model!r} not found"}, status=400
+            )
+        endpoints = matching
+    if not endpoints:
+        return web.json_response(
+            {"error": f"no healthy endpoints for model {requested_model!r}"}, status=503
+        )
+
+    engine_stats = get_engine_stats_scraper().get_engine_stats()
+    request_stats = get_request_stats_monitor().get_request_stats()
+    try:
+        server_url = await router.route_request(
+            endpoints, engine_stats, request_stats, request, request_json
+        )
+    except Exception as e:
+        logger.exception("routing failed")
+        return web.json_response({"error": f"routing failure: {e}"}, status=500)
+
+    curr_time = time.time()
+    logger.info(
+        "Routing request %s for model %s to %s at %f, process time = %.4f",
+        request_id, requested_model, server_url, curr_time, curr_time - in_router_time,
+    )
+    is_streaming = bool(request_json.get("stream", False))
+    return await process_request(
+        request, body, server_url, endpoint, request_id,
+        is_streaming=is_streaming, capture_body=capture_body,
+    )
+
+
+async def send_request_to_prefiller(
+    session: aiohttp.ClientSession, url: str, endpoint: str, payload: dict, request_id: str
+) -> dict:
+    """Phase 1: run prefill with max_tokens=1 (parity request.py:307-325)."""
+    async with session.post(
+        f"{url}{endpoint}",
+        json=payload,
+        headers={"X-Request-Id": request_id},
+    ) as resp:
+        resp.raise_for_status()
+        return await resp.json()
+
+
+async def route_disaggregated_prefill_request(
+    request: web.Request, endpoint: str, request_json: dict, request_id: str
+) -> web.StreamResponse:
+    """Two-phase P/D flow (parity request.py:347-439): prefill pool computes
+    KV (max_tokens=1), KV ships prefill->decode out-of-band (ICI/DCN via the
+    engine's kv-transfer role), then the decode pool streams tokens."""
+    router = get_routing_logic()
+    assert isinstance(router, DisaggregatedPrefillRouter)
+    endpoints = [ep for ep in get_service_discovery().get_endpoint_info() if not ep.sleep]
+    if not endpoints:
+        return web.json_response({"error": "no endpoints"}, status=503)
+    prefill_url = router.route_prefill(endpoints)
+    decode_url = router.route_decode(endpoints)
+    monitor = get_request_stats_monitor()
+    session = await get_client_session()
+
+    orig_max_tokens = request_json.get("max_tokens", 256)
+    prefill_json = dict(request_json)
+    prefill_json["max_tokens"] = 1
+    prefill_json["stream"] = False
+    prefill_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
+
+    t0 = time.time()
+    monitor.on_new_request(prefill_url, request_id)
+    logger.info(
+        "Routing request %s for model %s to prefill=%s decode=%s at %f",
+        request_id, request_json.get("model"), prefill_url, decode_url, t0,
+    )
+    try:
+        await send_request_to_prefiller(
+            session, prefill_url, endpoint, prefill_json, request_id
+        )
+        monitor.on_request_response(prefill_url, request_id)
+        monitor.on_request_complete(prefill_url, request_id)
+        logger.info("Prefill of %s done in %.3fs (TTFT)", request_id, time.time() - t0)
+    except aiohttp.ClientError as e:
+        monitor.on_request_complete(prefill_url, request_id)
+        return web.json_response({"error": f"prefill failed: {e}"}, status=502)
+
+    decode_json = dict(request_json)
+    decode_json["max_tokens"] = orig_max_tokens
+    decode_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
+    body = json.dumps(decode_json).encode()
+    return await process_request(
+        request, body, decode_url, endpoint, request_id,
+        is_streaming=bool(request_json.get("stream", False)),
+    )
+
+
+async def route_sleep_wakeup_request(
+    request: web.Request, path: str
+) -> web.Response:
+    """Proxy /sleep, /wake_up, /is_sleeping to a specific engine chosen by
+    ?url=... or model, and update discovery sleep flags
+    (parity request.py:442-514)."""
+    target = request.query.get("url")
+    sd = get_service_discovery()
+    candidates = [ep for ep in sd.get_endpoint_info() if target is None or ep.url == target]
+    # sleeping endpoints are filtered from get_endpoint_info (k8s mode) but
+    # must still be reachable for wake_up
+    if hasattr(sd, "endpoints"):
+        known = {c.url for c in candidates}
+        for ep in getattr(sd, "endpoints").values():
+            if ep.url not in known and (target is None or ep.url == target):
+                candidates.append(ep)
+    elif target is not None and not candidates and target in getattr(sd, "urls", []):
+        candidates = [EndpointInfo(url=target, model_names=[], added_timestamp=0)]
+    if not candidates:
+        return web.json_response({"error": "no matching engine"}, status=404)
+    ep = candidates[0]
+    session = await get_client_session()
+    try:
+        if path == "/is_sleeping":
+            async with session.get(f"{ep.url}{path}") as resp:
+                return web.json_response(await resp.json(), status=resp.status)
+        async with session.post(
+            f"{ep.url}{path}", params={k: v for k, v in request.query.items() if k != "url"}
+        ) as resp:
+            status = resp.status
+        if status == 200:
+            await sd.set_sleep_label(ep.url, path == "/sleep")
+        return web.Response(status=status)
+    except aiohttp.ClientError as e:
+        return web.json_response({"error": str(e)}, status=502)

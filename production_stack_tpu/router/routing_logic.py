@@ -1,0 +1,288 @@
+"""Routing algorithms.
+
+Parity: src/vllm_router/routers/routing_logic.py in /root/reference —
+roundrobin :126-157, session (consistent hash ring) :160-209, kvaware (global
+KV-index lookup) :212-329, prefixaware (HashTrie) :332-408,
+disaggregated_prefill :411-451, QPS fallback _qps_routing :59-81,
+initialize/reconfigure/get :455-511.
+
+The KV-aware router queries this stack's own KV-index controller
+(kvoffload/controller.py) — the TPU-native replacement for the LMCache
+controller ZMQ protocol the reference router speaks.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import hashlib
+import time
+from typing import Any, Optional
+
+from production_stack_tpu.router.hashtrie import HashTrie
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.router.utils import SingletonMeta
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class RoutingInterface(metaclass=SingletonMeta):
+    @abc.abstractmethod
+    async def route_request(
+        self,
+        endpoints: list[EndpointInfo],
+        engine_stats: dict[str, Any],
+        request_stats: dict[str, Any],
+        request: Any,
+        request_json: Optional[dict] = None,
+    ) -> str: ...
+
+
+def _qps_routing(endpoints: list[EndpointInfo], request_stats: dict[str, Any]) -> str:
+    """Lowest-QPS endpoint (parity :59-81)."""
+    best, best_qps = None, float("inf")
+    for ep in endpoints:
+        rs = request_stats.get(ep.url)
+        qps = rs.qps if rs is not None else -1
+        if qps < best_qps:
+            best, best_qps = ep.url, qps
+    if best is None:
+        raise ValueError("no endpoints to route to")
+    return best
+
+
+class RoundRobinRouter(RoutingInterface):
+    def __init__(self):
+        self.idx = 0
+
+    async def route_request(self, endpoints, engine_stats, request_stats, request,
+                            request_json=None) -> str:
+        urls = sorted(ep.url for ep in endpoints)
+        url = urls[self.idx % len(urls)]
+        self.idx += 1
+        return url
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes (uhashring replacement)."""
+
+    VNODES = 100
+
+    def __init__(self, nodes: Optional[list[str]] = None):
+        self._ring: list[tuple[int, str]] = []
+        self._nodes: set[str] = set()
+        for n in nodes or []:
+            self.add_node(n)
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "little")
+
+    def add_node(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        for v in range(self.VNODES):
+            self._ring.append((self._hash(f"{node}#{v}"), node))
+        self._ring.sort()
+
+    def remove_node(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._ring = [(h, n) for h, n in self._ring if n != node]
+
+    def get_nodes(self) -> set[str]:
+        return set(self._nodes)
+
+    def get_node(self, key: str) -> str:
+        if not self._ring:
+            raise ValueError("hash ring is empty")
+        h = self._hash(key)
+        import bisect
+
+        i = bisect.bisect_right(self._ring, (h, chr(0x10FFFF)))
+        if i == len(self._ring):
+            i = 0
+        return self._ring[i][1]
+
+
+class SessionRouter(RoutingInterface):
+    """Sticky sessions via consistent hashing on a header/param key
+    (parity :160-209)."""
+
+    def __init__(self, session_key: Optional[str] = None):
+        if not session_key:
+            raise ValueError("session routing requires --session-key")
+        self.session_key = session_key
+        self.ring = HashRing()
+
+    def _sync_ring(self, endpoints: list[EndpointInfo]) -> None:
+        urls = {ep.url for ep in endpoints}
+        for gone in self.ring.get_nodes() - urls:
+            self.ring.remove_node(gone)
+        for new in urls - self.ring.get_nodes():
+            self.ring.add_node(new)
+
+    async def route_request(self, endpoints, engine_stats, request_stats, request,
+                            request_json=None) -> str:
+        session_id = None
+        headers = getattr(request, "headers", None)
+        if headers is not None:
+            session_id = headers.get(self.session_key)
+        if session_id is None and request_json:
+            session_id = request_json.get(self.session_key)
+        self._sync_ring(endpoints)
+        if not session_id:
+            return _qps_routing(endpoints, request_stats)
+        return self.ring.get_node(str(session_id))
+
+
+class PrefixAwareRouter(RoutingInterface):
+    """Route to the endpoint that has seen the longest prefix of this prompt
+    (parity :332-408); falls back to lowest-QPS among tied candidates."""
+
+    def __init__(self):
+        self.trie = HashTrie()
+
+    @staticmethod
+    def _prompt_of(request_json: Optional[dict]) -> Optional[str]:
+        if not request_json:
+            return None
+        if "prompt" in request_json:
+            p = request_json["prompt"]
+            return p if isinstance(p, str) else (p[0] if p else None)
+        if "messages" in request_json:
+            return "".join(
+                str(m.get("content", "")) for m in request_json["messages"]
+            )
+        return None
+
+    async def route_request(self, endpoints, engine_stats, request_stats, request,
+                            request_json=None) -> str:
+        available = {ep.url for ep in endpoints}
+        prompt = self._prompt_of(request_json)
+        if prompt is None:
+            return _qps_routing(endpoints, request_stats)
+        matched, candidates = await self.trie.longest_prefix_match(prompt, available)
+        candidate_eps = [ep for ep in endpoints if ep.url in candidates]
+        url = _qps_routing(candidate_eps or endpoints, request_stats)
+        await self.trie.insert(prompt, url)
+        return url
+
+
+class KvawareRouter(RoutingInterface):
+    """Query the global KV-index controller for the instance holding the
+    longest cached token prefix (parity :212-329; LMCache controller protocol
+    replaced by kvoffload/controller.py)."""
+
+    def __init__(self, controller_url: Optional[str] = None, tokenizer_path: Optional[str] = None):
+        if not controller_url:
+            raise ValueError("kvaware routing requires --kv-controller-url")
+        self.controller_url = controller_url
+        from production_stack_tpu.engine.tokenizer import load_tokenizer
+
+        self.tokenizer = load_tokenizer(tokenizer_path)
+        self._client = None
+        self.fallback = PrefixAwareRouter.__new__(PrefixAwareRouter)
+        self.fallback.trie = HashTrie()
+
+    async def _lookup(self, tokens: list[int]) -> Optional[str]:
+        from production_stack_tpu.kvoffload.controller import ControllerClient
+
+        try:
+            if self._client is None:
+                self._client = ControllerClient(self.controller_url)
+            return await self._client.lookup_url(tokens)
+        except Exception as e:
+            logger.warning("kv controller lookup failed: %s", e)
+            self._client = None
+            return None
+
+    async def route_request(self, endpoints, engine_stats, request_stats, request,
+                            request_json=None) -> str:
+        prompt = PrefixAwareRouter._prompt_of(request_json)
+        if prompt is not None:
+            tokens = self.tokenizer.encode(prompt)
+            url = await self._lookup(tokens)
+            if url and any(ep.url == url for ep in endpoints):
+                return url
+        return await self.fallback.route_request(
+            endpoints, engine_stats, request_stats, request, request_json
+        )
+
+
+class DisaggregatedPrefillRouter(RoutingInterface):
+    """Pick a (prefill, decode) endpoint pair by model labels
+    (parity :411-451; the two-phase HTTP flow lives in request_service)."""
+
+    def __init__(self, prefill_labels: list[str], decode_labels: list[str]):
+        self.prefill_labels = prefill_labels
+        self.decode_labels = decode_labels
+        self._rr = {"prefill": 0, "decode": 0}
+
+    def _pick(self, endpoints: list[EndpointInfo], labels: list[str], kind: str) -> str:
+        pool = sorted(
+            ep.url for ep in endpoints if ep.model_label in labels
+        ) or sorted(ep.url for ep in endpoints)
+        url = pool[self._rr[kind] % len(pool)]
+        self._rr[kind] += 1
+        return url
+
+    async def route_request(self, endpoints, engine_stats, request_stats, request,
+                            request_json=None) -> str:
+        # plain route_request returns the decode endpoint; request_service
+        # calls route_prefill/route_decode explicitly for the 2-phase flow
+        return self._pick(endpoints, self.decode_labels, "decode")
+
+    def route_prefill(self, endpoints: list[EndpointInfo]) -> str:
+        return self._pick(endpoints, self.prefill_labels, "prefill")
+
+    def route_decode(self, endpoints: list[EndpointInfo]) -> str:
+        return self._pick(endpoints, self.decode_labels, "decode")
+
+
+_router: Optional[RoutingInterface] = None
+
+
+def initialize_routing_logic(
+    routing_logic: str,
+    *,
+    session_key: Optional[str] = None,
+    kv_controller_url: Optional[str] = None,
+    tokenizer_path: Optional[str] = None,
+    prefill_model_labels: Optional[list[str]] = None,
+    decode_model_labels: Optional[list[str]] = None,
+) -> RoutingInterface:
+    global _router
+    # reset only routing singletons (reconfigure support) — other singletons
+    # (stats scraper, request monitor) must survive a routing swap
+    for cls in list(SingletonMeta._instances):
+        if issubclass(cls, RoutingInterface):
+            SingletonMeta._instances.pop(cls)
+    if routing_logic == "roundrobin":
+        _router = RoundRobinRouter()
+    elif routing_logic == "session":
+        _router = SessionRouter(session_key)
+    elif routing_logic == "prefixaware":
+        _router = PrefixAwareRouter()
+    elif routing_logic == "kvaware":
+        _router = KvawareRouter(kv_controller_url, tokenizer_path)
+    elif routing_logic == "disaggregated_prefill":
+        _router = DisaggregatedPrefillRouter(
+            prefill_model_labels or [], decode_model_labels or []
+        )
+    else:
+        raise ValueError(f"unknown routing logic: {routing_logic}")
+    logger.info("initialized routing logic: %s", routing_logic)
+    return _router
+
+
+def reconfigure_routing_logic(routing_logic: str, **kwargs) -> RoutingInterface:
+    return initialize_routing_logic(routing_logic, **kwargs)
+
+
+def get_routing_logic() -> RoutingInterface:
+    assert _router is not None, "routing logic not initialized"
+    return _router

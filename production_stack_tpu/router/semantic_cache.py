@@ -1,0 +1,92 @@
+"""Semantic response cache (experimental, behind --feature-gates SemanticCache=true).
+
+Parity: src/vllm_router/experimental/semantic_cache/ in /root/reference
+(SemanticCache semantic_cache.py:16-120+, FAISSAdapter db_adapters/
+faiss_adapter.py:14-134, integration check/store hooks).
+
+The reference embeds with sentence-transformers and searches a FAISS index;
+neither ships in this environment, so the default embedder is a hashed
+character-n-gram featurizer (deterministic, dependency-free) with exact
+brute-force cosine search over a numpy matrix — the right structure with a
+pluggable `embed` function where a real encoder can drop in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+DIM = 256
+
+
+def ngram_hash_embed(text: str, dim: int = DIM) -> np.ndarray:
+    """Hashed char-3gram bag embedding, L2-normalized."""
+    v = np.zeros(dim, np.float32)
+    t = text.lower()
+    for i in range(max(len(t) - 2, 1)):
+        g = t[i : i + 3]
+        h = int.from_bytes(hashlib.blake2b(g.encode(), digest_size=4).digest(), "little")
+        v[h % dim] += 1.0
+    n = np.linalg.norm(v)
+    return v / n if n > 0 else v
+
+
+class SemanticCache:
+    def __init__(
+        self,
+        threshold: float = 0.92,
+        max_entries: int = 4096,
+        embed: Optional[Callable[[str], np.ndarray]] = None,
+    ):
+        self.threshold = threshold
+        self.max_entries = max_entries
+        self.embed = embed or ngram_hash_embed
+        self.vectors = np.zeros((0, DIM), np.float32)
+        self.entries: list[dict] = []
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _prompt_of(body: bytes) -> Optional[str]:
+        try:
+            data = json.loads(body)
+        except json.JSONDecodeError:
+            return None
+        msgs = data.get("messages")
+        if not msgs or data.get("stream"):
+            return None  # only cache non-streaming chat requests
+        return json.dumps(msgs, sort_keys=True)
+
+    async def check(self, body: bytes) -> Optional[dict]:
+        prompt = self._prompt_of(body)
+        if prompt is None or len(self.entries) == 0:
+            self.misses += 1
+            return None
+        q = self.embed(prompt)
+        sims = self.vectors @ q
+        best = int(np.argmax(sims))
+        if sims[best] >= self.threshold:
+            self.hits += 1
+            logger.info("semantic cache hit (sim=%.3f)", float(sims[best]))
+            return self.entries[best]["response"]
+        self.misses += 1
+        return None
+
+    async def store(self, body: bytes, response: dict) -> None:
+        prompt = self._prompt_of(body)
+        if prompt is None:
+            return
+        q = self.embed(prompt)
+        self.vectors = np.vstack([self.vectors, q[None]])
+        self.entries.append({"response": response, "ts": time.time()})
+        if len(self.entries) > self.max_entries:
+            self.vectors = self.vectors[1:]
+            self.entries.pop(0)

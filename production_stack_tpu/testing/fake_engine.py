@@ -1,0 +1,162 @@
+"""Fake TPU engine: an OpenAI-API mock for router/stack testing with zero
+accelerators — the keystone test fixture.
+
+Parity: src/tests/perftest/fake-openai-server.py:1-170 in /root/reference
+(streams tokens at --speed with injectable --ttft, tracks running requests),
+extended with /metrics in the engine's vllm:* format, sleep/wake, and optional
+kv-transfer query params so disaggregated-prefill flows are testable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+
+from aiohttp import web
+
+STATE = {
+    "running": 0,
+    "total": 0,
+    "sleeping": False,
+}
+
+
+def make_app(model: str, speed: float, ttft: float, model_label: str | None = None):
+    async def health(request):
+        return web.Response(text="")
+
+    async def models(request):
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": model,
+                        "object": "model",
+                        "created": int(time.time()),
+                        "owned_by": "fake-engine",
+                    }
+                ],
+            }
+        )
+
+    async def metrics(request):
+        text = (
+            f'vllm:num_requests_running{{model_name="{model}"}} {STATE["running"]}\n'
+            f'vllm:num_requests_waiting{{model_name="{model}"}} 0\n'
+            f'vllm:gpu_cache_usage_perc{{model_name="{model}"}} 0.42\n'
+            f'vllm:gpu_prefix_cache_hits_total{{model_name="{model}"}} 10\n'
+            f'vllm:gpu_prefix_cache_queries_total{{model_name="{model}"}} 20\n'
+        )
+        return web.Response(text=text, content_type="text/plain")
+
+    async def completions(request):
+        return await _generate(request, chat=False)
+
+    async def chat(request):
+        return await _generate(request, chat=True)
+
+    async def _generate(request, chat: bool):
+        if STATE["sleeping"]:
+            return web.json_response({"error": "sleeping"}, status=503)
+        body = await request.json()
+        max_tokens = int(body.get("max_tokens", 16))
+        stream = bool(body.get("stream", False))
+        req_id = request.headers.get("X-Request-Id", uuid.uuid4().hex)
+        STATE["running"] += 1
+        STATE["total"] += 1
+        created = int(time.time())
+        oid = ("chatcmpl-" if chat else "cmpl-") + req_id
+        try:
+            await asyncio.sleep(ttft)
+            if not stream:
+                await asyncio.sleep(max_tokens / speed)
+                text = "Hello " * max_tokens
+                choice = (
+                    {"index": 0, "message": {"role": "assistant", "content": text},
+                     "finish_reason": "length"}
+                    if chat
+                    else {"index": 0, "text": text, "finish_reason": "length"}
+                )
+                return web.json_response(
+                    {
+                        "id": oid, "object": "chat.completion" if chat else "text_completion",
+                        "created": created, "model": model, "choices": [choice],
+                        "usage": {
+                            "prompt_tokens": 10, "completion_tokens": max_tokens,
+                            "total_tokens": 10 + max_tokens,
+                        },
+                    },
+                    headers={"X-Request-Id": req_id},
+                )
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream", "X-Request-Id": req_id}
+            )
+            await resp.prepare(request)
+            for i in range(max_tokens):
+                delta = {"content": "Hello "} if chat else None
+                choice = (
+                    {"index": 0, "delta": delta, "finish_reason": None}
+                    if chat
+                    else {"index": 0, "text": "Hello ", "finish_reason": None}
+                )
+                await resp.write(
+                    f"data: {json.dumps({'id': oid, 'object': 'chat.completion.chunk' if chat else 'text_completion', 'created': created, 'model': model, 'choices': [choice]})}\n\n".encode()
+                )
+                await asyncio.sleep(1.0 / speed)
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        finally:
+            STATE["running"] -= 1
+
+    async def sleep(request):
+        STATE["sleeping"] = True
+        return web.Response(text="")
+
+    async def wake_up(request):
+        STATE["sleeping"] = False
+        return web.Response(text="")
+
+    async def is_sleeping(request):
+        return web.json_response({"is_sleeping": STATE["sleeping"]})
+
+    async def tokenize(request):
+        body = await request.json()
+        text = body.get("prompt", "")
+        return web.json_response(
+            {"tokens": list(text.encode()), "count": len(text.encode()), "max_model_len": 4096}
+        )
+
+    app = web.Application()
+    app.router.add_get("/health", health)
+    app.router.add_get("/v1/models", models)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_post("/sleep", sleep)
+    app.router.add_post("/wake_up", wake_up)
+    app.router.add_get("/is_sleeping", is_sleeping)
+    app.router.add_post("/tokenize", tokenize)
+    return app
+
+
+def main():
+    p = argparse.ArgumentParser("fake-tpu-engine")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--model", default="fake/model")
+    p.add_argument("--speed", type=float, default=100.0, help="tokens per second")
+    p.add_argument("--ttft", type=float, default=0.0, help="injected TTFT seconds")
+    p.add_argument("--model-label", default=None)
+    args = p.parse_args()
+    web.run_app(
+        make_app(args.model, args.speed, args.ttft, args.model_label),
+        port=args.port, print=None,
+    )
+
+
+if __name__ == "__main__":
+    main()

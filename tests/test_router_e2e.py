@@ -1,0 +1,303 @@
+"""Router e2e tests: real router subprocess in front of fake engines; routing
+verified by parsing the router's "Routing request ... to ..." log lines —
+the same verification method as the reference's tests/e2e/test-routing.py
+(SURVEY.md §4.3)."""
+
+import json
+import re
+import time
+
+import pytest
+import requests
+
+from production_stack_tpu.testing.procs import free_port, start_proc, stop_proc, wait_healthy
+
+ROUTE_RE = re.compile(r"Routing request (\S+) for model (\S+) to (\S+) at")
+
+
+def _start_fakes(n=2, model="fake/model", **kw):
+    procs, urls = [], []
+    for i in range(n):
+        port = free_port()
+        argv = ["-m", "production_stack_tpu.testing.fake_engine",
+                "--port", str(port), "--model", model, "--speed", "500"]
+        procs.append(start_proc(argv))
+        urls.append(f"http://127.0.0.1:{port}")
+    for proc, url in zip(procs, urls):
+        wait_healthy(f"{url}/health", proc, timeout=30)
+    return procs, urls
+
+
+def _start_router(urls, models=None, extra=None):
+    port = free_port()
+    models = models or ["fake/model"] * len(urls)
+    argv = [
+        "-m", "production_stack_tpu.router.app",
+        "--port", str(port),
+        "--static-backends", ",".join(urls),
+        "--static-models", ",".join(models),
+        "--engine-stats-interval", "1",
+    ] + (extra or [])
+    proc = start_proc(argv)
+    base = f"http://127.0.0.1:{port}"
+    wait_healthy(f"{base}/health", proc, timeout=30)
+    return proc, base
+
+
+def _routed_endpoints(log: str) -> list[str]:
+    return [m.group(3) for m in ROUTE_RE.finditer(log)]
+
+
+class TestRoundRobin:
+    def test_distribution(self):
+        fakes, urls = _start_fakes(2)
+        router, base = _start_router(urls)
+        try:
+            for _ in range(8):
+                r = requests.post(
+                    f"{base}/v1/chat/completions",
+                    json={"model": "fake/model",
+                          "messages": [{"role": "user", "content": "hi"}],
+                          "max_tokens": 2},
+                    timeout=15,
+                )
+                assert r.status_code == 200
+                assert "Hello" in r.json()["choices"][0]["message"]["content"]
+        finally:
+            log = stop_proc(router)
+            for p in fakes:
+                stop_proc(p)
+        routed = _routed_endpoints(log)
+        assert len(routed) == 8
+        counts = {u: routed.count(u) for u in set(routed)}
+        assert counts == {urls[0]: 4, urls[1]: 4}
+
+
+class TestSession:
+    def test_sticky(self):
+        fakes, urls = _start_fakes(3)
+        router, base = _start_router(
+            urls, extra=["--routing-logic", "session", "--session-key", "x-session-id"]
+        )
+        try:
+            for sid in ("alice", "bob", "carol", "alice", "bob", "alice"):
+                r = requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                    headers={"x-session-id": sid},
+                    timeout=15,
+                )
+                assert r.status_code == 200
+        finally:
+            log = stop_proc(router)
+            for p in fakes:
+                stop_proc(p)
+        lines = [
+            (m.group(1), m.group(3)) for m in ROUTE_RE.finditer(log)
+        ]
+        assert len(lines) == 6
+        routed = [u for _, u in lines]
+        # alice's three requests (indices 0,3,5) all landed on one endpoint
+        assert routed[0] == routed[3] == routed[5]
+        assert routed[1] == routed[4]
+
+
+class TestPrefixAware:
+    def test_same_prefix_same_endpoint(self):
+        fakes, urls = _start_fakes(2)
+        router, base = _start_router(urls, extra=["--routing-logic", "prefixaware"])
+        prefix = "You are a helpful assistant. " * 30
+        try:
+            for i in range(6):
+                r = requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": prefix + f"q{i}",
+                          "max_tokens": 2},
+                    timeout=15,
+                )
+                assert r.status_code == 200
+        finally:
+            log = stop_proc(router)
+            for p in fakes:
+                stop_proc(p)
+        routed = _routed_endpoints(log)
+        assert len(routed) == 6
+        assert len(set(routed)) == 1  # all to the endpoint that saw the prefix
+
+
+class TestDisaggregatedPrefill:
+    def test_two_phase(self):
+        fakes, urls = _start_fakes(2)
+        router, base = _start_router(
+            urls,
+            models=["fake/model", "fake/model"],
+            extra=[
+                "--routing-logic", "disaggregated_prefill",
+                "--prefill-model-labels", "prefill",
+                "--decode-model-labels", "decode",
+                "--static-model-labels", "prefill,decode",
+            ],
+        )
+        try:
+            r = requests.post(
+                f"{base}/v1/completions",
+                json={"model": "fake/model", "prompt": "hello", "max_tokens": 4},
+                timeout=20,
+            )
+            assert r.status_code == 200
+            assert "Hello" in r.json()["choices"][0]["text"]
+        finally:
+            log = stop_proc(router)
+            for p in fakes:
+                stop_proc(p)
+        m = re.search(r"to prefill=(\S+) decode=(\S+) at", log)
+        assert m, f"no disagg routing line in log:\n{log[-2000:]}"
+        assert m.group(1) == urls[0] and m.group(2) == urls[1]
+        assert "Prefill of" in log  # TTFT logged
+
+
+class TestExperimentalFeatures:
+    def test_pii_block_and_semantic_cache(self):
+        fakes, urls = _start_fakes(1)
+        router, base = _start_router(
+            urls,
+            extra=["--feature-gates", "SemanticCache=true,PIIDetection=true",
+                   "--pii-policy", "block", "--semantic-cache-threshold", "0.99"],
+        )
+        try:
+            # PII gets blocked
+            r = requests.post(
+                f"{base}/v1/completions",
+                json={"model": "fake/model",
+                      "prompt": "my ssn is 123-45-6789", "max_tokens": 2},
+                timeout=15,
+            )
+            assert r.status_code == 400
+            assert "PII" in r.text
+            # identical chat request twice: second comes from semantic cache
+            payload = {
+                "model": "fake/model",
+                "messages": [{"role": "user", "content": "what is the capital of France"}],
+                "max_tokens": 4,
+            }
+            r1 = requests.post(f"{base}/v1/chat/completions", json=payload, timeout=15)
+            assert r1.status_code == 200
+            assert "X-Semantic-Cache" not in r1.headers
+            r2 = requests.post(f"{base}/v1/chat/completions", json=payload, timeout=15)
+            assert r2.status_code == 200
+            assert r2.headers.get("X-Semantic-Cache") == "hit"
+            assert r2.json() == r1.json()
+        finally:
+            stop_proc(router)
+            for p in fakes:
+                stop_proc(p)
+
+
+class TestStackSurface:
+    @pytest.fixture(scope="class")
+    def stack(self):
+        fakes, urls = _start_fakes(2)
+        router, base = _start_router(urls, extra=["--enable-batch-api"])
+        yield base, urls
+        stop_proc(router)
+        for p in fakes:
+            stop_proc(p)
+
+    def test_models_aggregated(self, stack):
+        base, _ = stack
+        data = requests.get(f"{base}/v1/models").json()["data"]
+        assert [m["id"] for m in data] == ["fake/model"]
+
+    def test_engines_listing(self, stack):
+        base, urls = stack
+        # wait for a scrape cycle
+        time.sleep(1.5)
+        engines = requests.get(f"{base}/engines").json()["engines"]
+        assert {e["url"] for e in engines} == set(urls)
+        assert any("engine_stats" in e for e in engines)
+
+    def test_router_metrics(self, stack):
+        base, _ = stack
+        requests.post(
+            f"{base}/v1/completions",
+            json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+        )
+        text = requests.get(f"{base}/metrics").text
+        assert "vllm_router:current_qps" in text
+        assert "vllm_router:cpu_usage_perc" in text
+
+    def test_streaming_through_router(self, stack):
+        base, _ = stack
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={"model": "fake/model",
+                  "messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 4, "stream": True},
+            stream=True, timeout=15,
+        )
+        lines = [l for l in r.iter_lines() if l.startswith(b"data: ")]
+        assert lines[-1] == b"data: [DONE]"
+        assert len(lines) >= 4
+
+    def test_sleep_wake_proxy_and_routing_exclusion(self, stack):
+        base, urls = stack
+        assert requests.post(f"{base}/sleep", params={"url": urls[0]}).status_code == 200
+        assert requests.get(
+            f"{base}/is_sleeping", params={"url": urls[0]}
+        ).json()["is_sleeping"] is True
+        # while asleep, traffic must avoid the sleeping backend
+        for _ in range(4):
+            r = requests.post(
+                f"{base}/v1/completions",
+                json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                timeout=15,
+            )
+            assert r.status_code == 200  # fake engine 503s if it gets hit asleep
+        assert requests.post(f"{base}/wake_up", params={"url": urls[0]}).status_code == 200
+        assert requests.get(
+            f"{base}/is_sleeping", params={"url": urls[0]}
+        ).json()["is_sleeping"] is False
+
+    def test_files_and_batches(self, stack):
+        base, _ = stack
+        batch_input = "\n".join(
+            json.dumps(
+                {
+                    "custom_id": f"req-{i}",
+                    "method": "POST",
+                    "url": "/v1/chat/completions",
+                    "body": {
+                        "model": "fake/model",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 2,
+                    },
+                }
+            )
+            for i in range(3)
+        )
+        up = requests.post(
+            f"{base}/v1/files",
+            files={"file": ("batch.jsonl", batch_input)},
+            data={"purpose": "batch"},
+        )
+        assert up.status_code == 200, up.text
+        file_id = up.json()["id"]
+        meta = requests.get(f"{base}/v1/files/{file_id}").json()
+        assert meta["filename"] == "batch.jsonl"
+
+        b = requests.post(
+            f"{base}/v1/batches",
+            json={"input_file_id": file_id, "endpoint": "/v1/chat/completions"},
+        ).json()
+        deadline = time.time() + 30
+        status = b["status"]
+        while status not in ("completed", "failed") and time.time() < deadline:
+            time.sleep(0.5)
+            b = requests.get(f"{base}/v1/batches/{b['id']}").json()
+            status = b["status"]
+        assert status == "completed", b
+        assert b["request_counts"]["completed"] == 3
+        content = requests.get(
+            f"{base}/v1/files/{b['output_file_id']}/content"
+        ).content.decode()
+        assert len(content.strip().splitlines()) == 3

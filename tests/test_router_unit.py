@@ -1,0 +1,226 @@
+"""Router unit tests with duck-typed fakes (reference test strategy §4.1:
+test_session_router.py, test_static_service_discovery.py, test_parser.py)."""
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+import pytest
+
+from production_stack_tpu.router.engine_stats import EngineStats
+from production_stack_tpu.router.hashtrie import HashTrie
+from production_stack_tpu.router.parser import parse_args
+from production_stack_tpu.router.pii import check_pii_content, redact
+from production_stack_tpu.router.request_stats import RequestStatsMonitor
+from production_stack_tpu.router.routing_logic import (
+    HashRing,
+    PrefixAwareRouter,
+    RoundRobinRouter,
+    SessionRouter,
+)
+from production_stack_tpu.router.utils import SingletonMeta
+from production_stack_tpu.router.feature_gates import FeatureGates
+
+
+@dataclass
+class FakeEndpoint:
+    url: str
+    model_names: list = field(default_factory=lambda: ["m"])
+    added_timestamp: float = 0.0
+    model_label: str = None
+    sleep: bool = False
+    model_info: dict = field(default_factory=dict)
+
+
+@dataclass
+class FakeRequest:
+    headers: dict = field(default_factory=dict)
+
+
+def fresh(cls, *args, **kwargs):
+    SingletonMeta._instances.pop(cls, None)
+    return cls(*args, **kwargs)
+
+
+def test_roundrobin_cycles():
+    router = fresh(RoundRobinRouter)
+    eps = [FakeEndpoint(f"http://e{i}") for i in range(3)]
+    urls = [
+        asyncio.run(router.route_request(eps, {}, {}, FakeRequest())) for _ in range(6)
+    ]
+    assert urls == ["http://e0", "http://e1", "http://e2"] * 2
+
+
+def test_session_router_sticky_and_stable_under_change():
+    router = fresh(SessionRouter, "x-session-id")
+    eps = [FakeEndpoint(f"http://e{i}") for i in range(4)]
+    req = FakeRequest(headers={"x-session-id": "user-42"})
+
+    url1 = asyncio.run(router.route_request(eps, {}, {}, req))
+    for _ in range(5):
+        assert asyncio.run(router.route_request(eps, {}, {}, req)) == url1
+
+    # removing an unrelated endpoint must not move the session (consistent hash)
+    survivors = [ep for ep in eps if ep.url != "http://e3"]
+    if url1 != "http://e3":
+        assert asyncio.run(router.route_request(survivors, {}, {}, req)) == url1
+
+    # most keys stay put when one node leaves
+    moved = 0
+    for i in range(100):
+        r = FakeRequest(headers={"x-session-id": f"u{i}"})
+        a = asyncio.run(router.route_request(eps, {}, {}, r))
+        b = asyncio.run(router.route_request(survivors, {}, {}, r))
+        if a != b:
+            moved += 1
+    assert moved < 50  # consistent hashing: only keys on the removed node move
+
+
+def test_session_router_no_session_falls_back_qps():
+    router = fresh(SessionRouter, "x-session-id")
+    eps = [FakeEndpoint("http://a"), FakeEndpoint("http://b")]
+
+    @dataclass
+    class RS:
+        qps: float
+
+    stats = {"http://a": RS(5.0), "http://b": RS(1.0)}
+    assert asyncio.run(router.route_request(eps, {}, stats, FakeRequest())) == "http://b"
+
+
+def test_hashring_distribution():
+    ring = HashRing([f"n{i}" for i in range(4)])
+    counts = {}
+    for i in range(1000):
+        counts[ring.get_node(f"key{i}")] = counts.get(ring.get_node(f"key{i}"), 0) + 1
+    assert len(counts) == 4
+    assert min(counts.values()) > 100  # roughly balanced
+
+
+def test_prefix_aware_router_prefers_seen_endpoint():
+    router = fresh(PrefixAwareRouter)
+    eps = [FakeEndpoint("http://a"), FakeEndpoint("http://b")]
+
+    @dataclass
+    class RS:
+        qps: float
+
+    stats = {"http://a": RS(0.0), "http://b": RS(0.0)}
+    prompt = "You are a helpful assistant. " * 20
+    first = asyncio.run(
+        router.route_request(eps, {}, stats, FakeRequest(), {"prompt": prompt})
+    )
+    # same long prefix + extra suffix must hit the same endpoint
+    for suffix in ("tell me a joke", "what is 2+2", "summarize this"):
+        got = asyncio.run(
+            router.route_request(
+                eps, {}, stats, FakeRequest(), {"prompt": prompt + suffix}
+            )
+        )
+        assert got == first
+
+
+def test_hashtrie_longest_match():
+    trie = HashTrie(chunk_size=4)
+
+    async def run():
+        await trie.insert("abcdefgh", "e1")
+        await trie.insert("abcdxxxx", "e2")
+        n, eps = await trie.longest_prefix_match("abcdefgh", {"e1", "e2"})
+        assert n == 8 and eps == {"e1"}
+        n, eps = await trie.longest_prefix_match("abcdzzzz", {"e1", "e2"})
+        assert n == 4 and eps == {"e1", "e2"}
+        n, eps = await trie.longest_prefix_match("zzzz", {"e1", "e2"})
+        assert eps == {"e1", "e2"}  # fallback to available
+
+    asyncio.run(run())
+
+
+def test_engine_stats_parser():
+    text = """# HELP vllm:num_requests_running x
+vllm:num_requests_running{model_name="m"} 3
+vllm:num_requests_waiting{model_name="m"} 7
+vllm:gpu_cache_usage_perc{model_name="m"} 0.5
+vllm:gpu_prefix_cache_hits_total{model_name="m"} 30
+vllm:gpu_prefix_cache_queries_total{model_name="m"} 60
+"""
+    s = EngineStats.from_scrape(text)
+    assert s.num_running_requests == 3
+    assert s.num_queuing_requests == 7
+    assert s.gpu_cache_usage_perc == 0.5
+    assert s.gpu_prefix_cache_hit_rate == 0.5  # derived from counters
+
+
+def test_request_stats_lifecycle():
+    SingletonMeta._instances.pop(RequestStatsMonitor, None)
+    mon = RequestStatsMonitor(sliding_window=10.0)
+    t0 = time.monotonic()
+    mon.on_new_request("http://e", "r1", t0)
+    stats = mon.get_request_stats(t0 + 0.1)
+    assert stats["http://e"].in_prefill_requests == 1
+    mon.on_request_response("http://e", "r1", t0 + 0.5)
+    stats = mon.get_request_stats(t0 + 0.6)
+    assert stats["http://e"].in_prefill_requests == 0
+    assert stats["http://e"].in_decoding_requests == 1
+    assert abs(stats["http://e"].ttft - 0.5) < 1e-6
+    mon.on_token("http://e", "r1", t0 + 0.6)
+    mon.on_token("http://e", "r1", t0 + 0.7)
+    mon.on_request_complete("http://e", "r1", t0 + 1.0)
+    stats = mon.get_request_stats(t0 + 1.1)
+    assert stats["http://e"].finished_requests == 1
+    assert stats["http://e"].in_decoding_requests == 0
+    assert abs(stats["http://e"].avg_latency - 1.0) < 1e-6
+    assert stats["http://e"].avg_itl > 0
+
+
+def test_parser_validation():
+    with pytest.raises(ValueError):
+        parse_args(["--service-discovery", "static"])  # missing backends
+    with pytest.raises(ValueError):
+        parse_args(
+            ["--static-backends", "http://a,http://b", "--static-models", "m1"]
+        )  # length mismatch
+    with pytest.raises(ValueError):
+        parse_args(
+            ["--static-backends", "http://a", "--static-models", "m",
+             "--routing-logic", "session"]
+        )  # missing session key
+    args = parse_args(
+        ["--static-backends", "http://a", "--static-models", "m",
+         "--routing-logic", "roundrobin", "--port", "1234"]
+    )
+    assert args.port == 1234
+
+
+def test_parser_config_seeding(tmp_path):
+    cfg = tmp_path / "c.json"
+    cfg.write_text('{"port": 7777, "static_backends": "http://a", "static_models": "m"}')
+    args = parse_args(["--config", str(cfg)])
+    assert args.port == 7777
+    args = parse_args(["--config", str(cfg), "--port", "8888"])
+    assert args.port == 8888  # CLI wins
+
+
+def test_feature_gates():
+    g = FeatureGates("SemanticCache=true,PIIDetection=false")
+    assert g.is_enabled("SemanticCache")
+    assert not g.is_enabled("PIIDetection")
+    with pytest.raises(ValueError):
+        FeatureGates("Bogus=true")
+
+
+def test_pii_detection_and_redaction():
+    text = "email me at alice@example.com or call +1 (555) 123-4567, ssn 123-45-6789"
+    kinds = {m.kind for m in check_pii_content(text)}
+    assert {"EMAIL", "SSN"} <= kinds
+    red = redact(text)
+    assert "alice@example.com" not in red
+    assert "[EMAIL]" in red and "[SSN]" in red
+
+
+def test_singleton_meta():
+    class Foo(metaclass=SingletonMeta):
+        pass
+
+    assert Foo() is Foo()
+    SingletonMeta._instances.pop(Foo, None)
