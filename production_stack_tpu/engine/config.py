@@ -36,9 +36,12 @@ class EngineConfig:
     # KV offload (LMCache-equivalent) wiring
     kv_offload_cpu_gb: float = 0.0
     kv_offload_dir: Optional[str] = None
+    kv_offload_disk_gb: float = 16.0
     kv_remote_url: Optional[str] = None
+    kv_serde: str = "naive"            # naive | int8 (kvoffload/serde.py)
     kv_controller_url: Optional[str] = None
     kv_instance_id: Optional[str] = None
+    advertise_host: Optional[str] = None  # URL other pods reach this engine at
     # disaggregated prefill role: none | producer | consumer
     kv_role: str = "none"
     kv_transfer_port: int = 55555

@@ -62,7 +62,8 @@ class LLMEngine:
             model_cfg, mesh=mesh, params=params,
             num_pages=num_pages, page_size=cfg.page_size, seed=cfg.seed,
         )
-        self.kv = KVPageManager(num_pages, cfg.page_size)
+        self._offload = self._make_offload_connector(cfg)
+        self.kv = KVPageManager(num_pages, cfg.page_size, offload=self._offload)
         self.scheduler = Scheduler(
             self.kv,
             max_num_seqs=cfg.max_num_seqs,
@@ -85,6 +86,44 @@ class LLMEngine:
         self.total_generation_tokens = 0
         self.num_preemptions = 0
 
+    def _make_offload_connector(self, cfg: EngineConfig):
+        """Build the LMCache-equivalent offload connector when any tier or the
+        KV-index controller is configured (SURVEY.md §7 step 5)."""
+        if not (
+            cfg.kv_offload_cpu_gb > 0
+            or cfg.kv_offload_dir
+            or cfg.kv_remote_url
+            or cfg.kv_controller_url
+        ):
+            return None
+        from production_stack_tpu.kvoffload.connector import KVOffloadConnector
+
+        host = cfg.advertise_host or cfg.host
+        if cfg.kv_controller_url and host in ("0.0.0.0", "::", ""):
+            # the controller hands this URL to the router for kvaware routing;
+            # a wildcard bind address would never match a discovered endpoint
+            import socket
+
+            try:
+                host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                host = "127.0.0.1"
+            logger.warning(
+                "--advertise-host not set; registering with KV controller as "
+                "%s (set it to the pod IP for kvaware routing)", host,
+            )
+        return KVOffloadConnector(
+            self.runner,
+            cpu_bytes=int(cfg.kv_offload_cpu_gb * 1e9),
+            disk_path=cfg.kv_offload_dir,
+            disk_bytes=int(cfg.kv_offload_disk_gb * 1e9) if cfg.kv_offload_dir else 0,
+            remote_url=cfg.kv_remote_url,
+            serde=cfg.kv_serde,
+            controller_url=cfg.kv_controller_url,
+            instance_id=cfg.kv_instance_id or f"{cfg.name}-{cfg.port}",
+            engine_url=f"http://{host}:{cfg.port}",
+        )
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
@@ -96,6 +135,8 @@ class LLMEngine:
         self._inbox.put(None)
         if self._thread:
             self._thread.join(timeout=10)
+        if self._offload is not None:
+            self._offload.stop()
 
     # -- request api (asyncio side) -----------------------------------------
 
@@ -269,7 +310,9 @@ class LLMEngine:
             )
             self._saved_params = None
         self.runner.reset_kv()
-        self.kv = KVPageManager(self.kv.num_pages, self.kv.page_size)
+        self.kv = KVPageManager(
+            self.kv.num_pages, self.kv.page_size, offload=self._offload
+        )
         self.scheduler.kv = self.kv
         self._sleeping = False
 
@@ -280,7 +323,7 @@ class LLMEngine:
     # -- stats --------------------------------------------------------------
 
     def stats(self) -> dict:
-        return {
+        out = {
             "num_requests_running": self.scheduler.num_running(),
             "num_requests_waiting": self.scheduler.num_waiting(),
             "gpu_cache_usage_perc": self.kv.usage(),
@@ -290,3 +333,11 @@ class LLMEngine:
             "prompt_tokens_total": self.total_prompt_tokens,
             "generation_tokens_total": self.total_generation_tokens,
         }
+        if self._offload is not None:
+            o = self._offload.stats()
+            out["kv_offload_hit_pages_total"] = self.kv.offload_hits
+            out["kv_offload_saved_pages_total"] = o["saved_pages"]
+            out["kv_offload_loaded_pages_total"] = o["loaded_pages"]
+            out["kv_offload_cpu_bytes"] = o["cpu_bytes"]
+            out["kv_offload_disk_bytes"] = o["disk_bytes"]
+        return out

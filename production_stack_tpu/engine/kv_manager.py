@@ -50,7 +50,7 @@ class KVPageManager:
       and are evicted only when a fresh allocation needs them.
     """
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int, offload=None):
         self.num_pages = num_pages
         self.page_size = page_size
         self.pages = [PageInfo() for _ in range(num_pages)]
@@ -60,6 +60,10 @@ class KVPageManager:
         self.evictable: OrderedDict[int, None] = OrderedDict()
         self.prefix_queries = 0
         self.prefix_hits = 0  # counted in pages
+        self.offload_hits = 0  # pages restored from the offload tiers
+        # KVOffloadConnector (kvoffload/connector.py): spill evicted pages to
+        # host DRAM/disk/remote and restore them on later prefix matches
+        self.offload = offload
 
     # -- allocation ---------------------------------------------------------
 
@@ -80,6 +84,8 @@ class KVPageManager:
                 pid, _ = self.evictable.popitem(last=False)
                 info = self.pages[pid]
                 if info.hash is not None:
+                    if self.offload is not None:  # spill KV before slot reuse
+                        self.offload.save_page(pid, info.hash)
                     self.hash_to_page.pop(info.hash, None)
                     info.hash = None
             self.pages[pid].ref_count = 1
@@ -117,6 +123,34 @@ class KVPageManager:
                 self.evictable.pop(pid, None)
             info.ref_count += 1
             shared.append(pid)
+        if self.offload is not None:
+            # extend the match from the offload tiers: restore chunk-by-chunk
+            # into freshly allocated pages until the chain misses
+            for h in hashes[len(shared):]:
+                pid = self.hash_to_page.get(h)
+                if pid is not None:
+                    # chunk re-appeared in HBM further along the chain (e.g.
+                    # registered by a later request) — share it, don't restore
+                    info = self.pages[pid]
+                    if info.ref_count == 0:
+                        self.evictable.pop(pid, None)
+                    info.ref_count += 1
+                    shared.append(pid)
+                    continue
+                if not self.offload.has(h):
+                    break
+                got = self.allocate(1)
+                if got is None:
+                    break
+                pid = got[0]
+                if not self.offload.load_page(pid, h):
+                    self.free([pid])  # blob vanished between has() and get()
+                    break
+                info = self.pages[pid]
+                info.hash = h
+                self.hash_to_page[h] = pid
+                shared.append(pid)
+                self.offload_hits += 1
         self.prefix_hits += len(shared)
         return shared, len(shared) * self.page_size
 
@@ -124,11 +158,15 @@ class KVPageManager:
         """Record hashes for fully-written pages of a sequence so later
         requests can share them. Called after prefill completes."""
         hashes = prefix_hashes(tokens, self.page_size)
+        new: list[bytes] = []
         for h, pid in zip(hashes, page_ids):
             info = self.pages[pid]
             if info.hash is None and h not in self.hash_to_page:
                 info.hash = h
                 self.hash_to_page[h] = pid
+                new.append(h)
+        if self.offload is not None and new:
+            self.offload.report_admit(new)  # global KV index (kvaware routing)
 
     def hit_rate(self) -> float:
         return self.prefix_hits / self.prefix_queries if self.prefix_queries else 0.0

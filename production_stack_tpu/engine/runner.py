@@ -73,6 +73,7 @@ class ModelRunner:
             functools.partial(_step_fn, cfg),
             donate_argnums=(1, 2),
         )
+        self._set_page_fn = None  # built lazily in set_page
 
     def step(self, inp: StepInput) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Run one forward+sample step. Returns (token_ids [B], logits [B, V])."""
@@ -93,6 +94,24 @@ class ModelRunner:
             key,
         )
         return ids, logits
+
+    def get_page(self, pid: int):
+        """Fetch one page's K/V to host ([L, page_size, KH, D] each)."""
+        return jax.device_get((self.k_pages[:, pid], self.v_pages[:, pid]))
+
+    def set_page(self, pid: int, k, v) -> None:
+        """Write one page's K/V into the pools in place (offload restore /
+        disaggregated-prefill KV injection)."""
+        if self._set_page_fn is None:
+            self._set_page_fn = jax.jit(
+                lambda kp, vp, i, k, v: (kp.at[:, i].set(k), vp.at[:, i].set(v)),
+                donate_argnums=(0, 1),
+            )
+        dt = self.k_pages.dtype
+        self.k_pages, self.v_pages = self._set_page_fn(
+            self.k_pages, self.v_pages, jnp.int32(pid),
+            jnp.asarray(k, dt), jnp.asarray(v, dt),
+        )
 
     def reset_kv(self) -> None:
         """Zero the page pools (sleep/wake support frees and re-creates them)."""

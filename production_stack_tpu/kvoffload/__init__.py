@@ -1,0 +1,27 @@
+"""Tiered KV-cache offload + global KV index (the stack's LMCache equivalent).
+
+Components (SURVEY.md §7 step 5):
+- serde: KV chunk (de)serialization (naive / int8).
+- tiers: host-DRAM -> disk -> remote blob store per engine.
+- cache_server: shared remote KV tier (standalone TCP server).
+- controller: global KV-index service + clients (kvaware routing).
+- connector: engine-side integration with the device page pools.
+"""
+
+from production_stack_tpu.kvoffload.connector import KVOffloadConnector
+from production_stack_tpu.kvoffload.controller import (
+    ControllerClient,
+    KVIndexController,
+    WorkerClient,
+)
+from production_stack_tpu.kvoffload.serde import get_serde
+from production_stack_tpu.kvoffload.tiers import TieredKVStore
+
+__all__ = [
+    "KVOffloadConnector",
+    "ControllerClient",
+    "KVIndexController",
+    "WorkerClient",
+    "get_serde",
+    "TieredKVStore",
+]

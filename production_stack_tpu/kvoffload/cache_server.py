@@ -1,0 +1,132 @@
+"""Standalone remote KV cache server — shared DRAM tier across engine pods.
+
+TPU-native replacement for the reference's `lmcache_experimental_server`
+deployment (/root/reference helm/templates/deployment-cache-server.yaml:33-74;
+engines point at it via `LMCACHE_REMOTE_URL`,
+deployment-vllm-multi.yaml:309-314). Speaks the frame protocol in
+kvoffload/protocol.py; blobs are opaque serde bytes, so one server serves
+engines using any serde.
+
+Run: ``python -m production_stack_tpu.kvoffload.cache_server --port 8200``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+from collections import OrderedDict
+from typing import Optional
+
+from production_stack_tpu.kvoffload.protocol import read_frame, write_frame
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class CacheServer:
+    def __init__(self, max_bytes: int = 4 << 30):
+        self.max_bytes = max_bytes
+        self._data: OrderedDict[str, bytes] = OrderedDict()
+        self.used_bytes = 0
+        self.gets = 0
+        self.hits = 0
+        self.puts = 0
+
+    # -- storage --------------------------------------------------------------
+
+    def put(self, key: str, blob: bytes) -> None:
+        self.puts += 1
+        old = self._data.pop(key, None)
+        if old is not None:
+            self.used_bytes -= len(old)
+        self._data[key] = blob
+        self.used_bytes += len(blob)
+        while self.used_bytes > self.max_bytes and self._data:
+            _, b = self._data.popitem(last=False)
+            self.used_bytes -= len(b)
+
+    def get(self, key: str) -> Optional[bytes]:
+        self.gets += 1
+        blob = self._data.get(key)
+        if blob is not None:
+            self.hits += 1
+            self._data.move_to_end(key)
+        return blob
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._data),
+            "used_bytes": self.used_bytes,
+            "max_bytes": self.max_bytes,
+            "gets": self.gets,
+            "hits": self.hits,
+            "puts": self.puts,
+        }
+
+    # -- protocol -------------------------------------------------------------
+
+    async def handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    hdr, payload = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                op = hdr.get("op")
+                if op == "put":
+                    self.put(hdr["key"], payload)
+                    await write_frame(writer, {"ok": True})
+                elif op == "get":
+                    blob = self.get(hdr["key"])
+                    await write_frame(
+                        writer, {"ok": True, "found": blob is not None}, blob or b""
+                    )
+                elif op == "exists":
+                    await write_frame(
+                        writer, {"ok": True, "found": hdr["key"] in self._data}
+                    )
+                elif op == "delete":
+                    blob = self._data.pop(hdr["key"], None)
+                    if blob is not None:
+                        self.used_bytes -= len(blob)
+                    await write_frame(writer, {"ok": True, "found": blob is not None})
+                elif op == "stats":
+                    await write_frame(writer, {"ok": True, **self.stats()})
+                elif op == "ping":
+                    await write_frame(writer, {"ok": True})
+                else:
+                    await write_frame(writer, {"ok": False, "error": f"bad op {op!r}"})
+        except Exception as e:  # keep the server alive across bad clients
+            logger.warning("cache server: client %s error: %s", peer, e)
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+
+async def serve(host: str, port: int, max_bytes: int) -> asyncio.AbstractServer:
+    cs = CacheServer(max_bytes)
+    server = await asyncio.start_server(cs.handle, host, port)
+    logger.info("kv cache server on %s:%d (%.1f GB)", host, port, max_bytes / 1e9)
+    return server
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="TPU-stack remote KV cache server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--max-bytes", type=int, default=4 << 30)
+    args = p.parse_args()
+
+    async def run():
+        server = await serve(args.host, args.port, args.max_bytes)
+        async with server:
+            await server.serve_forever()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()
