@@ -115,14 +115,8 @@ class EngineServer:
         emit("gpu_prefix_cache_queries_total", "counter", s["gpu_prefix_cache_queries_total"])
         emit("prompt_tokens_total", "counter", s["prompt_tokens_total"])
         emit("generation_tokens_total", "counter", s["generation_tokens_total"])
-        for k in (
-            "kv_offload_hit_pages_total",
-            "kv_offload_saved_pages_total",
-            "kv_offload_loaded_pages_total",
-            "kv_offload_cpu_bytes",
-            "kv_offload_disk_bytes",
-        ):
-            if k in s:
+        for k in sorted(s):  # kv offload / transfer metrics, present when wired
+            if k.startswith("kv_"):
                 kind = "counter" if k.endswith("_total") else "gauge"
                 emit(k, kind, s[k])
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")

@@ -64,6 +64,23 @@ class LLMEngine:
         )
         self._offload = self._make_offload_connector(cfg)
         self.kv = KVPageManager(num_pages, cfg.page_size, offload=self._offload)
+        # disaggregated prefill (SURVEY.md §2.3): producer pushes finished
+        # prefill KV to the decode peer; consumer receives into its store
+        self._kv_sender = None
+        self._kv_receiver = None
+        if cfg.kv_role == "producer":
+            if not cfg.kv_peer_url:
+                raise ValueError("kv_role=producer requires --kv-peer-url")
+            from production_stack_tpu.kvoffload.transfer import KVTransferSender
+
+            self._kv_sender = KVTransferSender(cfg.kv_peer_url)
+        elif cfg.kv_role == "consumer":
+            from production_stack_tpu.kvoffload.transfer import KVTransferReceiver
+
+            self._kv_receiver = KVTransferReceiver(
+                self._offload.store, host=cfg.host, port=cfg.kv_transfer_port
+            )
+            self._kv_receiver.start()
         self.scheduler = Scheduler(
             self.kv,
             max_num_seqs=cfg.max_num_seqs,
@@ -88,7 +105,11 @@ class LLMEngine:
 
     def _make_offload_connector(self, cfg: EngineConfig):
         """Build the LMCache-equivalent offload connector when any tier or the
-        KV-index controller is configured (SURVEY.md §7 step 5)."""
+        KV-index controller is configured (SURVEY.md §7 step 5). A
+        disaggregated-prefill consumer always gets a CPU tier — received KV
+        lands there before admission restores it into HBM."""
+        if cfg.kv_role == "consumer" and cfg.kv_offload_cpu_gb <= 0:
+            cfg = dataclasses.replace(cfg, kv_offload_cpu_gb=2.0)
         if not (
             cfg.kv_offload_cpu_gb > 0
             or cfg.kv_offload_dir
@@ -137,6 +158,10 @@ class LLMEngine:
             self._thread.join(timeout=10)
         if self._offload is not None:
             self._offload.stop()
+        if self._kv_sender is not None:
+            self._kv_sender.close()
+        if self._kv_receiver is not None:
+            self._kv_receiver.stop()
 
     # -- request api (asyncio side) -----------------------------------------
 
@@ -235,10 +260,47 @@ class LLMEngine:
             if batch.kind == "prefill":
                 for s, c in zip(batch.seqs, batch.chunk_sizes):
                     self.total_prompt_tokens += c
+            if self._kv_sender is not None:
+                # ship KV before emitting the finish event: the prefill HTTP
+                # response must not return until the decode peer holds the KV
+                for s, _ in events:
+                    if s.finished:
+                        self._push_finished_kv(s)
             for s, tok in events:
                 self.total_generation_tokens += 1
                 self._process_token(s)
         logger.info("engine loop exited")
+
+    def _push_finished_kv(self, seq: Sequence) -> None:
+        """Producer role: push every hashed page of a finished sequence to the
+        decode peer. Runs on the device thread right after scheduler._finish
+        registered the pages, so their pids are still valid (nothing else has
+        allocated since)."""
+        from production_stack_tpu.engine.kv_manager import prefix_hashes
+
+        tokens = seq.prompt_ids + seq.output_ids
+        for h in prefix_hashes(tokens, self.kv.page_size):
+            pid = self.kv.hash_to_page.get(h)
+            if pid is None:
+                continue
+            key = h.hex()
+            blob = None
+            if self._offload is not None:
+                blob = self._offload.store.get(key)
+            if blob is None:
+                k, v = self.runner.get_page(pid)
+                serde = (
+                    self._offload.serde
+                    if self._offload is not None
+                    else self._default_serde()
+                )
+                blob = serde.serialize(np.asarray(k), np.asarray(v))
+            self._kv_sender.push(key, blob)
+
+    def _default_serde(self):
+        from production_stack_tpu.kvoffload.serde import get_serde
+
+        return get_serde(self.cfg.kv_serde)
 
     def _process_token(self, seq: Sequence) -> None:
         """Detokenize incrementally, check stop strings, emit the delta."""
@@ -333,6 +395,12 @@ class LLMEngine:
             "prompt_tokens_total": self.total_prompt_tokens,
             "generation_tokens_total": self.total_generation_tokens,
         }
+        if self._kv_sender is not None:
+            out["kv_transfer_sent_chunks_total"] = self._kv_sender.sent_chunks
+            out["kv_transfer_sent_bytes_total"] = self._kv_sender.sent_bytes
+        if self._kv_receiver is not None:
+            out["kv_transfer_received_chunks_total"] = self._kv_receiver.received_chunks
+            out["kv_transfer_received_bytes_total"] = self._kv_receiver.received_bytes
         if self._offload is not None:
             o = self._offload.stats()
             out["kv_offload_hit_pages_total"] = self.kv.offload_hits

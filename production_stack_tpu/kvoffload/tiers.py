@@ -220,13 +220,18 @@ class TieredKVStore:
         if self.on_local_drop is not None and not self.contains_local(key):
             self.on_local_drop(key)
 
-    def put(self, key: str, blob: bytes) -> None:
+    def put_local(self, key: str, blob: bytes) -> None:
+        """Insert into the local tiers only (no remote write-through) — used
+        for chunks *received* from a peer, which already live remotely."""
         with self._lock:
             if self.cpu is not None:
                 self._spill(self.cpu.put(key, blob))
             elif self.disk is not None:
                 for dropped in self.disk.put(key, blob):
                     self._dropped_locally(dropped)
+
+    def put(self, key: str, blob: bytes) -> None:
+        self.put_local(key, blob)
         if self.remote is not None:
             self.remote.put(key, blob)
 

@@ -1,0 +1,138 @@
+"""Disaggregated-prefill KV transfer: prefill (producer) -> decode (consumer).
+
+TPU-native replacement for the reference's NIXL/UCX sender/receiver pair
+(/root/reference helm/templates/deployment-vllm-multi.yaml:256-296:
+`LMCACHE_ENABLE_NIXL`, `LMCACHE_NIXL_ROLE=sender/receiver`, receiver port
+55555; examples/disaggregated_prefill/pd.yaml:22-65). No GPU-direct fabric on
+TPU pods — KV pages ship as serde blobs over TCP (DCN between pods; loopback
+within one) keyed by the same rolling chunk hashes the prefix cache uses, so
+the decode engine's ordinary offload-restore path injects them into HBM.
+
+Flow (two engines + router request_service.route_disaggregated_prefill_request):
+1. Router sends the prompt to the prefill engine with max_tokens=1.
+2. Producer engine, at sequence finish and *before* answering the prefill
+   HTTP request, pushes each full page's blob to the consumer's receiver —
+   so the KV is already there when the router's phase-2 decode request lands.
+3. Consumer's receiver drops blobs into its offload store; decode admission
+   restores them via KVPageManager.match_prefix (offload extension path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+from production_stack_tpu.kvoffload.protocol import (
+    BlockingClient,
+    parse_hostport,
+    read_frame,
+    write_frame,
+)
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+class KVTransferReceiver:
+    """TCP server inside the decode (consumer) engine process; pushes land in
+    the engine's tiered store where prefix-match admission finds them."""
+
+    def __init__(self, store, host: str = "0.0.0.0", port: int = 55555):
+        self.store = store
+        self.host, self.port = host, port
+        self.received_chunks = 0
+        self.received_bytes = 0
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self.bound_port: Optional[int] = None
+
+    async def _handle(self, reader, writer):
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                try:
+                    hdr, payload = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                op = hdr.get("op")
+                if op == "push":
+                    self.store.put_local(hdr["key"], payload)
+                    self.received_chunks += 1
+                    self.received_bytes += len(payload)
+                    await write_frame(writer, {"ok": True})
+                elif op == "ping":
+                    await write_frame(writer, {"ok": True})
+                else:
+                    await write_frame(writer, {"ok": False, "error": f"bad op {op!r}"})
+        except Exception as e:
+            logger.warning("kv receiver: client %s error: %s", peer, e)
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def start(self) -> None:
+        def run():
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+
+            async def serve():
+                server = await asyncio.start_server(self._handle, self.host, self.port)
+                self.bound_port = server.sockets[0].getsockname()[1]
+                self._started.set()
+                async with server:
+                    await server.serve_forever()
+
+            try:
+                self._loop.run_until_complete(serve())
+            except asyncio.CancelledError:
+                pass
+
+        self._thread = threading.Thread(target=run, daemon=True, name="kv-receiver")
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("KV transfer receiver failed to start")
+        logger.info("kv transfer receiver on %s:%s", self.host, self.bound_port)
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(
+                lambda: [t.cancel() for t in asyncio.all_tasks(self._loop)]
+            )
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class KVTransferSender:
+    """Producer-side pusher. Called on the engine device thread at sequence
+    finish — synchronous by design: the prefill HTTP response must not return
+    before the decode peer holds the KV (the reference gets the same ordering
+    from the NIXL blocking handshake)."""
+
+    def __init__(self, peer_url: str, timeout: float = 30.0):
+        host, port = parse_hostport(peer_url, default_port=55555)
+        self._client = BlockingClient(host, port, timeout=timeout)
+        self._lock = threading.Lock()
+        self.sent_chunks = 0
+        self.sent_bytes = 0
+        self.errors = 0
+
+    def push(self, key: str, blob: bytes) -> bool:
+        with self._lock:
+            try:
+                hdr, _ = self._client.request({"op": "push", "key": key}, blob)
+                if hdr.get("ok"):
+                    self.sent_chunks += 1
+                    self.sent_bytes += len(blob)
+                    return True
+                return False
+            except Exception as e:
+                self.errors += 1
+                logger.warning("kv transfer push failed: %s", e)
+                return False
+
+    def close(self) -> None:
+        self._client.close()
