@@ -56,6 +56,16 @@ class ModelRunner:
         self.page_size = page_size
         self.num_pages = num_pages
         self.mesh = mesh if mesh is not None else make_mesh()
+        if cfg.attn_impl == "auto":
+            # pallas decode kernel: single-shard meshes on real TPU only (the
+            # XLA gather path partitions under GSPMD; the kernel does not yet)
+            use_pallas = (
+                jax.default_backend() == "tpu" and self.mesh.devices.size == 1
+            )
+            cfg = dataclasses.replace(
+                cfg, attn_impl="pallas" if use_pallas else "xla"
+            )
+            self.cfg = cfg
 
         if params is None:
             params = llama.init_params(cfg, jax.random.key(seed))

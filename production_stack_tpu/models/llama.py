@@ -46,6 +46,11 @@ class LlamaConfig:
     max_model_len: int = 8192
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16
+    # decode attention implementation: "auto" (ModelRunner resolves), "xla"
+    # (gather + flash, partitions under GSPMD), "pallas" (page-streaming
+    # kernel, single-shard meshes), "pallas_interpret" (tests on CPU).
+    # "auto" outside a runner falls back to the XLA path.
+    attn_impl: str = "auto"
 
     @staticmethod
     def from_hf_config(cfg: dict) -> "LlamaConfig":
@@ -181,8 +186,19 @@ def forward(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         kp, vp = write_kv_pages(kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions)
-        kc, vc = gather_kv_pages(kp, vp, page_table)
-        attn = flash_attention(q, kc, vc, q_positions=positions, kv_lens=kv_lens)
+        if T == 1 and cfg.attn_impl.startswith("pallas"):
+            # decode: stream pages HBM->VMEM, no gather materialization
+            from production_stack_tpu.ops.pallas.paged_attention import (
+                ragged_paged_attention_decode,
+            )
+
+            attn = ragged_paged_attention_decode(
+                q[:, 0], kp, vp, page_table, kv_lens,
+                interpret=cfg.attn_impl == "pallas_interpret",
+            )[:, None]
+        else:
+            kc, vc = gather_kv_pages(kp, vp, page_table)
+            attn = flash_attention(q, kc, vc, q_positions=positions, kv_lens=kv_lens)
         x = x + attn.reshape(B, T, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]

@@ -1,0 +1,153 @@
+"""Pallas TPU kernel: ragged paged attention for the decode step.
+
+Why a kernel (SURVEY.md §7 hard part #1): the XLA reference path
+(ops/attention.py paged_attention_decode) gathers each sequence's pages into a
+contiguous [B, S, KH, D] tensor in HBM *before* attending — that copy is pure
+HBM-bandwidth waste in the bandwidth-bound decode regime. This kernel instead
+streams each page HBM->VMEM exactly once, using the page table as a
+scalar-prefetch argument so the block index map can chase page indirection,
+and Pallas's grid pipeline double-buffers the page fetches behind the online-
+softmax compute.
+
+Layout: grid = (B, max_pages); for each sequence the page axis is innermost,
+so the (m, l, acc) VMEM scratch persists across that sequence's pages (same
+output block revisited) — the classic flash-decode accumulation. Query/kv
+heads stay packed [KH, G, D] so all heads of a page are one batched MXU call.
+
+Equivalent role in the reference: vLLM's CUDA PagedAttention decode kernel
+(executed inside the engine image; configured by
+helm/templates/deployment-vllm-multi.yaml in /root/reference).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    pt_ref,      # [B, max_pages] int32 page table
+    lens_ref,    # [B] int32 kv lengths
+    # blocks
+    q_ref,       # [1, NH, D]
+    k_ref,       # [1, page_size, KH, D]
+    v_ref,       # [1, page_size, KH, D]
+    o_ref,       # [1, NH, D]
+    # scratch (persist across the page axis of one sequence)
+    m_ref,       # [KH, G] f32
+    l_ref,       # [KH, G] f32
+    acc_ref,     # [KH, G, D] f32
+    *,
+    sm_scale: float,
+    kv_heads: int,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    page_size = k_ref.shape[1]
+    NH, D = q_ref.shape[1], q_ref.shape[2]
+    KH = kv_heads
+    G = NH // KH
+
+    @pl.when(p == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kv_len = lens_ref[b]
+    start = p * page_size
+
+    @pl.when(start < kv_len)
+    def _():
+        q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
+        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [KH, page, D]
+        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+        # batched over KH: [KH, G, D] x [KH, page, D] -> [KH, G, page]
+        scores = lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )
+        idx = start + lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
+        scores = jnp.where(idx < kv_len, scores, NEG_INF)
+
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1))
+        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+        pij = jnp.exp(scores - m_new[..., None])
+        pij = jnp.where(idx < kv_len, pij, 0.0)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + pij.sum(axis=-1)
+        # [KH, G, page] x [KH, page, D] -> [KH, G, D]
+        pv = lax.dot_general(
+            pij, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+        )
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)[..., None]
+        o_ref[0] = out.reshape(NH, D).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def ragged_paged_attention_decode(
+    q: jnp.ndarray,          # [B, NH, D]
+    k_pages: jnp.ndarray,    # [P, page_size, KH, D]
+    v_pages: jnp.ndarray,    # [P, page_size, KH, D]
+    page_table: jnp.ndarray, # [B, max_pages] int32
+    seq_lens: jnp.ndarray,   # [B] int32
+    *,
+    sm_scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Decode attention over paged KV, streaming pages HBM->VMEM.
+
+    Returns [B, NH, D] in q.dtype. Matches ops/attention.paged_attention_decode
+    (the XLA oracle) — tests assert equivalence.
+    """
+    B, NH, D = q.shape
+    _, page_size, KH, _ = k_pages.shape
+    max_pages = page_table.shape[1]
+    G = NH // KH
+    scale = sm_scale if sm_scale is not None else D**-0.5
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, NH, D), lambda b, p, pt, lens: (b, 0, 0)),
+            pl.BlockSpec(
+                (1, page_size, KH, D), lambda b, p, pt, lens: (pt[b, p], 0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, page_size, KH, D), lambda b, p, pt, lens: (pt[b, p], 0, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, NH, D), lambda b, p, pt, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KH, G), jnp.float32),
+            pltpu.VMEM((KH, G), jnp.float32),
+            pltpu.VMEM((KH, G, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, sm_scale=scale, kv_heads=KH)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, NH, D), q.dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * NH * D * max_pages * page_size,
+            bytes_accessed=(
+                2 * max_pages * page_size * KH * D * 2 * B + B * NH * D * 4
+            ),
+            transcendentals=B * NH * max_pages * page_size,
+        ),
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), q, k_pages, v_pages)

@@ -1,0 +1,103 @@
+"""Pallas ragged paged-attention decode kernel vs the XLA oracle
+(ops/attention.paged_attention_decode), and end-to-end through the engine."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from production_stack_tpu.ops.attention import paged_attention_decode
+from production_stack_tpu.ops.pallas.paged_attention import ragged_paged_attention_decode
+
+
+def _case(B=4, NH=8, KH=2, D=128, page=16, P=32, maxp=4, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, NH, D), dtype)
+    kp = jnp.asarray(rng.randn(P, page, KH, D), dtype)
+    vp = jnp.asarray(rng.randn(P, page, KH, D), dtype)
+    pt = jnp.asarray(
+        rng.choice(P, (B * maxp), replace=False).reshape(B, maxp), jnp.int32
+    )
+    return q, kp, vp, pt
+
+
+class TestKernelVsOracle:
+    def test_ragged_lengths(self):
+        q, kp, vp, pt = _case()
+        lens = jnp.asarray([5, 16, 33, 64], jnp.int32)
+        ref = paged_attention_decode(q, kp, vp, pt, lens)
+        out = ragged_paged_attention_decode(q, kp, vp, pt, lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_gqa_groups_and_odd_dims(self):
+        q, kp, vp, pt = _case(B=3, NH=12, KH=4, D=64, page=8, P=24, maxp=6, seed=1)
+        lens = jnp.asarray([1, 24, 48], jnp.int32)
+        ref = paged_attention_decode(q, kp, vp, pt, lens)
+        out = ragged_paged_attention_decode(q, kp, vp, pt, lens, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_padded_batch_row(self):
+        """kv_len=0 rows (scheduler padding) must produce zeros, not NaN."""
+        q, kp, vp, pt = _case(B=2, NH=4, KH=2, D=32, page=8, P=8, maxp=2, seed=2)
+        lens = jnp.asarray([10, 0], jnp.int32)
+        out = ragged_paged_attention_decode(q, kp, vp, pt, lens, interpret=True)
+        assert not np.any(np.isnan(np.asarray(out)))
+        np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
+
+    def test_bf16_inputs(self):
+        q, kp, vp, pt = _case(dtype=jnp.bfloat16, seed=3)
+        lens = jnp.asarray([7, 16, 40, 64], jnp.int32)
+        ref = paged_attention_decode(q, kp, vp, pt, lens)
+        out = ragged_paged_attention_decode(q, kp, vp, pt, lens, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2, rtol=3e-2
+        )
+
+
+class TestEngineWithPallasDecode:
+    def test_greedy_matches_xla_engine(self):
+        """Same engine, pallas_interpret vs xla decode attention — greedy
+        outputs must be identical token-for-token."""
+        import asyncio
+
+        from production_stack_tpu.engine.config import EngineConfig
+        from production_stack_tpu.engine.engine import LLMEngine
+        from production_stack_tpu.engine.scheduler import SamplingParams
+
+        def run(attn_impl):
+            cfg = EngineConfig(
+                model="llama-debug", max_model_len=128, max_num_seqs=2,
+                num_pages=32, page_size=8, prefill_chunk=32,
+            )
+            eng = LLMEngine(cfg)
+            eng.runner.cfg = dataclasses.replace(eng.runner.cfg, attn_impl=attn_impl)
+            # rebuild the jitted step with the chosen attention impl
+            import functools
+
+            import jax as _jax
+
+            from production_stack_tpu.engine import runner as runner_mod
+
+            eng.runner._step = _jax.jit(
+                functools.partial(runner_mod._step_fn, eng.runner.cfg),
+                donate_argnums=(1, 2),
+            )
+            eng.start()
+            try:
+                async def go():
+                    toks = []
+                    async for out in eng.generate(
+                        "pk-1", prompt="hello pallas world",
+                        params=SamplingParams(
+                            max_tokens=6, temperature=0.0, ignore_eos=True
+                        ),
+                    ):
+                        toks.extend(out.token_ids)
+                    return toks
+
+                return asyncio.run(go())
+            finally:
+                eng.stop()
+
+        assert run("pallas_interpret") == run("xla")
