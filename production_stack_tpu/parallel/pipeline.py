@@ -1,0 +1,98 @@
+"""Pipeline parallelism over the ``pp`` mesh axis — multi-host stage execution
+without Ray.
+
+The reference runs pipeline parallelism by provisioning a KubeRay cluster and
+passing ``--pipeline-parallel-size`` to vLLM (/root/reference
+helm/templates/ray-cluster.yaml:515-566; tutorials/15-basic-pipeline-parallel.md).
+Here PP is a mesh axis: layers shard over ``pp`` (each device holds a
+contiguous stage of the layer stack), microbatches flow stage-to-stage via
+``lax.ppermute`` over ICI/DCN, and the whole schedule is one jitted SPMD
+program — JAX's multi-controller runtime replaces the Ray choreography
+(SURVEY.md §7 hard part #4).
+
+Schedule: GPipe-style fill-drain. With M microbatches and S stages the scan
+runs M + S - 1 ticks; device s is active on ticks [s, s + M). Bubble fraction
+(S-1)/(M+S-1) — callers pick M >= 4*S for serving prefill.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_local(
+    stage_fn: Callable,
+    stage_params,
+    microbatches: jnp.ndarray,  # [M, ...mb shape...] (replicated)
+    *,
+    axis_name: str = "pp",
+):
+    """Per-shard GPipe schedule — call inside shard_map over ``axis_name``.
+
+    ``stage_fn(stage_params, x) -> y`` runs this device's slice of the layer
+    stack; ``stage_params`` is the local stage's shard (layer axis already
+    split by shard_map). Returns the final-stage outputs, [M, ...] on every
+    device (psum-broadcast at the end).
+    """
+    S = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    T = M + S - 1
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    buf = jnp.zeros_like(microbatches[0])
+    outs = jnp.zeros_like(microbatches)
+
+    def tick(carry, t):
+        buf, outs = carry
+        mb_idx = jnp.clip(t - s, 0, M - 1)
+        active = (t >= s) & (t - s < M)
+        # stage 0 injects fresh microbatches; others consume the ppermuted buf
+        x_in = jnp.where(s == 0, microbatches[jnp.clip(t, 0, M - 1)], buf)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # last stage records its (active) output
+        outs = jnp.where(
+            active & (s == S - 1),
+            lax.dynamic_update_index_in_dim(outs, y, mb_idx, 0),
+            outs,
+        )
+        # ship activations to the next stage (last stage sends nothing)
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, outs), None
+
+    (_, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(T))
+    # broadcast final outputs from the last stage to every device
+    outs = lax.psum(jnp.where(s == S - 1, outs, jnp.zeros_like(outs)), axis_name)
+    return outs
+
+
+def pipeline_forward(
+    mesh: Mesh,
+    stage_fn: Callable,
+    params,                    # pytree; every leaf's leading axis = num layers
+    microbatches: jnp.ndarray, # [M, ...]
+    *,
+    axis_name: str = "pp",
+):
+    """Shard ``params``' layer axis over ``axis_name`` and run the pipeline.
+
+    ``stage_fn(stage_params, x)`` sees the local ``layers/S``-sized stack —
+    typically a ``lax.scan`` over its layers.
+    """
+    fn = functools.partial(pipeline_local, stage_fn, axis_name=axis_name)
+    pspec = jax.tree.map(lambda _: P(axis_name), params)
+    shard_fn = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return shard_fn(params, microbatches)
