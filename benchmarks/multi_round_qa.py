@@ -1,0 +1,297 @@
+"""Multi-round QA serving benchmark — the stack's headline workload.
+
+Parity with the reference's benchmark harness
+(/root/reference benchmarks/multi-round-qa/multi-round-qa.py:303-650):
+- ``UserSession``: one simulated user holding a growing chat history; each
+  round sends the full history (shared system prompt + per-user context +
+  prior Q/A) and streams the answer, recording TTFT / generation time /
+  token counts (reference UserSession:303-430).
+- ``UserSessionManager``: spawns sessions at a target QPS with a gap between
+  a user's rounds, produces the summary (reference :436-508).
+- ``ProcessSummary`` metrics: QPS, average prompt throughput, average
+  generation throughput, average TTFT (reference README.md:80-86).
+- Per-request CSV for offline analysis.
+
+Data: the reference preprocesses ShareGPT; this environment has zero egress,
+so ``synthesize_workload`` generates deterministic synthetic conversations
+with the same shape knobs (--shared-prefix-len, --user-history-len,
+--answer-len — matching run.sh's 1k shared prefix / 20k history / 100-token
+answers at the default settings' spirit, scaled by flags).
+
+Run: ``python benchmarks/multi_round_qa.py --base-url http://host:port/v1
+--model NAME --qps 1.0 --num-users 10 --num-rounds 5``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import csv
+import dataclasses
+import json
+import random
+import string
+import time
+from typing import Optional
+
+import aiohttp
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    user_id: int
+    round_idx: int
+    launch_time: float
+    finish_time: float = 0.0
+    ttft: float = float("nan")
+    prompt_tokens: int = 0
+    generation_tokens: int = 0
+    status: str = "ok"
+
+    @property
+    def latency(self) -> float:
+        return self.finish_time - self.launch_time
+
+    @property
+    def generation_time(self) -> float:
+        return max(self.finish_time - self.launch_time - self.ttft, 1e-9)
+
+
+def synthesize_workload(
+    num_users: int,
+    shared_prefix_len: int,
+    user_history_len: int,
+    seed: int = 0,
+) -> tuple[str, list[str]]:
+    """Deterministic synthetic (shared system prompt, per-user context)."""
+    rng = random.Random(seed)
+
+    def words(n):
+        return " ".join(
+            "".join(rng.choices(string.ascii_lowercase, k=rng.randint(3, 9)))
+            for _ in range(n)
+        )
+
+    shared = "You are a helpful assistant. Context: " + words(shared_prefix_len)
+    users = [f"User {i} background: " + words(user_history_len) for i in range(num_users)]
+    return shared, users
+
+
+QUESTIONS = [
+    "Summarize the context above in one sentence.",
+    "What is the most important point so far?",
+    "List three key items mentioned.",
+    "Continue the discussion with a new insight.",
+    "What should we do next?",
+]
+
+
+class UserSession:
+    """One simulated user: multi-round chat with a growing history."""
+
+    def __init__(
+        self,
+        user_id: int,
+        base_url: str,
+        model: str,
+        system_prompt: str,
+        user_context: str,
+        num_rounds: int,
+        answer_len: int,
+        round_gap: float,
+        records: list[RequestRecord],
+        timeout: float = 120.0,
+    ):
+        self.user_id = user_id
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.messages = [
+            {"role": "system", "content": system_prompt},
+            {"role": "user", "content": user_context},
+            {"role": "assistant", "content": "Understood."},
+        ]
+        self.num_rounds = num_rounds
+        self.answer_len = answer_len
+        self.round_gap = round_gap
+        self.records = records
+        self.timeout = timeout
+
+    async def _one_round(self, session: aiohttp.ClientSession, round_idx: int) -> None:
+        question = QUESTIONS[round_idx % len(QUESTIONS)]
+        self.messages.append({"role": "user", "content": question})
+        rec = RequestRecord(self.user_id, round_idx, launch_time=time.monotonic())
+        self.records.append(rec)
+        answer: list[str] = []
+        try:
+            async with session.post(
+                f"{self.base_url}/chat/completions",
+                json={
+                    "model": self.model,
+                    "messages": self.messages,
+                    "max_tokens": self.answer_len,
+                    "temperature": 0.0,
+                    "ignore_eos": True,
+                    "stream": True,
+                },
+                timeout=aiohttp.ClientTimeout(total=self.timeout),
+            ) as resp:
+                if resp.status != 200:
+                    rec.status = f"http {resp.status}"
+                    rec.finish_time = time.monotonic()
+                    return
+                async for raw in resp.content:
+                    line = raw.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    payload = line[6:]
+                    if payload == b"[DONE]":
+                        break
+                    chunk = json.loads(payload)
+                    for choice in chunk.get("choices", []):
+                        delta = (choice.get("delta") or {}).get("content") or choice.get(
+                            "text"
+                        )
+                        if delta:
+                            if rec.ttft != rec.ttft:  # first token (nan check)
+                                rec.ttft = time.monotonic() - rec.launch_time
+                            answer.append(delta)
+                            rec.generation_tokens += 1
+                    usage = chunk.get("usage")
+                    if usage:
+                        rec.prompt_tokens = usage.get("prompt_tokens", 0)
+                        rec.generation_tokens = usage.get(
+                            "completion_tokens", rec.generation_tokens
+                        )
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            rec.status = f"error: {type(e).__name__}"
+        rec.finish_time = time.monotonic()
+        self.messages.append({"role": "assistant", "content": "".join(answer) or "..."})
+
+    async def run(self, session: aiohttp.ClientSession) -> None:
+        for r in range(self.num_rounds):
+            await self._one_round(session, r)
+            if r + 1 < self.num_rounds:
+                await asyncio.sleep(self.round_gap)
+
+
+@dataclasses.dataclass
+class ProcessSummary:
+    """Reference metric definitions (benchmarks/multi-round-qa/README.md:80-86)."""
+
+    qps: float
+    avg_prompt_throughput: float
+    avg_generation_throughput: float
+    avg_ttft: float
+    p50_ttft: float
+    p90_ttft: float
+    avg_latency: float
+    completed: int
+    failed: int
+    elapsed: float
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def summarize(records: list[RequestRecord], elapsed: float) -> ProcessSummary:
+    ok = [r for r in records if r.status == "ok" and r.finish_time > 0]
+    failed = [r for r in records if r.status != "ok"]
+    ttfts = sorted(r.ttft for r in ok if r.ttft == r.ttft)
+
+    def pct(p):
+        return ttfts[min(int(p * len(ttfts)), len(ttfts) - 1)] if ttfts else float("nan")
+
+    return ProcessSummary(
+        qps=len(ok) / elapsed if elapsed > 0 else 0.0,
+        avg_prompt_throughput=(
+            sum(r.prompt_tokens for r in ok) / elapsed if elapsed > 0 else 0.0
+        ),
+        avg_generation_throughput=(
+            sum(r.generation_tokens for r in ok) / elapsed if elapsed > 0 else 0.0
+        ),
+        avg_ttft=sum(ttfts) / len(ttfts) if ttfts else float("nan"),
+        p50_ttft=pct(0.50),
+        p90_ttft=pct(0.90),
+        avg_latency=sum(r.latency for r in ok) / len(ok) if ok else float("nan"),
+        completed=len(ok),
+        failed=len(failed),
+        elapsed=elapsed,
+    )
+
+
+class UserSessionManager:
+    def __init__(self, args: argparse.Namespace):
+        self.args = args
+        self.records: list[RequestRecord] = []
+
+    async def run(self) -> ProcessSummary:
+        a = self.args
+        shared, users = synthesize_workload(
+            a.num_users, a.shared_prefix_len, a.user_history_len, seed=a.seed
+        )
+        conn = aiohttp.TCPConnector(limit=0)
+        start = time.monotonic()
+        async with aiohttp.ClientSession(connector=conn) as session:
+            tasks = []
+            for i in range(a.num_users):
+                us = UserSession(
+                    i, a.base_url, a.model, shared, users[i],
+                    a.num_rounds, a.answer_len, a.round_gap, self.records,
+                    timeout=a.request_timeout,
+                )
+                tasks.append(asyncio.create_task(us.run(session)))
+                # user arrivals paced at --qps (reference: session launch rate)
+                if a.qps > 0:
+                    await asyncio.sleep(1.0 / a.qps)
+            await asyncio.gather(*tasks)
+        elapsed = time.monotonic() - start
+        return summarize(self.records, elapsed)
+
+    def write_csv(self, path: str) -> None:
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(
+                [
+                    "user_id", "round", "launch_time", "ttft", "latency",
+                    "prompt_tokens", "generation_tokens", "status",
+                ]
+            )
+            for r in self.records:
+                w.writerow(
+                    [
+                        r.user_id, r.round_idx, f"{r.launch_time:.4f}",
+                        f"{r.ttft:.4f}", f"{r.latency:.4f}",
+                        r.prompt_tokens, r.generation_tokens, r.status,
+                    ]
+                )
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("multi-round-qa")
+    p.add_argument("--base-url", required=True, help="e.g. http://127.0.0.1:8000/v1")
+    p.add_argument("--model", default="llama-debug")
+    p.add_argument("--qps", type=float, default=1.0, help="user-session launch rate")
+    p.add_argument("--num-users", type=int, default=10)
+    p.add_argument("--num-rounds", type=int, default=5)
+    p.add_argument("--answer-len", type=int, default=100, help="tokens per answer")
+    p.add_argument("--shared-prefix-len", type=int, default=150, help="words")
+    p.add_argument("--user-history-len", type=int, default=100, help="words")
+    p.add_argument("--round-gap", type=float, default=1.0, help="seconds between rounds")
+    p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--output", default=None, help="per-request CSV path")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> ProcessSummary:
+    args = parse_args(argv)
+    mgr = UserSessionManager(args)
+    summary = asyncio.run(mgr.run())
+    if args.output:
+        mgr.write_csv(args.output)
+    print(summary.to_json())
+    return summary
+
+
+if __name__ == "__main__":
+    main()

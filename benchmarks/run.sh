@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# QPS sweep for the multi-round QA benchmark — parity with the reference's
+# benchmarks/multi-round-qa/run.sh (warmup pass, then QPS 0.1 -> 4.1 sweep).
+# Usage: ./run.sh <model> <base_url> [output_dir]
+set -euo pipefail
+
+MODEL="${1:?model name}"
+BASE_URL="${2:?base url, e.g. http://localhost:8000/v1}"
+OUT="${3:-results}"
+mkdir -p "$OUT"
+
+# warmup: prime the prefix caches with every user's history (reference
+# run.sh:14-35 warms 400 users; scaled here)
+python "$(dirname "$0")/multi_round_qa.py" \
+    --base-url "$BASE_URL" --model "$MODEL" \
+    --qps 2.0 --num-users 40 --num-rounds 1 --answer-len 20 \
+    --output "$OUT/warmup.csv"
+
+for QPS in 0.1 0.5 0.9 1.3 1.7 2.1 2.5 2.9 3.3 3.7 4.1; do
+    echo "=== QPS $QPS ==="
+    python "$(dirname "$0")/multi_round_qa.py" \
+        --base-url "$BASE_URL" --model "$MODEL" \
+        --qps "$QPS" --num-users 32 --num-rounds 10 --answer-len 100 \
+        --output "$OUT/qps-$QPS.csv" | tee "$OUT/summary-$QPS.json"
+done

@@ -1,0 +1,46 @@
+"""Benchmark harness test: drive multi_round_qa against the fake engine and
+check the summary metrics are sane (reference test strategy §4.2: perf tests
+run against the fake backend with zero accelerators)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benchmarks"))
+
+from production_stack_tpu.testing.procs import free_port, start_proc, stop_proc, wait_healthy
+
+
+def test_multi_round_qa_against_fake_engine(tmp_path):
+    import multi_round_qa
+
+    port = free_port()
+    proc = start_proc(
+        [
+            "-m", "production_stack_tpu.testing.fake_engine",
+            "--port", str(port), "--model", "bench-model",
+            "--speed", "500", "--ttft", "0.05",
+        ]
+    )
+    try:
+        wait_healthy(f"http://127.0.0.1:{port}/health", proc)
+        csv_path = str(tmp_path / "out.csv")
+        summary = multi_round_qa.main(
+            [
+                "--base-url", f"http://127.0.0.1:{port}/v1",
+                "--model", "bench-model",
+                "--qps", "20", "--num-users", "4", "--num-rounds", "2",
+                "--answer-len", "10", "--round-gap", "0.05",
+                "--shared-prefix-len", "20", "--user-history-len", "10",
+                "--output", csv_path,
+            ]
+        )
+        assert summary.completed == 8
+        assert summary.failed == 0
+        # injected TTFT is 50ms; measured must be >= that and well below latency
+        assert 0.04 <= summary.p50_ttft <= 1.0
+        assert summary.avg_generation_throughput > 0
+        with open(csv_path) as f:
+            lines = f.read().strip().splitlines()
+        assert len(lines) == 1 + 8  # header + one row per request
+    finally:
+        stop_proc(proc)
