@@ -1,0 +1,77 @@
+{{/* Common labels */}}
+{{- define "pstpu.labels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
+{{- end }}
+
+{{/* Engine deployment name for a modelSpec */}}
+{{- define "pstpu.engineName" -}}
+{{ .release }}-{{ .model.name }}-engine
+{{- end }}
+
+{{/* Full engine CLI args for a modelSpec (values -> engine flags).
+     Reference analogue: the vllm serve command assembly in
+     deployment-vllm-multi.yaml:96-186. */}}
+{{- define "pstpu.engineArgs" -}}
+- "-m"
+- "production_stack_tpu.engine.api_server"
+- "--model"
+- {{ .model.modelURL | quote }}
+- "--served-model-name"
+- {{ .model.name | quote }}
+- "--port"
+- {{ .containerPort | quote }}
+- "--tensor-parallel-size"
+- {{ .model.tensorParallelSize | default 1 | quote }}
+- "--max-model-len"
+- {{ .model.maxModelLen | default 4096 | quote }}
+- "--max-num-seqs"
+- {{ .model.maxNumSeqs | default 64 | quote }}
+- "--page-size"
+- {{ .model.pageSize | default 16 | quote }}
+- "--kv-cache-memory-gb"
+- {{ .model.kvCacheMemoryGB | default 4 | quote }}
+{{- if not (.model.enableChunkedPrefill | default true) }}
+- "--no-enable-chunked-prefill"
+{{- end }}
+{{- if not (.model.enablePrefixCaching | default true) }}
+- "--no-enable-prefix-caching"
+{{- end }}
+{{- if .model.enableSleepMode }}
+- "--enable-sleep-mode"
+{{- end }}
+{{- if .model.kvOffload }}
+{{- if .model.kvOffload.enabled }}
+- "--kv-offload-cpu-gb"
+- {{ .model.kvOffload.cpuOffloadGB | quote }}
+{{- if gt (int .model.kvOffload.diskOffloadGB) 0 }}
+- "--kv-offload-dir"
+- {{ .model.kvOffload.diskOffloadPath | quote }}
+- "--kv-offload-disk-gb"
+- {{ .model.kvOffload.diskOffloadGB | quote }}
+{{- end }}
+- "--kv-serde"
+- {{ .model.kvOffload.serde | default "naive" | quote }}
+{{- if .model.kvOffload.useRemote }}
+- "--kv-remote-url"
+- "{{ .release }}-cache-server:{{ .cachePort }}"
+{{- end }}
+{{- if .model.kvOffload.useController }}
+- "--kv-controller-url"
+- "{{ .release }}-kv-controller:{{ .controllerPort }}"
+{{- end }}
+{{- end }}
+{{- end }}
+{{- if ne (.model.kvRole | default "none") "none" }}
+- "--kv-role"
+- {{ .model.kvRole | quote }}
+- "--kv-transfer-port"
+- {{ .model.kvTransferPort | default 55555 | quote }}
+{{- if .model.kvPeerService }}
+- "--kv-peer-url"
+- "{{ .model.kvPeerService }}:{{ .model.kvTransferPort | default 55555 }}"
+{{- end }}
+{{- end }}
+{{- end }}

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Install the observability stack (parity: reference observability/install.sh).
+set -euo pipefail
+NS="${1:-monitoring}"
+
+helm repo add prometheus-community https://prometheus-community.github.io/helm-charts || true
+helm repo update
+helm upgrade --install kube-prom-stack prometheus-community/kube-prometheus-stack \
+  --namespace "$NS" --create-namespace \
+  -f "$(dirname "$0")/kube-prom-stack.yaml"
+
+# dashboard as a sidecar-discovered ConfigMap
+kubectl -n "$NS" create configmap tpu-stack-dashboard \
+  --from-file=tpu-stack-dashboard.json="$(dirname "$0")/tpu-stack-dashboard.json" \
+  --dry-run=client -o yaml | kubectl apply -f -
+kubectl -n "$NS" label configmap tpu-stack-dashboard grafana_dashboard=1 --overwrite
+
+# custom-metrics adapter for HPA on queue depth
+helm upgrade --install prom-adapter prometheus-community/prometheus-adapter \
+  --namespace "$NS" -f "$(dirname "$0")/prom-adapter.yaml"

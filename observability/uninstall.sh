@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+set -euo pipefail
+NS="${1:-monitoring}"
+helm uninstall prom-adapter -n "$NS" || true
+helm uninstall kube-prom-stack -n "$NS" || true
+kubectl -n "$NS" delete configmap tpu-stack-dashboard || true
