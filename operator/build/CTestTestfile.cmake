@@ -1,0 +1,8 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/operator
+# Build directory: /root/repo/operator/build
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(json_test "/root/repo/operator/build/json_test")
+set_tests_properties(json_test PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/operator/CMakeLists.txt;19;add_test;/root/repo/operator/CMakeLists.txt;0;")

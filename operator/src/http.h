@@ -1,0 +1,230 @@
+// Blocking HTTP/1.1 client over POSIX sockets — the operator's transport to
+// the Kubernetes apiserver.
+//
+// TLS is terminated by a kubectl-proxy sidecar in the operator pod (this
+// image vendors no TLS library), so the client speaks plain HTTP to
+// 127.0.0.1:8001 in-cluster and to the fake apiserver in tests. The Go
+// reference operator's client-go fills this role (/root/reference operator/).
+//
+// Supports: request/response with Content-Length or chunked bodies, and
+// streaming line callbacks for K8s watch endpoints.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <functional>
+#include <map>
+#include <optional>
+#include <sstream>
+#include <string>
+
+namespace http {
+
+struct Response {
+  int status = 0;
+  std::map<std::string, std::string> headers;
+  std::string body;
+};
+
+class Error : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+class Client {
+ public:
+  Client(std::string host, int port, int timeout_sec = 30)
+      : host_(std::move(host)), port_(port), timeout_sec_(timeout_sec) {}
+
+  Response request(const std::string& method, const std::string& path,
+                   const std::string& body = "",
+                   const std::map<std::string, std::string>& headers = {}) {
+    int fd = connect_();
+    try {
+      send_request(fd, method, path, body, headers);
+      Response r = read_response(fd, nullptr);
+      ::close(fd);
+      return r;
+    } catch (...) {
+      ::close(fd);
+      throw;
+    }
+  }
+
+  // Streaming GET: on_line is invoked for every newline-delimited body line
+  // (K8s watch event frames). Returns when the server closes the stream or
+  // on_line returns false.
+  void stream(const std::string& path,
+              const std::function<bool(const std::string&)>& on_line,
+              int read_timeout_sec = 60) {
+    int fd = connect_(read_timeout_sec);
+    try {
+      send_request(fd, "GET", path, "", {});
+      read_response(fd, &on_line);
+      ::close(fd);
+    } catch (...) {
+      ::close(fd);
+      throw;
+    }
+  }
+
+ private:
+  int connect_(int timeout_override = 0) {
+    struct addrinfo hints = {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port_);
+    if (getaddrinfo(host_.c_str(), port_s.c_str(), &hints, &res) != 0 || !res)
+      throw Error("resolve failed: " + host_);
+    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) {
+      freeaddrinfo(res);
+      throw Error("socket failed");
+    }
+    struct timeval tv = {};
+    tv.tv_sec = timeout_override ? timeout_override : timeout_sec_;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      freeaddrinfo(res);
+      ::close(fd);
+      throw Error("connect failed: " + host_ + ":" + port_s);
+    }
+    freeaddrinfo(res);
+    return fd;
+  }
+
+  void send_request(int fd, const std::string& method, const std::string& path,
+                    const std::string& body,
+                    const std::map<std::string, std::string>& headers) {
+    std::ostringstream os;
+    os << method << " " << path << " HTTP/1.1\r\n";
+    os << "Host: " << host_ << ":" << port_ << "\r\n";
+    os << "Connection: close\r\n";
+    for (const auto& [k, v] : headers) os << k << ": " << v << "\r\n";
+    if (!body.empty() && !headers.count("Content-Type"))
+      os << "Content-Type: application/json\r\n";
+    os << "Content-Length: " << body.size() << "\r\n\r\n" << body;
+    std::string out = os.str();
+    size_t sent = 0;
+    while (sent < out.size()) {
+      ssize_t n = ::send(fd, out.data() + sent, out.size() - sent, 0);
+      if (n <= 0) throw Error("send failed");
+      sent += static_cast<size_t>(n);
+    }
+  }
+
+  Response read_response(
+      int fd, const std::function<bool(const std::string&)>* on_line) {
+    std::string buf;
+    char tmp[8192];
+    // read headers
+    size_t header_end;
+    while ((header_end = buf.find("\r\n\r\n")) == std::string::npos) {
+      ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
+      if (n <= 0) throw Error("recv failed reading headers");
+      buf.append(tmp, static_cast<size_t>(n));
+    }
+    Response r;
+    {
+      std::istringstream hs(buf.substr(0, header_end));
+      std::string line;
+      std::getline(hs, line);
+      if (line.size() > 9) r.status = std::atoi(line.c_str() + 9);
+      while (std::getline(hs, line)) {
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        size_t colon = line.find(':');
+        if (colon != std::string::npos) {
+          std::string k = line.substr(0, colon);
+          for (auto& c : k) c = static_cast<char>(tolower(c));
+          size_t vs = line.find_first_not_of(' ', colon + 1);
+          r.headers[k] = vs == std::string::npos ? "" : line.substr(vs);
+        }
+      }
+    }
+    std::string rest = buf.substr(header_end + 4);
+    bool chunked = r.headers.count("transfer-encoding") &&
+                   r.headers["transfer-encoding"].find("chunked") !=
+                       std::string::npos;
+    long content_len = r.headers.count("content-length")
+                           ? std::atol(r.headers["content-length"].c_str())
+                           : -1;
+
+    std::string pending;  // for line streaming
+    auto feed = [&](const std::string& data) -> bool {
+      if (!on_line || !*on_line) {
+        r.body += data;
+        return true;
+      }
+      pending += data;
+      size_t nl;
+      while ((nl = pending.find('\n')) != std::string::npos) {
+        std::string line = pending.substr(0, nl);
+        if (!line.empty() && line.back() == '\r') line.pop_back();
+        pending.erase(0, nl + 1);
+        if (!line.empty() && !(*on_line)(line)) return false;
+      }
+      return true;
+    };
+
+    if (chunked) {
+      std::string raw = rest;
+      std::string decoded;
+      auto pump = [&]() -> bool {
+        // decode complete chunks from `raw`
+        while (true) {
+          size_t nl = raw.find("\r\n");
+          if (nl == std::string::npos) return true;
+          long sz = std::strtol(raw.c_str(), nullptr, 16);
+          if (sz == 0) return false;  // final chunk
+          if (raw.size() < nl + 2 + static_cast<size_t>(sz) + 2) return true;
+          if (!feed(raw.substr(nl + 2, static_cast<size_t>(sz)))) return false;
+          raw.erase(0, nl + 2 + static_cast<size_t>(sz) + 2);
+        }
+      };
+      if (!pump()) return r;
+      while (true) {
+        ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
+        if (n <= 0) break;
+        raw.append(tmp, static_cast<size_t>(n));
+        if (!pump()) break;
+      }
+    } else {
+      if (!feed(rest)) return r;
+      while (content_len < 0 ||
+             r.body.size() + pending.size() < static_cast<size_t>(content_len)) {
+        ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
+        if (n <= 0) break;
+        if (!feed(std::string(tmp, static_cast<size_t>(n)))) return r;
+      }
+      if (on_line && *on_line && !pending.empty()) (*on_line)(pending);
+    }
+    return r;
+  }
+
+  std::string host_;
+  int port_;
+  int timeout_sec_;
+};
+
+inline std::string url_encode(const std::string& s) {
+  std::ostringstream os;
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~' || c == '=' ||
+        c == '&')
+      os << c;
+    else {
+      char buf[4];
+      snprintf(buf, sizeof(buf), "%%%02X", c);
+      os << buf;
+    }
+  }
+  return os.str();
+}
+
+}  // namespace http

@@ -1,0 +1,368 @@
+// Minimal JSON value/parser/serializer for the operator.
+//
+// The Go reference operator gets JSON handling from client-go; this operator
+// is dependency-free C++ (the environment vendors no JSON library), so this
+// header provides the small subset K8s API objects need: objects, arrays,
+// strings (with escapes), numbers, bools, null. Parse errors throw
+// json::parse_error with byte offset.
+//
+// Reference analogue: operator/ (Go, kubebuilder) in /root/reference.
+#pragma once
+
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace json {
+
+class parse_error : public std::runtime_error {
+ public:
+  parse_error(const std::string& msg, size_t pos)
+      : std::runtime_error(msg + " at byte " + std::to_string(pos)) {}
+};
+
+class Value;
+using Object = std::map<std::string, Value>;
+using Array = std::vector<Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(int i) : type_(Type::Number), num_(i) {}
+  Value(int64_t i) : type_(Type::Number), num_(static_cast<double>(i)) {}
+  Value(double d) : type_(Type::Number), num_(d) {}
+  Value(const char* s) : type_(Type::String), str_(s) {}
+  Value(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Value(Array a) : type_(Type::Array), arr_(std::move(a)) {}
+  Value(Object o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_number(double dflt = 0) const {
+    return type_ == Type::Number ? num_ : dflt;
+  }
+  int64_t as_int(int64_t dflt = 0) const {
+    return type_ == Type::Number ? static_cast<int64_t>(num_) : dflt;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return type_ == Type::String ? str_ : empty;
+  }
+  const Array& as_array() const {
+    static const Array empty;
+    return type_ == Type::Array ? arr_ : empty;
+  }
+  Array& as_array_mut() {
+    if (type_ != Type::Array) *this = Value(Array{});
+    return arr_;
+  }
+  const Object& as_object() const {
+    static const Object empty;
+    return type_ == Type::Object ? obj_ : empty;
+  }
+  Object& as_object_mut() {
+    if (type_ != Type::Object) *this = Value(Object{});
+    return obj_;
+  }
+
+  // object access; returns Null value for missing keys
+  const Value& operator[](const std::string& key) const {
+    static const Value null_v;
+    if (type_ != Type::Object) return null_v;
+    auto it = obj_.find(key);
+    return it == obj_.end() ? null_v : it->second;
+  }
+  Value& set(const std::string& key, Value v) {
+    as_object_mut()[key] = std::move(v);
+    return *this;
+  }
+  bool has(const std::string& key) const {
+    return type_ == Type::Object && obj_.count(key) > 0;
+  }
+  // dotted-path lookup: at("spec.router.port")
+  const Value& at_path(const std::string& path) const {
+    const Value* cur = this;
+    size_t start = 0;
+    while (start <= path.size()) {
+      size_t dot = path.find('.', start);
+      std::string key = path.substr(start, dot == std::string::npos
+                                               ? std::string::npos
+                                               : dot - start);
+      cur = &(*cur)[key];
+      if (dot == std::string::npos) break;
+      start = dot + 1;
+    }
+    return *cur;
+  }
+
+  std::string dump(int indent = -1) const {
+    std::ostringstream os;
+    dump_to(os, indent, 0);
+    return os.str();
+  }
+
+ private:
+  static void escape_to(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  void dump_to(std::ostringstream& os, int indent, int depth) const {
+    auto pad = [&](int d) {
+      if (indent >= 0) {
+        os << '\n';
+        for (int i = 0; i < indent * d; i++) os << ' ';
+      }
+    };
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) && num_ == std::floor(num_) &&
+            std::abs(num_) < 9e15) {
+          os << static_cast<int64_t>(num_);
+        } else {
+          os << num_;
+        }
+        break;
+      }
+      case Type::String: escape_to(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        bool first = true;
+        for (const auto& v : arr_) {
+          if (!first) os << ',';
+          first = false;
+          pad(depth + 1);
+          v.dump_to(os, indent, depth + 1);
+        }
+        if (!arr_.empty()) pad(depth);
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& [k, v] : obj_) {
+          if (!first) os << ',';
+          first = false;
+          pad(depth + 1);
+          escape_to(os, k);
+          os << (indent >= 0 ? ": " : ":");
+          v.dump_to(os, indent, depth + 1);
+        }
+        if (!obj_.empty()) pad(depth);
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  Array arr_;
+  Object obj_;
+};
+
+// ---------------------------------------------------------------------------
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  Value parse() {
+    Value v = value();
+    skip_ws();
+    if (pos_ != s_.size()) throw parse_error("trailing data", pos_);
+    return v;
+  }
+
+ private:
+  void skip_ws() {
+    while (pos_ < s_.size() &&
+           (s_[pos_] == ' ' || s_[pos_] == '\t' || s_[pos_] == '\n' ||
+            s_[pos_] == '\r'))
+      pos_++;
+  }
+  char peek() {
+    if (pos_ >= s_.size()) throw parse_error("unexpected end", pos_);
+    return s_[pos_];
+  }
+  char next() {
+    char c = peek();
+    pos_++;
+    return c;
+  }
+  void expect(const char* lit) {
+    for (const char* p = lit; *p; p++) {
+      if (pos_ >= s_.size() || s_[pos_] != *p)
+        throw parse_error(std::string("expected '") + lit + "'", pos_);
+      pos_++;
+    }
+  }
+
+  Value value() {
+    skip_ws();
+    switch (peek()) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return Value(string());
+      case 't': expect("true"); return Value(true);
+      case 'f': expect("false"); return Value(false);
+      case 'n': expect("null"); return Value(nullptr);
+      default: return number();
+    }
+  }
+
+  Value object() {
+    next();  // {
+    Object obj;
+    skip_ws();
+    if (peek() == '}') {
+      next();
+      return Value(std::move(obj));
+    }
+    while (true) {
+      skip_ws();
+      std::string key = string();
+      skip_ws();
+      if (next() != ':') throw parse_error("expected ':'", pos_ - 1);
+      obj[std::move(key)] = value();
+      skip_ws();
+      char c = next();
+      if (c == '}') break;
+      if (c != ',') throw parse_error("expected ',' or '}'", pos_ - 1);
+    }
+    return Value(std::move(obj));
+  }
+
+  Value array() {
+    next();  // [
+    Array arr;
+    skip_ws();
+    if (peek() == ']') {
+      next();
+      return Value(std::move(arr));
+    }
+    while (true) {
+      arr.push_back(value());
+      skip_ws();
+      char c = next();
+      if (c == ']') break;
+      if (c != ',') throw parse_error("expected ',' or ']'", pos_ - 1);
+    }
+    return Value(std::move(arr));
+  }
+
+  std::string string() {
+    if (next() != '"') throw parse_error("expected string", pos_ - 1);
+    std::string out;
+    while (true) {
+      char c = next();
+      if (c == '"') break;
+      if (c == '\\') {
+        char e = next();
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) throw parse_error("bad \\u", pos_);
+            unsigned cp = std::stoul(s_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // encode UTF-8 (surrogate pairs for BMP-external chars)
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos_ + 6 <= s_.size() &&
+                s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+              unsigned lo = std::stoul(s_.substr(pos_ + 2, 4), nullptr, 16);
+              pos_ += 6;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else if (cp < 0x10000) {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (cp >> 18));
+              out += static_cast<char>(0x80 | ((cp >> 12) & 0x3F));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: throw parse_error("bad escape", pos_ - 1);
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+
+  Value number() {
+    size_t start = pos_;
+    if (peek() == '-') next();
+    while (pos_ < s_.size() &&
+           (isdigit(s_[pos_]) || s_[pos_] == '.' || s_[pos_] == 'e' ||
+            s_[pos_] == 'E' || s_[pos_] == '+' || s_[pos_] == '-'))
+      pos_++;
+    try {
+      return Value(std::stod(s_.substr(start, pos_ - start)));
+    } catch (...) {
+      throw parse_error("bad number", start);
+    }
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+inline Value parse(const std::string& text) { return Parser(text).parse(); }
+
+}  // namespace json

@@ -1,0 +1,59 @@
+// Unit tests for the minimal JSON implementation (run via ctest).
+#include "json.h"
+
+#include <cassert>
+#include <cstdio>
+
+static int failures = 0;
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);  \
+      failures++;                                                      \
+    }                                                                  \
+  } while (0)
+
+int main() {
+  // roundtrip
+  auto v = json::parse(R"({"a": 1, "b": [true, null, "x\n"], "c": {"d": 2.5}})");
+  CHECK(v["a"].as_int() == 1);
+  CHECK(v["b"].as_array().size() == 3);
+  CHECK(v["b"].as_array()[0].as_bool());
+  CHECK(v["b"].as_array()[1].is_null());
+  CHECK(v["b"].as_array()[2].as_string() == "x\n");
+  CHECK(v.at_path("c.d").as_number() == 2.5);
+
+  auto re = json::parse(v.dump());
+  CHECK(re.dump() == v.dump());
+
+  // escapes + unicode
+  auto u = json::parse(R"({"s": "é😀\"q\""})");
+  CHECK(u["s"].as_string() == "\xc3\xa9\xf0\x9f\x98\x80\"q\"");
+  CHECK(json::parse(u.dump())["s"].as_string() == u["s"].as_string());
+
+  // missing keys are null, not crashes
+  CHECK(v["nope"].is_null());
+  CHECK(v.at_path("c.nope.deeper").is_null());
+
+  // mutation
+  json::Value obj;
+  obj.set("x", 1).set("y", json::Array{json::Value(2)});
+  CHECK(obj.dump() == R"({"x":1,"y":[2]})");
+
+  // errors
+  bool threw = false;
+  try {
+    json::parse("{bad");
+  } catch (const json::parse_error&) {
+    threw = true;
+  }
+  CHECK(threw);
+
+  // large ints survive (resourceVersion-style)
+  auto big = json::parse(R"({"rv": 123456789012})");
+  CHECK(big["rv"].as_int() == 123456789012LL);
+  CHECK(big.dump() == R"({"rv":123456789012})");
+
+  if (failures == 0) printf("json_test: all checks passed\n");
+  return failures == 0 ? 0 : 1;
+}

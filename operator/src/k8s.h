@@ -1,0 +1,138 @@
+// Kubernetes REST client: typed verbs over http::Client.
+//
+// Covers what the reconcilers need from client-go (/root/reference
+// operator/internal/controller/*.go): list/get/create/update/patch/delete on
+// namespaced resources (core, apps, and the stack's CRD group), status
+// subresource updates, and a line-delimited watch.
+#pragma once
+
+#include <functional>
+#include <optional>
+#include <string>
+
+#include "http.h"
+#include "json.h"
+
+namespace k8s {
+
+inline const char* kGroup = "production-stack.tpu.ai";
+inline const char* kVersion = "v1alpha1";
+
+struct ApiPath {
+  // builds /api/v1/... or /apis/<group>/<version>/... resource paths
+  static std::string collection(const std::string& group,
+                                const std::string& version,
+                                const std::string& ns,
+                                const std::string& plural) {
+    std::string base = group.empty() ? "/api/" + version
+                                     : "/apis/" + group + "/" + version;
+    if (!ns.empty()) base += "/namespaces/" + ns;
+    return base + "/" + plural;
+  }
+  static std::string item(const std::string& group, const std::string& version,
+                          const std::string& ns, const std::string& plural,
+                          const std::string& name) {
+    return collection(group, version, ns, plural) + "/" + name;
+  }
+};
+
+class Client {
+ public:
+  Client(std::string host, int port) : http_(std::move(host), port) {}
+
+  json::Value list(const std::string& group, const std::string& version,
+                   const std::string& ns, const std::string& plural,
+                   const std::string& label_selector = "") {
+    std::string path = ApiPath::collection(group, version, ns, plural);
+    if (!label_selector.empty())
+      path += "?labelSelector=" + http::url_encode(label_selector);
+    auto r = http_.request("GET", path);
+    if (r.status != 200) throw http::Error("list " + plural + ": " + std::to_string(r.status));
+    return json::parse(r.body);
+  }
+
+  std::optional<json::Value> get(const std::string& group,
+                                 const std::string& version,
+                                 const std::string& ns,
+                                 const std::string& plural,
+                                 const std::string& name) {
+    auto r = http_.request("GET",
+                           ApiPath::item(group, version, ns, plural, name));
+    if (r.status == 404) return std::nullopt;
+    if (r.status != 200) throw http::Error("get " + name + ": " + std::to_string(r.status));
+    return json::parse(r.body);
+  }
+
+  json::Value create(const std::string& group, const std::string& version,
+                     const std::string& ns, const std::string& plural,
+                     const json::Value& obj) {
+    auto r = http_.request("POST", ApiPath::collection(group, version, ns, plural),
+                           obj.dump());
+    if (r.status != 200 && r.status != 201)
+      throw http::Error("create " + plural + ": " + std::to_string(r.status) +
+                        " " + r.body);
+    return json::parse(r.body);
+  }
+
+  json::Value update(const std::string& group, const std::string& version,
+                     const std::string& ns, const std::string& plural,
+                     const std::string& name, const json::Value& obj) {
+    auto r = http_.request("PUT", ApiPath::item(group, version, ns, plural, name),
+                           obj.dump());
+    if (r.status != 200)
+      throw http::Error("update " + name + ": " + std::to_string(r.status) +
+                        " " + r.body);
+    return json::parse(r.body);
+  }
+
+  json::Value update_status(const std::string& group, const std::string& version,
+                            const std::string& ns, const std::string& plural,
+                            const std::string& name, const json::Value& obj) {
+    auto r = http_.request(
+        "PUT", ApiPath::item(group, version, ns, plural, name) + "/status",
+        obj.dump());
+    if (r.status != 200)
+      throw http::Error("status " + name + ": " + std::to_string(r.status));
+    return json::parse(r.body);
+  }
+
+  bool remove(const std::string& group, const std::string& version,
+              const std::string& ns, const std::string& plural,
+              const std::string& name) {
+    auto r = http_.request("DELETE",
+                           ApiPath::item(group, version, ns, plural, name));
+    return r.status == 200 || r.status == 404;
+  }
+
+  // Watch a collection; cb receives parsed {type, object} events. Returns on
+  // stream end (callers re-list + re-watch; resourceVersion-based resume).
+  void watch(const std::string& group, const std::string& version,
+             const std::string& ns, const std::string& plural,
+             const std::string& resource_version,
+             const std::function<bool(const json::Value&)>& cb) {
+    std::string path = ApiPath::collection(group, version, ns, plural) +
+                       "?watch=true";
+    if (!resource_version.empty())
+      path += "&resourceVersion=" + resource_version;
+    http_.stream(path, [&](const std::string& line) {
+      try {
+        return cb(json::parse(line));
+      } catch (const json::parse_error&) {
+        return true;  // skip malformed frames
+      }
+    });
+  }
+
+  // POST to an arbitrary URL path on another host (LoRA load/unload calls go
+  // straight to engine pods, reference loraadapter_controller.go:586-616).
+  static int post_url(const std::string& host, int port, const std::string& path,
+                      const std::string& body) {
+    http::Client c(host, port, 10);
+    return c.request("POST", path, body).status;
+  }
+
+ private:
+  http::Client http_;
+};
+
+}  // namespace k8s

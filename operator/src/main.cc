@@ -1,0 +1,104 @@
+// production-stack-tpu operator: controller manager entry point.
+//
+// Connects to the apiserver (kubectl-proxy sidecar at 127.0.0.1:8001 by
+// default — this binary speaks plain HTTP; the sidecar terminates TLS/auth),
+// then runs a reconcile loop: periodic full resync plus watch-triggered
+// passes on the stack's CRDs. C++ replacement for the reference's
+// kubebuilder manager (/root/reference operator/cmd/main.go).
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+
+#include "k8s.h"
+#include "reconciler.h"
+
+static std::atomic<bool> g_stop{false};
+static std::atomic<bool> g_dirty{true};
+
+static void on_signal(int) { g_stop = true; }
+
+int main(int argc, char** argv) {
+  std::string host = "127.0.0.1";
+  int port = 8001;
+  std::string ns = "default";
+  int resync_sec = 30;
+  int max_passes = -1;  // -1 = run forever; tests bound it
+
+  for (int i = 1; i < argc; i++) {
+    auto arg = std::string(argv[i]);
+    auto next = [&]() -> std::string {
+      return i + 1 < argc ? argv[++i] : "";
+    };
+    if (arg == "--apiserver-host") host = next();
+    else if (arg == "--apiserver-port") port = std::stoi(next());
+    else if (arg == "--namespace") ns = next();
+    else if (arg == "--resync-seconds") resync_sec = std::stoi(next());
+    else if (arg == "--max-passes") max_passes = std::stoi(next());
+    else if (arg == "--help") {
+      printf("usage: operator [--apiserver-host H] [--apiserver-port P]\n"
+             "                [--namespace NS] [--resync-seconds N]\n"
+             "                [--max-passes N (testing)]\n");
+      return 0;
+    }
+  }
+
+  signal(SIGINT, on_signal);
+  signal(SIGTERM, on_signal);
+
+  k8s::Client kc(host, port);
+  op::Reconciler rec(kc, ns);
+  fprintf(stderr, "operator: apiserver=%s:%d namespace=%s resync=%ds\n",
+          host.c_str(), port, ns.c_str(), resync_sec);
+
+  // watch threads mark the world dirty; the main loop reconciles
+  const char* kinds[] = {"tpuruntimes", "tpurouters", "tpucacheservers",
+                         "loraadapters"};
+  std::vector<std::thread> watchers;
+  for (const char* plural : kinds) {
+    watchers.emplace_back([&kc2 = kc, plural]() {
+      k8s::Client wc = kc2;  // own connection per watcher
+      while (!g_stop) {
+        try {
+          wc.watch(k8s::kGroup, k8s::kVersion, "", plural, "",
+                   [](const json::Value&) {
+                     g_dirty = true;
+                     return !g_stop.load();
+                   });
+        } catch (const std::exception&) {
+          // apiserver unreachable or watch unsupported; resync covers us
+        }
+        for (int i = 0; i < 10 && !g_stop; i++)
+          std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      }
+    });
+  }
+
+  int passes = 0;
+  auto last = std::chrono::steady_clock::now() - std::chrono::hours(1);
+  while (!g_stop) {
+    bool due = std::chrono::steady_clock::now() - last >=
+               std::chrono::seconds(resync_sec);
+    if (g_dirty || due) {
+      g_dirty = false;
+      last = std::chrono::steady_clock::now();
+      try {
+        int n = rec.reconcile_all();
+        fprintf(stderr, "operator: reconciled %d objects\n", n);
+      } catch (const std::exception& e) {
+        fprintf(stderr, "operator: reconcile pass failed: %s\n", e.what());
+      }
+      if (max_passes > 0 && ++passes >= max_passes) break;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  }
+  g_stop = true;
+  for (auto& t : watchers) t.detach();  // blocked in recv; process exits
+  fprintf(stderr, "operator: shutting down\n");
+  return 0;
+}

@@ -1,0 +1,498 @@
+// Reconcilers for the stack's CRDs — C++ port of the reference Go operator's
+// controller logic (/root/reference operator/internal/controller/):
+//
+//   TPURuntime    <- VLLMRuntime   (vllmruntime_controller.go:56-440)
+//   TPURouter     <- VLLMRouter    (vllmrouter_controller.go:61-511)
+//   TPUCacheServer<- CacheServer   (cacheserver_controller.go:54-291)
+//   LoraAdapter   <- LoraAdapter   (loraadapter_controller.go:76-871)
+//
+// Each reconcile builds the desired child objects from the CR spec, then
+// create-or-updates them. Updates are gated on a spec hash annotation
+// (pstpu.ai/spec-hash) instead of a structural diff — same effect as the
+// reference's deploymentNeedsUpdate (vllmruntime_controller.go:440-523) with
+// far less code. Children carry ownerReferences so kube GC deletes them with
+// the CR.
+#pragma once
+
+#include <functional>
+#include <string>
+
+#include "json.h"
+#include "k8s.h"
+
+namespace op {
+
+inline std::string spec_hash(const json::Value& v) {
+  // FNV-1a over the canonical dump
+  std::string s = v.dump();
+  uint64_t h = 1469598103934665603ULL;
+  for (unsigned char c : s) {
+    h ^= c;
+    h *= 1099511628211ULL;
+  }
+  char buf[24];
+  snprintf(buf, sizeof(buf), "%016llx", static_cast<unsigned long long>(h));
+  return buf;
+}
+
+inline json::Value owner_ref(const json::Value& cr, const std::string& kind) {
+  json::Value ref;
+  ref.set("apiVersion", std::string(k8s::kGroup) + "/" + k8s::kVersion);
+  ref.set("kind", kind);
+  ref.set("name", cr.at_path("metadata.name").as_string());
+  ref.set("uid", cr.at_path("metadata.uid").as_string());
+  ref.set("controller", true);
+  return ref;
+}
+
+// create-or-update a namespaced object, gated on the spec-hash annotation
+inline void apply(k8s::Client& kc, const std::string& group,
+                  const std::string& version, const std::string& ns,
+                  const std::string& plural, json::Value desired) {
+  const std::string name = desired.at_path("metadata.name").as_string();
+  const std::string hash = spec_hash(desired["spec"]);
+  desired.as_object_mut()["metadata"].as_object_mut()["annotations"].set(
+      "pstpu.ai/spec-hash", hash);
+  auto existing = kc.get(group, version, ns, plural, name);
+  if (!existing) {
+    kc.create(group, version, ns, plural, desired);
+    return;
+  }
+  const std::string old_hash =
+      (*existing).at_path("metadata.annotations").as_object().count(
+          "pstpu.ai/spec-hash")
+          ? (*existing)
+                .at_path("metadata.annotations")["pstpu.ai/spec-hash"]
+                .as_string()
+          : "";
+  if (old_hash == hash) return;  // up to date
+  // carry resourceVersion for optimistic concurrency
+  desired.as_object_mut()["metadata"].set(
+      "resourceVersion",
+      (*existing).at_path("metadata.resourceVersion").as_string());
+  kc.update(group, version, ns, plural, name, desired);
+}
+
+// ---------------------------------------------------------------------------
+// TPURuntime -> engine Deployment + Service
+
+inline json::Array engine_args(const json::Value& spec) {
+  // mirrors helm/templates/_helpers.tpl pstpu.engineArgs and the reference's
+  // vllm-serve arg assembly (vllmruntime_controller.go:152-440)
+  json::Array a;
+  auto add = [&](const std::string& s) { a.push_back(json::Value(s)); };
+  const auto& eng = spec["engineConfig"];
+  add("-m");
+  add("production_stack_tpu.engine.api_server");
+  add("--model");
+  add(spec.at_path("model.modelURL").as_string());
+  add("--served-model-name");
+  add(spec.at_path("model.name").as_string());
+  add("--port");
+  add(std::to_string(eng["port"].as_int(8100)));
+  add("--tensor-parallel-size");
+  add(std::to_string(eng["tensorParallelSize"].as_int(1)));
+  add("--max-model-len");
+  add(std::to_string(eng["maxModelLen"].as_int(4096)));
+  add("--max-num-seqs");
+  add(std::to_string(eng["maxNumSeqs"].as_int(64)));
+  add("--page-size");
+  add(std::to_string(eng["pageSize"].as_int(16)));
+  add("--kv-cache-memory-gb");
+  add(std::to_string(eng["kvCacheMemoryGB"].as_int(4)));
+  if (eng.has("enableChunkedPrefill") && !eng["enableChunkedPrefill"].as_bool())
+    add("--no-enable-chunked-prefill");
+  if (eng.has("enablePrefixCaching") && !eng["enablePrefixCaching"].as_bool())
+    add("--no-enable-prefix-caching");
+  if (eng["enableSleepMode"].as_bool()) add("--enable-sleep-mode");
+  const auto& kv = spec["kvOffload"];
+  if (kv["enabled"].as_bool()) {
+    add("--kv-offload-cpu-gb");
+    add(std::to_string(kv["cpuOffloadGB"].as_int(8)));
+    if (!kv["remoteURL"].as_string().empty()) {
+      add("--kv-remote-url");
+      add(kv["remoteURL"].as_string());
+    }
+    if (!kv["controllerURL"].as_string().empty()) {
+      add("--kv-controller-url");
+      add(kv["controllerURL"].as_string());
+    }
+    add("--kv-serde");
+    add(kv["serde"].as_string().empty() ? "naive" : kv["serde"].as_string());
+  }
+  return a;
+}
+
+inline json::Value runtime_deployment(const json::Value& cr) {
+  const std::string name = cr.at_path("metadata.name").as_string();
+  const auto& spec = cr["spec"];
+  int port = static_cast<int>(spec.at_path("engineConfig.port").as_int(8100));
+
+  json::Value labels;
+  labels.set("app", name + "-engine");
+  labels.set("model", spec.at_path("model.name").as_string());
+  labels.set("environment", "router");
+  labels.set("release", "router");
+
+  json::Value container;
+  container.set("name", "engine");
+  container.set("image", spec.at_path("image.repository").as_string() + ":" +
+                             spec.at_path("image.tag").as_string());
+  container.set("command", json::Array{json::Value("python")});
+  container.set("args", engine_args(spec));
+  json::Value cport;
+  cport.set("containerPort", port);
+  cport.set("name", "http");
+  container.set("ports", json::Array{cport});
+  json::Value probe;
+  {
+    json::Value httpGet;
+    httpGet.set("path", "/health");
+    httpGet.set("port", port);
+    probe.set("httpGet", httpGet);
+    probe.set("periodSeconds", 10);
+    probe.set("failureThreshold", 60);
+  }
+  container.set("startupProbe", probe);
+  container.set("livenessProbe", probe);
+  {
+    json::Value req;
+    if (spec.has("tpu")) {
+      req.set("google.com/tpu", spec.at_path("tpu.chips").as_int(1));
+    }
+    if (spec.at_path("resources.cpu").is_string())
+      req.set("cpu", spec.at_path("resources.cpu").as_string());
+    if (spec.at_path("resources.memory").is_string())
+      req.set("memory", spec.at_path("resources.memory").as_string());
+    json::Value res;
+    res.set("requests", req);
+    if (spec.has("tpu")) {
+      json::Value lim;
+      lim.set("google.com/tpu", spec.at_path("tpu.chips").as_int(1));
+      res.set("limits", lim);
+    }
+    container.set("resources", res);
+  }
+
+  json::Value podspec;
+  podspec.set("containers", json::Array{container});
+  if (spec.has("tpu")) {
+    json::Value sel;
+    sel.set("cloud.google.com/gke-tpu-accelerator",
+            spec.at_path("tpu.accelerator").as_string());
+    sel.set("cloud.google.com/gke-tpu-topology",
+            spec.at_path("tpu.topology").as_string());
+    podspec.set("nodeSelector", sel);
+  }
+
+  json::Value tmpl;
+  tmpl.set("metadata", json::Value().set("labels", labels));
+  tmpl.set("spec", podspec);
+
+  json::Value dspec;
+  dspec.set("replicas", spec["replicas"].as_int(1));
+  dspec.set("selector",
+            json::Value().set("matchLabels",
+                              json::Value().set("app", name + "-engine")));
+  dspec.set("template", tmpl);
+
+  json::Value d;
+  d.set("apiVersion", "apps/v1");
+  d.set("kind", "Deployment");
+  d.set("metadata", json::Value()
+                        .set("name", name + "-engine")
+                        .set("ownerReferences",
+                             json::Array{owner_ref(cr, "TPURuntime")}));
+  d.set("spec", dspec);
+  return d;
+}
+
+inline json::Value runtime_service(const json::Value& cr) {
+  const std::string name = cr.at_path("metadata.name").as_string();
+  int port =
+      static_cast<int>(cr.at_path("spec.engineConfig.port").as_int(8100));
+  json::Value sport;
+  sport.set("name", "http");
+  sport.set("port", port);
+  sport.set("targetPort", port);
+  json::Value s;
+  s.set("apiVersion", "v1");
+  s.set("kind", "Service");
+  s.set("metadata", json::Value()
+                        .set("name", name + "-engine-service")
+                        .set("ownerReferences",
+                             json::Array{owner_ref(cr, "TPURuntime")}));
+  s.set("spec", json::Value()
+                    .set("selector", json::Value().set("app", name + "-engine"))
+                    .set("ports", json::Array{sport}));
+  return s;
+}
+
+// ---------------------------------------------------------------------------
+// TPURouter -> router Deployment + Service
+
+inline json::Value router_deployment(const json::Value& cr) {
+  const std::string name = cr.at_path("metadata.name").as_string();
+  const auto& spec = cr["spec"];
+  int port = static_cast<int>(spec["port"].as_int(8000));
+
+  json::Array args;
+  auto add = [&](const std::string& s) { args.push_back(json::Value(s)); };
+  add("-m");
+  add("production_stack_tpu.router.app");
+  add("--host");
+  add("0.0.0.0");
+  add("--port");
+  add(std::to_string(port));
+  add("--service-discovery");
+  add(spec["serviceDiscovery"].as_string().empty()
+          ? "k8s"
+          : spec["serviceDiscovery"].as_string());
+  if (!spec["k8sLabelSelector"].as_string().empty()) {
+    add("--k8s-label-selector");
+    add(spec["k8sLabelSelector"].as_string());
+  }
+  add("--routing-logic");
+  add(spec["routingLogic"].as_string().empty()
+          ? "roundrobin"
+          : spec["routingLogic"].as_string());
+  if (!spec["sessionKey"].as_string().empty()) {
+    add("--session-key");
+    add(spec["sessionKey"].as_string());
+  }
+  for (const auto& e : spec["extraArgs"].as_array())
+    args.push_back(e);
+
+  json::Value container;
+  container.set("name", "router");
+  container.set("image", spec.at_path("image.repository").as_string() + ":" +
+                             spec.at_path("image.tag").as_string());
+  container.set("command", json::Array{json::Value("python")});
+  container.set("args", args);
+  json::Value cport;
+  cport.set("containerPort", port);
+  cport.set("name", "http");
+  container.set("ports", json::Array{cport});
+
+  json::Value tmpl;
+  tmpl.set("metadata",
+           json::Value().set("labels", json::Value().set("app", name)));
+  json::Value podspec;
+  podspec.set("serviceAccountName", name + "-sa");
+  podspec.set("containers", json::Array{container});
+  tmpl.set("spec", podspec);
+
+  json::Value d;
+  d.set("apiVersion", "apps/v1");
+  d.set("kind", "Deployment");
+  d.set("metadata", json::Value()
+                        .set("name", name)
+                        .set("ownerReferences",
+                             json::Array{owner_ref(cr, "TPURouter")}));
+  d.set("spec",
+        json::Value()
+            .set("replicas", spec["replicas"].as_int(1))
+            .set("selector", json::Value().set(
+                                 "matchLabels",
+                                 json::Value().set("app", name)))
+            .set("template", tmpl));
+  return d;
+}
+
+inline json::Value router_service(const json::Value& cr) {
+  const std::string name = cr.at_path("metadata.name").as_string();
+  int port = static_cast<int>(cr.at_path("spec.port").as_int(8000));
+  json::Value sport;
+  sport.set("name", "http");
+  sport.set("port", cr.at_path("spec.servicePort").as_int(80));
+  sport.set("targetPort", port);
+  json::Value s;
+  s.set("apiVersion", "v1");
+  s.set("kind", "Service");
+  s.set("metadata", json::Value()
+                        .set("name", name + "-service")
+                        .set("ownerReferences",
+                             json::Array{owner_ref(cr, "TPURouter")}));
+  s.set("spec", json::Value()
+                    .set("selector", json::Value().set("app", name))
+                    .set("ports", json::Array{sport}));
+  return s;
+}
+
+// ---------------------------------------------------------------------------
+// TPUCacheServer -> Deployment + Service
+
+inline json::Value cacheserver_deployment(const json::Value& cr) {
+  const std::string name = cr.at_path("metadata.name").as_string();
+  const auto& spec = cr["spec"];
+  int port = static_cast<int>(spec["port"].as_int(8200));
+  json::Array args;
+  for (const std::string& s :
+       {std::string("-m"), std::string("production_stack_tpu.kvoffload.cache_server"),
+        std::string("--host"), std::string("0.0.0.0"), std::string("--port"),
+        std::to_string(port), std::string("--max-bytes"),
+        std::to_string(spec["maxBytes"].as_int(4LL << 30))})
+    args.push_back(json::Value(s));
+  json::Value container;
+  container.set("name", "cache-server");
+  container.set("image", spec.at_path("image.repository").as_string() + ":" +
+                             spec.at_path("image.tag").as_string());
+  container.set("command", json::Array{json::Value("python")});
+  container.set("args", args);
+  json::Value cport;
+  cport.set("containerPort", port);
+  container.set("ports", json::Array{cport});
+
+  json::Value tmpl;
+  tmpl.set("metadata",
+           json::Value().set("labels", json::Value().set("app", name)));
+  tmpl.set("spec", json::Value().set("containers", json::Array{container}));
+
+  json::Value d;
+  d.set("apiVersion", "apps/v1");
+  d.set("kind", "Deployment");
+  d.set("metadata", json::Value()
+                        .set("name", name)
+                        .set("ownerReferences",
+                             json::Array{owner_ref(cr, "TPUCacheServer")}));
+  d.set("spec",
+        json::Value()
+            .set("replicas", spec["replicas"].as_int(1))
+            .set("selector", json::Value().set(
+                                 "matchLabels", json::Value().set("app", name)))
+            .set("template", tmpl));
+  return d;
+}
+
+inline json::Value cacheserver_service(const json::Value& cr) {
+  const std::string name = cr.at_path("metadata.name").as_string();
+  int port = static_cast<int>(cr.at_path("spec.port").as_int(8200));
+  json::Value sport;
+  sport.set("port", port);
+  sport.set("targetPort", port);
+  json::Value s;
+  s.set("apiVersion", "v1");
+  s.set("kind", "Service");
+  s.set("metadata", json::Value()
+                        .set("name", name)
+                        .set("ownerReferences",
+                             json::Array{owner_ref(cr, "TPUCacheServer")}));
+  s.set("spec", json::Value()
+                    .set("selector", json::Value().set("app", name))
+                    .set("ports", json::Array{sport}));
+  return s;
+}
+
+// ---------------------------------------------------------------------------
+// Reconcile drivers
+
+class Reconciler {
+ public:
+  Reconciler(k8s::Client& kc, std::string ns) : kc_(kc), ns_(std::move(ns)) {}
+
+  // one pass over all CRs of every kind; returns number of CRs seen
+  int reconcile_all() {
+    int n = 0;
+    n += reconcile_kind("tpuruntimes", [this](const json::Value& cr) {
+      apply(kc_, "apps", "v1", ns_, "deployments", runtime_deployment(cr));
+      apply(kc_, "", "v1", ns_, "services", runtime_service(cr));
+      update_runtime_status(cr);
+    });
+    n += reconcile_kind("tpurouters", [this](const json::Value& cr) {
+      apply(kc_, "apps", "v1", ns_, "deployments", router_deployment(cr));
+      apply(kc_, "", "v1", ns_, "services", router_service(cr));
+    });
+    n += reconcile_kind("tpucacheservers", [this](const json::Value& cr) {
+      apply(kc_, "apps", "v1", ns_, "deployments", cacheserver_deployment(cr));
+      apply(kc_, "", "v1", ns_, "services", cacheserver_service(cr));
+    });
+    n += reconcile_kind("loraadapters", [this](const json::Value& cr) {
+      reconcile_lora(cr);
+    });
+    return n;
+  }
+
+ private:
+  int reconcile_kind(const std::string& plural,
+                     const std::function<void(const json::Value&)>& fn) {
+    json::Value list;
+    try {
+      list = kc_.list(k8s::kGroup, k8s::kVersion, ns_, plural);
+    } catch (const std::exception&) {
+      return 0;  // CRD not installed (or apiserver hiccup); try next resync
+    }
+    int n = 0;
+    for (const auto& cr : list["items"].as_array()) {
+      try {
+        fn(cr);
+        n++;
+      } catch (const std::exception& e) {
+        fprintf(stderr, "reconcile %s/%s failed: %s\n", plural.c_str(),
+                cr.at_path("metadata.name").as_string().c_str(), e.what());
+      }
+    }
+    return n;
+  }
+
+  void update_runtime_status(const json::Value& cr) {
+    const std::string name = cr.at_path("metadata.name").as_string();
+    auto dep = kc_.get("apps", "v1", ns_, "deployments", name + "-engine");
+    json::Value status;
+    int64_t ready =
+        dep ? (*dep).at_path("status.readyReplicas").as_int(0) : 0;
+    int64_t want = cr.at_path("spec.replicas").as_int(1);
+    status.set("readyReplicas", ready);
+    status.set("modelStatus", ready >= want ? "Ready" : "Pending");
+    json::Value crcopy = cr;
+    crcopy.set("status", status);
+    try {
+      kc_.update_status(k8s::kGroup, k8s::kVersion, ns_, "tpuruntimes", name,
+                        crcopy);
+    } catch (const std::exception&) {
+      // status subresource may be disabled on the fake apiserver; non-fatal
+    }
+  }
+
+  // LoRA: POST load_lora_adapter to every ready pod matching the selector
+  // (reference loraadapter_controller.go:403-616, simplified placement: all
+  // matching pods).
+  void reconcile_lora(const json::Value& cr) {
+    const auto& spec = cr["spec"];
+    const std::string selector =
+        spec["podLabelSelector"].as_string().empty()
+            ? "model=" + spec.at_path("baseModel").as_string()
+            : spec["podLabelSelector"].as_string();
+    auto pods = kc_.list("", "v1", ns_, "pods", selector);
+    json::Value body;
+    body.set("lora_name", cr.at_path("metadata.name").as_string());
+    body.set("lora_path", spec.at_path("source.path").as_string());
+    json::Array loaded;
+    for (const auto& pod : pods["items"].as_array()) {
+      const std::string ip = pod.at_path("status.podIP").as_string();
+      if (ip.empty()) continue;
+      int port = static_cast<int>(spec["enginePort"].as_int(8100));
+      try {
+        int code =
+            k8s::Client::post_url(ip, port, "/v1/load_lora_adapter", body.dump());
+        if (code == 200)
+          loaded.push_back(pod.at_path("metadata.name").as_string());
+      } catch (const std::exception&) {
+      }
+    }
+    json::Value crcopy = cr;
+    json::Value status;
+    status.set("loadedPods", loaded);
+    status.set("phase", loaded.empty() ? "Pending" : "Loaded");
+    crcopy.set("status", status);
+    try {
+      kc_.update_status(k8s::kGroup, k8s::kVersion, ns_, "loraadapters",
+                        cr.at_path("metadata.name").as_string(), crcopy);
+    } catch (const std::exception&) {
+    }
+  }
+
+  k8s::Client& kc_;
+  std::string ns_;
+};
+
+}  // namespace op
