@@ -44,7 +44,9 @@ class RequestOutput:
 class LLMEngine:
     def __init__(self, cfg: EngineConfig, mesh=None):
         self.cfg = cfg
-        model_cfg, params = load_model(cfg.model, seed=cfg.seed, max_model_len=cfg.max_model_len)
+        model_mod, model_cfg, params = load_model(
+            cfg.model, seed=cfg.seed, max_model_len=cfg.max_model_len
+        )
         self.model_cfg = model_cfg
         self.tokenizer = load_tokenizer(
             cfg.tokenizer or (cfg.model if "/" in cfg.model or cfg.model.startswith(".") else None)
@@ -59,7 +61,7 @@ class LLMEngine:
         if mesh is None:
             mesh = make_mesh(tp=cfg.tensor_parallel_size, dp=cfg.data_parallel_size)
         self.runner = ModelRunner(
-            model_cfg, mesh=mesh, params=params,
+            model_cfg, mesh=mesh, params=params, module=model_mod,
             num_pages=num_pages, page_size=cfg.page_size, seed=cfg.seed,
         )
         self._offload = self._make_offload_connector(cfg)

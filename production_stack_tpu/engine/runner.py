@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from production_stack_tpu.models import llama
+from production_stack_tpu import models
 from production_stack_tpu.ops.sampling import sample
 from production_stack_tpu.parallel import shardings
 from production_stack_tpu.parallel.mesh import make_mesh
@@ -44,14 +44,16 @@ class ModelRunner:
 
     def __init__(
         self,
-        cfg: llama.LlamaConfig,
+        cfg,
         *,
         mesh: Optional[Mesh] = None,
         params: Optional[dict] = None,
         num_pages: int = 512,
         page_size: int = 16,
         seed: int = 0,
+        module=None,
     ):
+        self.module = module if module is not None else models.module_for_config(cfg)
         self.cfg = cfg
         self.page_size = page_size
         self.num_pages = num_pages
@@ -68,10 +70,10 @@ class ModelRunner:
             self.cfg = cfg
 
         if params is None:
-            params = llama.init_params(cfg, jax.random.key(seed))
+            params = self.module.init_params(cfg, jax.random.key(seed))
         pspecs = shardings.param_specs_for(params)
         self.params = shardings.shard_tree(params, pspecs, self.mesh)
-        kp, vp = llama.init_kv_pages(cfg, num_pages, page_size)
+        kp, vp = self.module.init_kv_pages(cfg, num_pages, page_size)
         kv_sh = NamedSharding(self.mesh, shardings.KV_PAGES_SPEC)
         self.k_pages = jax.device_put(kp, kv_sh)
         self.v_pages = jax.device_put(vp, kv_sh)
@@ -80,7 +82,7 @@ class ModelRunner:
         self._row_sh = NamedSharding(self.mesh, shardings.BATCH_SPECS["input_ids"])
         self._vec_sh = NamedSharding(self.mesh, shardings.BATCH_SPECS["kv_lens"])
         self._step = jax.jit(
-            functools.partial(_step_fn, cfg),
+            functools.partial(_step_fn, self.module.forward, cfg),
             donate_argnums=(1, 2),
         )
         self._set_page_fn = None  # built lazily in set_page
@@ -125,15 +127,15 @@ class ModelRunner:
 
     def reset_kv(self) -> None:
         """Zero the page pools (sleep/wake support frees and re-creates them)."""
-        kp, vp = llama.init_kv_pages(self.cfg, self.num_pages, self.page_size)
+        kp, vp = self.module.init_kv_pages(self.cfg, self.num_pages, self.page_size)
         kv_sh = NamedSharding(self.mesh, shardings.KV_PAGES_SPEC)
         self.k_pages = jax.device_put(kp, kv_sh)
         self.v_pages = jax.device_put(vp, kv_sh)
 
 
-def _step_fn(cfg, params, k_pages, v_pages, input_ids, positions, page_table,
-             kv_lens, temperature, top_k, top_p, key):
-    logits, k_pages, v_pages = llama.forward(
+def _step_fn(forward, cfg, params, k_pages, v_pages, input_ids, positions,
+             page_table, kv_lens, temperature, top_k, top_p, key):
+    logits, k_pages, v_pages = forward(
         params, cfg, input_ids, positions, k_pages, v_pages, page_table, kv_lens
     )
     ids = sample(logits, key, temperature, top_k, top_p)

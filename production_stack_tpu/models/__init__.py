@@ -1,0 +1,57 @@
+"""Model registry.
+
+Every model module exposes the same duck-typed interface consumed by
+engine/runner.py and engine/model_loader.py:
+
+- ``Config`` dataclass (``from_hf_config``, ``attn_impl``, ``num_layers``,
+  ``num_kv_heads``, ``head_dim``, ``max_model_len``, ``dtype``)
+- ``PRESETS: dict[str, Config]``
+- ``init_params(cfg, key)`` / ``init_kv_pages(cfg, num_pages, page_size)``
+- ``forward(params, cfg, input_ids, positions, k_pages, v_pages, page_table,
+  kv_lens) -> (logits, k_pages, v_pages)``
+
+Sharding specs are name-based (parallel/shardings.py) so new families only
+need to reuse the leaf-name vocabulary or extend the spec tables.
+"""
+
+from __future__ import annotations
+
+from production_stack_tpu.models import llama, opt
+
+#: module search order for preset names and HF architectures
+MODULES = (llama, opt)
+
+_ARCH_TO_MODULE = {
+    "LlamaForCausalLM": llama,
+    "MistralForCausalLM": llama,
+    "Qwen2ForCausalLM": llama,
+    "MixtralForCausalLM": llama,
+    "OPTForCausalLM": opt,
+}
+
+
+def module_for_arch(arch: str):
+    """Map a HuggingFace `architectures[0]` string to a model module."""
+    try:
+        return _ARCH_TO_MODULE[arch]
+    except KeyError:
+        raise ValueError(
+            f"unsupported architecture {arch!r}; supported: {sorted(_ARCH_TO_MODULE)}"
+        ) from None
+
+
+def module_for_config(cfg):
+    """Map a model config instance back to its module."""
+    if isinstance(cfg, llama.LlamaConfig):
+        return llama
+    if isinstance(cfg, opt.OPTConfig):
+        return opt
+    raise ValueError(f"unknown model config type {type(cfg).__name__}")
+
+
+def find_preset(name: str):
+    """Return (module, config) for a preset name, or None."""
+    for mod in MODULES:
+        if name in mod.PRESETS:
+            return mod, mod.PRESETS[name]
+    return None

@@ -1,5 +1,12 @@
-"""Llama family (Llama 2/3/3.x, and by config also Mistral/Qwen2-sans-bias) as
+"""Llama superfamily (Llama 2/3/3.x, Mistral, Qwen2/2.5, Mixtral-MoE) as
 pure functional JAX.
+
+One forward covers the whole family through static config switches (resolved at
+trace time, so each variant still compiles to a single straight-line program):
+``attention_bias`` (Qwen2), ``sliding_window`` (Mistral/Qwen2),
+``num_experts>0`` (Mixtral sparse-MoE MLP with top-k routing; expert weights
+carry a leading [E] axis sharded on the ``ep`` mesh axis — SURVEY.md §2.3
+"mesh axis reserved" made real).
 
 TPU-first choices:
 - Layers are *stacked*: every per-layer weight is one array with a leading
@@ -45,6 +52,10 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     max_model_len: int = 8192
     tie_word_embeddings: bool = False
+    attention_bias: bool = False          # Qwen2: bias on q/k/v projections
+    sliding_window: Optional[int] = None  # Mistral/Qwen2: windowed attention
+    num_experts: int = 0                  # Mixtral: >0 switches MLP to sparse MoE
+    num_experts_per_tok: int = 2
     dtype: Any = jnp.bfloat16
     # decode attention implementation: "auto" (ModelRunner resolves), "xla"
     # (gather + flash, partitions under GSPMD), "pallas" (page-streaming
@@ -54,7 +65,10 @@ class LlamaConfig:
 
     @staticmethod
     def from_hf_config(cfg: dict) -> "LlamaConfig":
-        """Build from a HuggingFace `config.json` dict (LlamaForCausalLM etc.)."""
+        """Build from a HuggingFace `config.json` dict. Handles
+        LlamaForCausalLM, MistralForCausalLM, Qwen2ForCausalLM, and
+        MixtralForCausalLM (arch read from `architectures[0]`)."""
+        arch = (cfg.get("architectures") or ["LlamaForCausalLM"])[0]
         scaling = None
         rs = cfg.get("rope_scaling") or None
         if rs and rs.get("rope_type", rs.get("type")) == "llama3":
@@ -66,6 +80,10 @@ class LlamaConfig:
             )
         hidden = cfg["hidden_size"]
         heads = cfg["num_attention_heads"]
+        # Qwen2 always biases q/k/v; Mistral/Qwen2 may window attention.
+        window = cfg.get("sliding_window")
+        if arch.startswith("Qwen2") and not cfg.get("use_sliding_window", False):
+            window = None
         return LlamaConfig(
             vocab_size=cfg["vocab_size"],
             hidden_size=hidden,
@@ -73,12 +91,18 @@ class LlamaConfig:
             num_layers=cfg["num_hidden_layers"],
             num_heads=heads,
             num_kv_heads=cfg.get("num_key_value_heads", heads),
-            head_dim=cfg.get("head_dim", hidden // heads),
+            head_dim=cfg.get("head_dim") or hidden // heads,
             rope_theta=cfg.get("rope_theta", 10000.0),
             rope_scaling=scaling,
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_model_len=cfg.get("max_position_embeddings", 8192),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            attention_bias=cfg.get("attention_bias", arch.startswith("Qwen2")),
+            sliding_window=window,
+            num_experts=cfg.get("num_local_experts", 0)
+            if arch.startswith("Mixtral")
+            else 0,
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
         )
 
 
@@ -95,6 +119,31 @@ PRESETS: dict[str, LlamaConfig] = {
         rope_scaling=RopeScaling(factor=32.0),
         tie_word_embeddings=True,
     ),
+    "mistral-7b": LlamaConfig(
+        vocab_size=32000,
+        rope_theta=10000.0,
+        sliding_window=4096,
+        max_model_len=32768,
+    ),
+    "qwen2.5-7b": LlamaConfig(
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        attention_bias=True,
+        max_model_len=32768,
+    ),
+    "mixtral-8x7b": LlamaConfig(
+        vocab_size=32000,
+        rope_theta=1000000.0,
+        num_experts=8,
+        num_experts_per_tok=2,
+        max_model_len=32768,
+    ),
     "llama-debug": LlamaConfig(
         vocab_size=512,
         hidden_size=128,
@@ -109,6 +158,17 @@ PRESETS: dict[str, LlamaConfig] = {
 }
 
 
+def _debug_variant(**kw) -> LlamaConfig:
+    import dataclasses as _dc
+
+    return _dc.replace(PRESETS["llama-debug"], **kw)
+
+
+PRESETS["qwen2-debug"] = _debug_variant(attention_bias=True)
+PRESETS["mistral-debug"] = _debug_variant(sliding_window=8)
+PRESETS["mixtral-debug"] = _debug_variant(num_experts=4, num_experts_per_tok=2)
+
+
 def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
     """Random-normal initialized parameter tree (layer-stacked)."""
     k_embed, k_layers, k_head = jax.random.split(key, 3)
@@ -118,21 +178,33 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> dict:
     def normal(key, shape, scale):
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
     scale = H**-0.5
+    layers: dict = {
+        "attn_norm": jnp.ones((L, H), cfg.dtype),
+        "wq": normal(ks[0], (L, H, NH * D), scale),
+        "wk": normal(ks[1], (L, H, KH * D), scale),
+        "wv": normal(ks[2], (L, H, KH * D), scale),
+        "wo": normal(ks[3], (L, NH * D, H), (NH * D) ** -0.5),
+        "mlp_norm": jnp.ones((L, H), cfg.dtype),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((L, NH * D), cfg.dtype)
+        layers["bk"] = jnp.zeros((L, KH * D), cfg.dtype)
+        layers["bv"] = jnp.zeros((L, KH * D), cfg.dtype)
+    if cfg.num_experts:
+        E = cfg.num_experts
+        layers["moe_router"] = normal(ks[7], (L, H, E), scale)
+        layers["moe_gate"] = normal(ks[4], (L, E, H, I), scale)
+        layers["moe_up"] = normal(ks[5], (L, E, H, I), scale)
+        layers["moe_down"] = normal(ks[6], (L, E, I, H), I**-0.5)
+    else:
+        layers["w_gate"] = normal(ks[4], (L, H, I), scale)
+        layers["w_up"] = normal(ks[5], (L, H, I), scale)
+        layers["w_down"] = normal(ks[6], (L, I, H), I**-0.5)
     params = {
         "embed": normal(k_embed, (cfg.vocab_size, H), scale),
-        "layers": {
-            "attn_norm": jnp.ones((L, H), cfg.dtype),
-            "wq": normal(ks[0], (L, H, NH * D), scale),
-            "wk": normal(ks[1], (L, H, KH * D), scale),
-            "wv": normal(ks[2], (L, H, KH * D), scale),
-            "wo": normal(ks[3], (L, NH * D, H), (NH * D) ** -0.5),
-            "mlp_norm": jnp.ones((L, H), cfg.dtype),
-            "w_gate": normal(ks[4], (L, H, I), scale),
-            "w_up": normal(ks[5], (L, H, I), scale),
-            "w_down": normal(ks[6], (L, I, H), I**-0.5),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((H,), cfg.dtype),
     }
     if not cfg.tie_word_embeddings:
@@ -147,6 +219,33 @@ def init_kv_pages(
     dtype = dtype or cfg.dtype
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _moe_block(h: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
+    """Mixtral sparse-MoE MLP, computed densely over experts.
+
+    Routing follows HF Mixtral: softmax over all experts, take top-k, renormalize.
+    The dispatch is *dense* — every token multiplies every expert, with
+    non-selected experts zeroed by the gate — which XLA maps cleanly onto the
+    MXU with static shapes. With expert weights sharded on the ``ep`` mesh axis
+    each device computes only its E/ep experts and the final contraction over E
+    becomes one psum over ICI (classic expert parallelism). A sort-based
+    capacity dispatch (token-choice) is the future optimization for large E at
+    small batch; at serving batch sizes the dense form wins on compile
+    simplicity and avoids ragged all-to-alls.
+    """
+    B, T, H = h.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    router_logits = (h @ lp["moe_router"]).astype(jnp.float32)     # [B, T, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    topw, topi = lax.top_k(probs, K)                               # [B, T, K]
+    topw = topw / jnp.maximum(topw.sum(-1, keepdims=True), 1e-9)
+    # scatter the renormalized top-k weights back to a dense [B, T, E] gate
+    gate = (jax.nn.one_hot(topi, E, dtype=jnp.float32) * topw[..., None]).sum(-2)
+    g = jnp.einsum("bth,ehi->btei", h, lp["moe_gate"])
+    u = jnp.einsum("bth,ehi->btei", h, lp["moe_up"])
+    y = jax.nn.silu(g) * u * gate.astype(h.dtype)[..., None]
+    return jnp.einsum("btei,eih->bth", y, lp["moe_down"])
 
 
 def forward(
@@ -183,10 +282,14 @@ def forward(
         q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
         k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        if cfg.attention_bias:
+            q = q + lp["bq"].reshape(cfg.num_heads, cfg.head_dim)
+            k = k + lp["bk"].reshape(cfg.num_kv_heads, cfg.head_dim)
+            v = v + lp["bv"].reshape(cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         kp, vp = write_kv_pages(kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions)
-        if T == 1 and cfg.attn_impl.startswith("pallas"):
+        if T == 1 and cfg.attn_impl.startswith("pallas") and cfg.sliding_window is None:
             # decode: stream pages HBM->VMEM, no gather materialization
             from production_stack_tpu.ops.pallas.paged_attention import (
                 ragged_paged_attention_decode,
@@ -198,10 +301,16 @@ def forward(
             )[:, None]
         else:
             kc, vc = gather_kv_pages(kp, vp, page_table)
-            attn = flash_attention(q, kc, vc, q_positions=positions, kv_lens=kv_lens)
+            attn = flash_attention(
+                q, kc, vc, q_positions=positions, kv_lens=kv_lens,
+                window=cfg.sliding_window,
+            )
         x = x + attn.reshape(B, T, -1) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+        if cfg.num_experts:
+            x = x + _moe_block(h, lp, cfg)
+        else:
+            x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
         return x, (kp, vp)
 
     x, (k_pages, v_pages) = lax.scan(layer, x, (params["layers"], k_pages, v_pages))

@@ -95,6 +95,7 @@ def flash_attention(
     *,
     sm_scale: float | None = None,
     block_size: int = 512,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Online-softmax attention scanned over KV blocks (GQA-aware).
 
@@ -105,6 +106,8 @@ def flash_attention(
       kv_lens:     [B] valid KV length per sequence.
       sm_scale:    softmax scale; defaults to D**-0.5.
       block_size:  KV block per scan step (memory/compute tradeoff).
+      window:      sliding-window size (Mistral-style): query at position p sees
+                   KV positions (p - window, p]. None = full causal.
 
     Returns [B, T, NH, D] in q.dtype. A KV index j is visible to query at
     position p iff j <= p and j < kv_len (causal within the real sequence).
@@ -138,6 +141,8 @@ def flash_attention(
         visible = (idx[None, None, :] <= q_positions[:, :, None]) & (
             idx[None, None, :] < kv_lens[:, None, None]
         )  # [B, T, bs]
+        if window is not None:
+            visible &= idx[None, None, :] > q_positions[:, :, None] - window
         scores = jnp.where(visible[:, :, None, None, :], scores, NEG_INF)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         # Guard exp(NEG_INF - NEG_INF) for fully masked rows.

@@ -16,22 +16,46 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# Llama-family parameter tree -> PartitionSpec (leading None = stacked layer axis).
-LLAMA_PARAM_SPECS = {
+# Per-parameter PartitionSpecs, keyed by leaf name. Top-level leaves are plain
+# tensors; leaves under "layers" are layer-stacked and get a leading None for
+# the [L] axis prepended by param_specs_for. Covers every model family
+# (Llama/Mistral/Qwen2/Mixtral in models/llama.py, OPT in models/opt.py).
+_TOP_SPECS = {
     "embed": P("tp", None),            # vocab-sharded; GSPMD handles the gather
-    "layers": {
-        "attn_norm": P(None, None),
-        "wq": P(None, None, "tp"),     # column parallel
-        "wk": P(None, None, "tp"),
-        "wv": P(None, None, "tp"),
-        "wo": P(None, "tp", None),     # row parallel
-        "mlp_norm": P(None, None),
-        "w_gate": P(None, None, "tp"),
-        "w_up": P(None, None, "tp"),
-        "w_down": P(None, "tp", None),
-    },
+    "pos_embed": P(None, None),
     "final_norm": P(None),
+    "final_norm_w": P(None),
+    "final_norm_b": P(None),
     "lm_head": P(None, "tp"),
+}
+_LAYER_SPECS = {
+    "attn_norm": P(None),
+    "attn_norm_w": P(None),
+    "attn_norm_b": P(None),
+    "wq": P(None, "tp"),               # column parallel (+ bias on the out dim)
+    "bq": P("tp"),
+    "wk": P(None, "tp"),
+    "bk": P("tp"),
+    "wv": P(None, "tp"),
+    "bv": P("tp"),
+    "wo": P("tp", None),               # row parallel (bias after the all-reduce)
+    "bo": P(None),
+    "mlp_norm": P(None),
+    "mlp_norm_w": P(None),
+    "mlp_norm_b": P(None),
+    "w_gate": P(None, "tp"),
+    "w_up": P(None, "tp"),
+    "w_down": P("tp", None),
+    "fc1": P(None, "tp"),
+    "fc1_b": P("tp"),
+    "fc2": P("tp", None),
+    "fc2_b": P(None),
+    # MoE (Mixtral): experts sharded over ep, each expert's FFN over tp — the
+    # contraction over E inserts one psum over the ep axis (expert parallelism).
+    "moe_router": P(None, None),
+    "moe_gate": P("ep", None, "tp"),
+    "moe_up": P("ep", None, "tp"),
+    "moe_down": P("ep", "tp", None),
 }
 
 # [L, P, page_size, KH, D] pools: shard kv heads over tp.
@@ -47,9 +71,14 @@ BATCH_SPECS = {
 
 
 def param_specs_for(params: dict) -> dict:
-    """LLAMA_PARAM_SPECS restricted to the keys present (tied embeddings drop
-    lm_head)."""
-    specs = {k: v for k, v in LLAMA_PARAM_SPECS.items() if k in params}
+    """PartitionSpec tree matching the structure of `params` (any model
+    family), built from the per-leaf-name tables above."""
+    specs: dict = {}
+    for k, v in params.items():
+        if k == "layers":
+            specs[k] = {n: P(None, *_LAYER_SPECS[n]) for n in v}
+        else:
+            specs[k] = _TOP_SPECS[k]
     return specs
 
 
