@@ -68,20 +68,30 @@ class EngineServer:
         return web.json_response({"version": __version__})
 
     async def models(self, request: web.Request) -> web.Response:
-        return web.json_response(
+        data = [
             {
-                "object": "list",
-                "data": [
-                    {
-                        "id": self.cfg.name,
-                        "object": "model",
-                        "created": int(self.start_time),
-                        "owned_by": "production-stack-tpu",
-                        "max_model_len": self.cfg.max_model_len,
-                    }
-                ],
+                "id": self.cfg.name,
+                "object": "model",
+                "created": int(self.start_time),
+                "owned_by": "production-stack-tpu",
+                "max_model_len": self.cfg.max_model_len,
             }
-        )
+        ]
+        # loaded LoRA adapters appear as servable models with a parent pointer
+        # (vLLM convention; the reference LoraAdapter controller and router
+        # model discovery both read this listing)
+        for name in self.engine.list_lora_adapters():
+            data.append(
+                {
+                    "id": name,
+                    "object": "model",
+                    "created": int(self.start_time),
+                    "owned_by": "production-stack-tpu",
+                    "parent": self.cfg.name,
+                    "max_model_len": self.cfg.max_model_len,
+                }
+            )
+        return web.json_response({"object": "list", "data": data})
 
     async def tokenize(self, request: web.Request) -> web.Response:
         body = await request.json()
@@ -148,6 +158,16 @@ class EngineServer:
         if self.engine.is_sleeping:
             return web.json_response({"error": "engine is sleeping"}, status=503)
         model = body.get("model", self.cfg.name)
+        lora_name = None
+        if model != self.cfg.name:
+            if self.engine.lora is not None and self.engine.lora.is_adapter(model):
+                lora_name = model
+            else:
+                return web.json_response(
+                    {"error": {"message": f"model {model!r} does not exist",
+                               "type": "NotFoundError", "code": 404}},
+                    status=404,
+                )
         req_id = request.headers.get("X-Request-Id") or f"req-{uuid.uuid4().hex[:16]}"
         params = _sampling_params(body)
         stream = bool(body.get("stream", False))
@@ -170,7 +190,9 @@ class EngineServer:
                 },
                 status=400,
             )
-        gen = self.engine.generate(req_id, prompt_token_ids=prompt_ids, params=params)
+        gen = self.engine.generate(
+            req_id, prompt_token_ids=prompt_ids, params=params, lora_name=lora_name
+        )
 
         if not stream:
             text, finish_reason, last = [], None, None
@@ -283,16 +305,32 @@ class EngineServer:
         return web.json_response({"is_sleeping": self.engine.is_sleeping})
 
     async def load_lora_adapter(self, request: web.Request) -> web.Response:
+        """Contract parity: the reference LoraAdapter controller POSTs
+        {lora_name, lora_path} here (loraadapter_controller.go:586-601)."""
         body = await request.json()
-        return web.json_response(
-            {"status": "accepted", "lora_name": body.get("lora_name")},
-        )
+        name, path = body.get("lora_name"), body.get("lora_path")
+        if not name or not path:
+            return web.json_response(
+                {"error": "lora_name and lora_path are required"}, status=400
+            )
+        try:
+            slot = await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.load_lora_adapter, name, path
+            )
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"status": "success", "lora_name": name, "slot": slot})
 
     async def unload_lora_adapter(self, request: web.Request) -> web.Response:
         body = await request.json()
-        return web.json_response(
-            {"status": "accepted", "lora_name": body.get("lora_name")},
-        )
+        name = body.get("lora_name")
+        if not name:
+            return web.json_response({"error": "lora_name is required"}, status=400)
+        try:
+            self.engine.unload_lora_adapter(name)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"status": "success", "lora_name": name})
 
     # -- app ---------------------------------------------------------------
 

@@ -33,6 +33,12 @@ class EngineConfig:
     data_parallel_size: int = 1
     enable_sleep_mode: bool = False
     seed: int = 0
+    # multi-LoRA serving (reference: vLLM --enable-lora + load/unload endpoints,
+    # helm/templates/deployment-vllm-multi.yaml:197-207)
+    enable_lora: bool = False
+    max_loras: int = 4
+    max_lora_rank: int = 16
+    lora_target_modules: str = "q_proj,k_proj,v_proj,o_proj"
     # KV offload (LMCache-equivalent) wiring
     kv_offload_cpu_gb: float = 0.0
     kv_offload_dir: Optional[str] = None

@@ -22,6 +22,7 @@ from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.kv_manager import KVPageManager
 from production_stack_tpu.engine.model_loader import load_model
 from production_stack_tpu.engine.runner import ModelRunner, StepInput
+from production_stack_tpu.engine.lora import LoRAManager
 from production_stack_tpu.engine.scheduler import SamplingParams, ScheduledBatch, Scheduler, Sequence
 from production_stack_tpu.engine.tokenizer import load_tokenizer
 from production_stack_tpu.utils.logging import init_logger
@@ -60,10 +61,28 @@ class LLMEngine:
 
         if mesh is None:
             mesh = make_mesh(tp=cfg.tensor_parallel_size, dp=cfg.data_parallel_size)
+        lora_targets = ()
+        if cfg.enable_lora:
+            from production_stack_tpu.engine.lora import _HF_TO_LEAF
+
+            mods = [m.strip() for m in cfg.lora_target_modules.split(",") if m.strip()]
+            bad = [m for m in mods if m not in _HF_TO_LEAF]
+            if bad:
+                raise ValueError(
+                    f"unknown --lora-target-modules {bad}; valid: {sorted(_HF_TO_LEAF)}"
+                )
+            lora_targets = tuple(_HF_TO_LEAF[m] for m in mods)
         self.runner = ModelRunner(
             model_cfg, mesh=mesh, params=params, module=model_mod,
             num_pages=num_pages, page_size=cfg.page_size, seed=cfg.seed,
+            enable_lora=cfg.enable_lora, max_loras=cfg.max_loras,
+            max_lora_rank=cfg.max_lora_rank, lora_targets=lora_targets,
         )
+        self.lora: Optional[LoRAManager] = None
+        if cfg.enable_lora:
+            self.lora = LoRAManager(
+                self.runner, max_loras=cfg.max_loras, max_rank=cfg.max_lora_rank
+            )
         self._offload = self._make_offload_connector(cfg)
         self.kv = KVPageManager(num_pages, cfg.page_size, offload=self._offload)
         # disaggregated prefill (SURVEY.md §2.3): producer pushes finished
@@ -173,8 +192,17 @@ class LLMEngine:
         prompt: Optional[str] = None,
         prompt_token_ids: Optional[list[int]] = None,
         params: Optional[SamplingParams] = None,
+        lora_name: Optional[str] = None,
     ) -> AsyncIterator[RequestOutput]:
         params = params or SamplingParams()
+        lora_slot, cache_salt = 0, b""
+        if lora_name:
+            if self.lora is None:
+                raise ValueError("LoRA is not enabled (--enable-lora)")
+            if not self.lora.is_adapter(lora_name):
+                raise ValueError(f"LoRA adapter {lora_name!r} is not loaded")
+            lora_slot = self.lora.slot_for(lora_name)
+            cache_salt = self.lora.cache_salt(lora_name)
         if prompt_token_ids is None:
             prompt_token_ids = self.tokenizer.encode(prompt or "")
         if not prompt_token_ids:
@@ -191,7 +219,10 @@ class LLMEngine:
         with self._lock:
             self._outputs[seq_id] = (loop, out_q)
             self._texts[seq_id] = ""
-        seq = Sequence(seq_id=seq_id, prompt_ids=list(prompt_token_ids), params=params)
+        seq = Sequence(
+            seq_id=seq_id, prompt_ids=list(prompt_token_ids), params=params,
+            lora_slot=lora_slot, cache_salt=cache_salt,
+        )
         self._inbox.put(seq)
         try:
             while True:
@@ -220,7 +251,9 @@ class LLMEngine:
             block = False
             if item is None:
                 return
-            if isinstance(item, tuple) and item[0] == "abort":
+            if isinstance(item, tuple) and item[0] == "lora_cmd":
+                item[1]()  # adapter load/unload, serialized with the step loop
+            elif isinstance(item, tuple) and item[0] == "abort":
                 for s in self.scheduler.waiting + self.scheduler.running:
                     if s.seq_id == item[1] and not s.finished:
                         self.scheduler._finish(s, "abort")
@@ -246,6 +279,7 @@ class LLMEngine:
                     StepInput(
                         batch.input_ids, batch.positions, batch.page_table,
                         batch.kv_lens, batch.temperature, batch.top_k, batch.top_p,
+                        lora_ids=batch.lora_ids,
                     )
                 )
                 tokens = np.asarray(ids)
@@ -281,7 +315,7 @@ class LLMEngine:
         from production_stack_tpu.engine.kv_manager import prefix_hashes
 
         tokens = seq.prompt_ids + seq.output_ids
-        for h in prefix_hashes(tokens, self.kv.page_size):
+        for h in prefix_hashes(tokens, self.kv.page_size, seq.cache_salt):
             pid = self.kv.hash_to_page.get(h)
             if pid is None:
                 continue
@@ -339,6 +373,60 @@ class LLMEngine:
         loop.call_soon_threadsafe(out_q.put_nowait, out)
 
     # -- sleep / wake (engine contract: /sleep /wake_up /is_sleeping) -------
+
+    def _lora_cmd(self, op: str, name: str, path: Optional[str] = None):
+        """Run a LoRA load/unload. Device-buffer writes must not race the step
+        loop (the slot update donates the live buffers), so when the engine
+        loop is running the command is executed *by the device thread* between
+        steps; otherwise it runs inline."""
+        if self.lora is None:
+            raise ValueError("LoRA is not enabled (--enable-lora)")
+
+        def run():
+            if op == "load":
+                return self.lora.load(name, path)
+            slot = self.lora.slot_for(name)  # 0 when not loaded
+            in_use = slot != 0 and any(
+                s.lora_slot == slot
+                for s in self.scheduler.waiting + self.scheduler.running
+                if not s.finished
+            )
+            return self.lora.unload(name, in_use=in_use)
+
+        if self._thread is None or not self._thread.is_alive():
+            return run()
+        done = threading.Event()
+        box: dict = {}
+
+        def cmd():
+            try:
+                box["result"] = run()
+            except BaseException as e:  # surfaced on the caller thread
+                box["error"] = e
+            finally:
+                done.set()
+
+        self._inbox.put(("lora_cmd", cmd))
+        if not done.wait(timeout=120):
+            raise TimeoutError(f"LoRA {op} of {name!r} timed out")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    def load_lora_adapter(self, name: str, path: str) -> int:
+        """Load a PEFT adapter; served under model name `name`.
+        Contract parity: POST /v1/load_lora_adapter driven by the reference's
+        LoraAdapter controller (loraadapter_controller.go:586-616)."""
+        return self._lora_cmd("load", name, path)
+
+    def unload_lora_adapter(self, name: str) -> None:
+        """Unload an adapter. Refuses while requests using it are in flight
+        (the controller retries), so a slot can never be re-targeted under a
+        running sequence."""
+        self._lora_cmd("unload", name)
+
+    def list_lora_adapters(self) -> list[str]:
+        return self.lora.list_adapters() if self.lora is not None else []
 
     def sleep(self, level: int = 1) -> None:
         """Free HBM without killing the process. Level 1 drops the KV pools;

@@ -26,9 +26,15 @@ def chunk_hash(prev_hash: bytes, tokens: Sequence[int]) -> bytes:
     return h.digest()
 
 
-def prefix_hashes(tokens: Sequence[int], page_size: int) -> list[bytes]:
-    """Hash chain over full pages of `tokens` (len // page_size entries)."""
-    out, h = [], b""
+def prefix_hashes(
+    tokens: Sequence[int], page_size: int, salt: bytes = b""
+) -> list[bytes]:
+    """Hash chain over full pages of `tokens` (len // page_size entries).
+
+    ``salt`` seeds the chain; LoRA requests salt with the adapter name because
+    adapters change wk/wv and hence the KV contents — pages must never be
+    shared across adapters (or with the base model)."""
+    out, h = [], salt
     for i in range(len(tokens) // page_size):
         h = chunk_hash(h, tokens[i * page_size : (i + 1) * page_size])
         out.append(h)
@@ -105,13 +111,15 @@ class KVPageManager:
 
     # -- prefix cache -------------------------------------------------------
 
-    def match_prefix(self, tokens: Sequence[int]) -> tuple[list[int], int]:
+    def match_prefix(
+        self, tokens: Sequence[int], salt: bytes = b""
+    ) -> tuple[list[int], int]:
         """Longest cached prefix of `tokens` (page-aligned).
 
         Returns (shared_page_ids, num_cached_tokens). Increments ref counts of
         the returned pages (caller owns them until `free`).
         """
-        hashes = prefix_hashes(tokens, self.page_size)
+        hashes = prefix_hashes(tokens, self.page_size, salt)
         self.prefix_queries += max(len(hashes), 1)
         shared: list[int] = []
         for h in hashes:
@@ -154,10 +162,12 @@ class KVPageManager:
         self.prefix_hits += len(shared)
         return shared, len(shared) * self.page_size
 
-    def register_filled(self, tokens: Sequence[int], page_ids: Sequence[int]) -> None:
+    def register_filled(
+        self, tokens: Sequence[int], page_ids: Sequence[int], salt: bytes = b""
+    ) -> None:
         """Record hashes for fully-written pages of a sequence so later
         requests can share them. Called after prefill completes."""
-        hashes = prefix_hashes(tokens, self.page_size)
+        hashes = prefix_hashes(tokens, self.page_size, salt)
         new: list[bytes] = []
         for h, pid in zip(hashes, page_ids):
             info = self.pages[pid]

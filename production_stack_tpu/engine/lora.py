@@ -1,0 +1,251 @@
+"""Multi-LoRA adapter manager (engine side).
+
+The reference stack reaches LoRA through vLLM's ``--enable-lora`` plus the
+engine HTTP endpoints ``/v1/load_lora_adapter`` / ``/v1/unload_lora_adapter``
+that the Go ``LoraAdapter`` controller drives
+(operator/internal/controller/loraadapter_controller.go:586-616 in
+/root/reference). Here the engine owns the implementation:
+
+- Adapters live in slot-stacked device buffers (``models.llama.init_lora_buffers``)
+  so a single compiled program serves a batch mixing any loaded adapters
+  (batched LoRA, the S-LoRA/punica idea expressed as one gather + two einsums
+  that XLA maps onto the MXU).
+- ``load()`` reads a PEFT checkpoint directory (``adapter_config.json`` +
+  ``adapter_model.safetensors``), maps HF module names to our stacked leaf
+  names, pads rank to the configured max, and writes the slot in place on
+  device.
+- Slot 0 is reserved for the base model and is always all-zero.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+# HF/PEFT module name -> our stacked-weight leaf name
+_HF_TO_LEAF = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+_LEAF_TO_HF = {v: k for k, v in _HF_TO_LEAF.items()}
+
+
+class LoRAError(ValueError):
+    pass
+
+
+class LoRAManager:
+    """Tracks adapter-name -> slot and writes adapter weights into the runner's
+    device buffers. Thread-safe: the HTTP side loads/unloads while the engine
+    loop reads slots (slot content swaps are atomic device-array updates)."""
+
+    def __init__(self, runner, *, max_loras: int = 4, max_rank: int = 16):
+        self.runner = runner
+        self.max_loras = max_loras  # concurrent adapters (slot 0 = base, extra)
+        self.max_rank = max_rank
+        self._lock = threading.Lock()
+        self._slots: dict[str, int] = {}  # name -> slot (1-based; 0 = base)
+        self._gen = 0  # bumped per load: versions the prefix-cache salt
+        self._salt_gen: dict[str, int] = {}  # name -> generation of current load
+
+    # -- queries -------------------------------------------------------------
+
+    def list_adapters(self) -> list[str]:
+        with self._lock:
+            return sorted(self._slots)
+
+    def slot_for(self, name: Optional[str]) -> int:
+        """Resolve a request's model name to an adapter slot (0 = base)."""
+        if not name:
+            return 0
+        with self._lock:
+            return self._slots.get(name, 0)
+
+    def is_adapter(self, name: str) -> bool:
+        with self._lock:
+            return name in self._slots
+
+    def cache_salt(self, name: str) -> bytes:
+        """Prefix-cache salt for an adapter. Versioned per load(): reloading a
+        retrained checkpoint under the same name gets a fresh salt, so pages
+        cached under the old weights can never match (they age out via LRU)."""
+        with self._lock:
+            gen = self._salt_gen.get(name)
+        return b"" if gen is None else f"lora:{name}:{gen}".encode()
+
+    # -- load / unload -------------------------------------------------------
+
+    def load(self, name: str, path: str) -> int:
+        """Load a PEFT adapter directory into a free slot; returns the slot.
+
+        Device-buffer writes must be serialized with the engine step loop —
+        LLMEngine routes load/unload through its inbox so they execute on the
+        device thread between steps (no concurrent donation of live buffers).
+        """
+        with self._lock:
+            if name in self._slots:
+                raise LoRAError(f"adapter {name!r} is already loaded")
+            used = set(self._slots.values())
+            # slots 1..max_loras inclusive: max_loras counts *adapters* (slot 0
+            # is the base model and comes on top, matching vLLM's --max-loras)
+            free = [s for s in range(1, self.max_loras + 1) if s not in used]
+            if not free:
+                raise LoRAError(
+                    f"no free LoRA slots (max_loras={self.max_loras}, "
+                    f"loaded={sorted(self._slots)})"
+                )
+            slot = free[0]
+            tensors, scale = self._read_peft(path)
+            self.runner.set_lora_slot(slot, tensors, scale)
+            self._gen += 1
+            self._salt_gen[name] = self._gen
+            self._slots[name] = slot
+            logger.info("loaded LoRA adapter %r from %s into slot %d", name, path, slot)
+            return slot
+
+    def unload(self, name: str, in_use: bool = False) -> None:
+        with self._lock:
+            slot = self._slots.get(name)
+            if slot is None:
+                raise LoRAError(f"adapter {name!r} is not loaded")
+            if in_use:
+                raise LoRAError(
+                    f"adapter {name!r} has in-flight requests; retry when drained"
+                )
+            del self._slots[name]
+            self._salt_gen.pop(name, None)
+            self.runner.clear_lora_slot(slot)
+            logger.info("unloaded LoRA adapter %r (slot %d)", name, slot)
+
+    # -- PEFT checkpoint parsing --------------------------------------------
+
+    def _read_peft(self, path: str) -> tuple[dict, float]:
+        """Read adapter_config.json + adapter_model.safetensors into stacked
+        per-target arrays ``{a_<t>: [L, in, R], b_<t>: [L, R, out]}``."""
+        cfg_path = os.path.join(path, "adapter_config.json")
+        if not os.path.isfile(cfg_path):
+            raise LoRAError(f"no adapter_config.json in {path}")
+        with open(cfg_path) as f:
+            acfg = json.load(f)
+        r = int(acfg.get("r", 8))
+        alpha = float(acfg.get("lora_alpha", r))
+        if r > self.max_rank:
+            raise LoRAError(
+                f"adapter rank {r} exceeds max_lora_rank {self.max_rank}"
+            )
+        st_path = os.path.join(path, "adapter_model.safetensors")
+        if not os.path.isfile(st_path):
+            raise LoRAError(f"no adapter_model.safetensors in {path}")
+        from safetensors import safe_open
+
+        raw: dict[str, np.ndarray] = {}
+        with safe_open(st_path, framework="np") as f:
+            for key in f.keys():
+                raw[key] = f.get_tensor(key)
+
+        cfg = self.runner.cfg
+        targets = self.runner.lora_targets
+        L, R = cfg.num_layers, self.max_rank
+        from production_stack_tpu.models.llama import lora_dims
+
+        dims = lora_dims(cfg)
+        # refuse adapters that target modules we are not applying: silently
+        # dropping trained deltas would serve a different model than trained
+        enabled_hf = {_LEAF_TO_HF[t] for t in targets}
+        in_ckpt = set()
+        for key in raw:
+            if key.endswith(".lora_A.weight"):
+                in_ckpt.add(key.split(".")[-3])
+        extra = in_ckpt - enabled_hf
+        if extra:
+            raise LoRAError(
+                f"adapter targets {sorted(extra)} but only {sorted(enabled_hf)} "
+                f"are enabled (--lora-target-modules); refusing partial application"
+            )
+        out: dict[str, np.ndarray] = {}
+        present = set()
+        for t in targets:
+            din, dout = dims[t]
+            a = np.zeros((L, din, R), np.float32)
+            b = np.zeros((L, R, dout), np.float32)
+            hf = _LEAF_TO_HF[t]
+            for layer in range(L):
+                ka = _find_tensor(raw, layer, hf, "lora_A")
+                kb = _find_tensor(raw, layer, hf, "lora_B")
+                if ka is None or kb is None:
+                    continue
+                present.add(t)
+                wa = raw[ka]  # PEFT stores lora_A as [r, in], lora_B as [out, r]
+                wb = raw[kb]
+                if wa.shape != (r, din) or wb.shape != (dout, r):
+                    raise LoRAError(
+                        f"layer {layer} {hf}: expected A {(r, din)} / B {(dout, r)}, "
+                        f"got {wa.shape} / {wb.shape}"
+                    )
+                a[layer, :, :r] = wa.T
+                b[layer, :r, :] = wb.T
+            out["a_" + t] = a
+            out["b_" + t] = b
+        if not present:
+            raise LoRAError(
+                f"adapter in {path} targets none of the enabled modules "
+                f"{[ _LEAF_TO_HF[t] for t in targets ]}"
+            )
+        return out, alpha / r
+
+
+def _find_tensor(raw: dict, layer: int, hf_name: str, ab: str) -> Optional[str]:
+    """Locate a PEFT tensor key tolerating prefix variants
+    (``base_model.model.model.layers.N...`` vs ``model.layers.N...``)."""
+    needle = f".layers.{layer}."
+    suffix_attn = f".self_attn.{hf_name}.{ab}.weight"
+    suffix_mlp = f".mlp.{hf_name}.{ab}.weight"
+    for key in raw:
+        if needle in key and (key.endswith(suffix_attn) or key.endswith(suffix_mlp)):
+            return key
+    return None
+
+
+def save_peft_adapter(path: str, cfg, rank: int, alpha: float, tensors: dict) -> None:
+    """Write a PEFT-format adapter directory (test fixture / round-trip tool).
+
+    ``tensors`` maps leaf target name -> (A [L, r, in], B [L, out, r]) in the
+    PEFT orientation.
+    """
+    os.makedirs(path, exist_ok=True)
+    target_modules = sorted(_LEAF_TO_HF[t] for t in tensors)
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump(
+            {
+                "peft_type": "LORA",
+                "r": rank,
+                "lora_alpha": alpha,
+                "target_modules": target_modules,
+                "task_type": "CAUSAL_LM",
+            },
+            f,
+        )
+    flat: dict[str, np.ndarray] = {}
+    for t, (a, b) in tensors.items():
+        hf = _LEAF_TO_HF[t]
+        group = "mlp" if t in ("w_gate", "w_up", "w_down") else "self_attn"
+        for layer in range(a.shape[0]):
+            base = f"base_model.model.model.layers.{layer}.{group}.{hf}"
+            flat[f"{base}.lora_A.weight"] = np.asarray(a[layer], np.float32)
+            flat[f"{base}.lora_B.weight"] = np.asarray(b[layer], np.float32)
+    from safetensors.numpy import save_file
+
+    save_file(flat, os.path.join(path, "adapter_model.safetensors"))

@@ -18,6 +18,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from production_stack_tpu import models
@@ -37,6 +38,7 @@ class StepInput:
     temperature: Any    # [B] float32
     top_k: Any          # [B] int32
     top_p: Any          # [B] float32
+    lora_ids: Any = None  # [B] int32 adapter slot (0 = base); None when LoRA off
 
 
 class ModelRunner:
@@ -52,6 +54,10 @@ class ModelRunner:
         page_size: int = 16,
         seed: int = 0,
         module=None,
+        enable_lora: bool = False,
+        max_loras: int = 4,
+        max_lora_rank: int = 16,
+        lora_targets: tuple[str, ...] = ("wq", "wk", "wv", "wo"),
     ):
         self.module = module if module is not None else models.module_for_config(cfg)
         self.cfg = cfg
@@ -79,6 +85,27 @@ class ModelRunner:
         self.v_pages = jax.device_put(vp, kv_sh)
         self._rng = jax.random.key(seed)
 
+        self.enable_lora = enable_lora
+        self.max_loras = max_loras
+        self.max_lora_rank = max_lora_rank
+        self.lora_targets = tuple(lora_targets)
+        self.lora = None
+        if enable_lora:
+            if not hasattr(self.module, "init_lora_buffers"):
+                raise ValueError(
+                    f"LoRA is not supported for model family "
+                    f"{self.module.__name__.rsplit('.', 1)[-1]!r} (llama-family only)"
+                )
+            # slot-stacked adapter buffers, replicated (small; the deltas they
+            # produce inherit the activations' sharding under GSPMD).
+            # max_loras counts adapters; slot 0 is the base model, hence +1.
+            buf = self.module.init_lora_buffers(
+                cfg, max_loras + 1, max_lora_rank, self.lora_targets
+            )
+            rep = NamedSharding(self.mesh, P())
+            self.lora = jax.tree.map(lambda x: jax.device_put(x, rep), buf)
+            self._set_lora_fn = None  # built lazily in set_lora_slot
+
         self._row_sh = NamedSharding(self.mesh, shardings.BATCH_SPECS["input_ids"])
         self._vec_sh = NamedSharding(self.mesh, shardings.BATCH_SPECS["kv_lens"])
         self._step = jax.jit(
@@ -92,6 +119,14 @@ class ModelRunner:
         self._rng, key = jax.random.split(self._rng)
         row = lambda x, dt: jax.device_put(jnp.asarray(x, dt), self._row_sh)
         vec = lambda x, dt: jax.device_put(jnp.asarray(x, dt), self._vec_sh)
+        lora_ids = None
+        if self.lora is not None:
+            ids_arr = (
+                inp.lora_ids
+                if inp.lora_ids is not None
+                else jnp.zeros(jnp.asarray(inp.kv_lens).shape, jnp.int32)
+            )
+            lora_ids = vec(ids_arr, jnp.int32)
         ids, logits, self.k_pages, self.v_pages = self._step(
             self.params,
             self.k_pages,
@@ -104,8 +139,44 @@ class ModelRunner:
             vec(inp.top_k, jnp.int32),
             vec(inp.top_p, jnp.float32),
             key,
+            self.lora,
+            lora_ids,
         )
         return ids, logits
+
+    # -- LoRA slot management (engine/lora.py drives these) ------------------
+
+    def set_lora_slot(self, slot: int, tensors: dict, scale: float) -> None:
+        """Write one adapter's stacked weights into `slot` in place."""
+        if self.lora is None:
+            raise RuntimeError("runner built with enable_lora=False")
+        if not 0 < slot <= self.max_loras:
+            raise ValueError(f"slot must be in [1, {self.max_loras}], got {slot}")
+        if self._set_lora_fn is None:
+            def _set(layers, scale_vec, slot, new_layers, new_scale):
+                layers = {
+                    k: (v.at[:, slot].set(new_layers[k].astype(v.dtype))
+                        if k in new_layers else v)
+                    for k, v in layers.items()
+                }
+                return layers, scale_vec.at[slot].set(new_scale)
+
+            self._set_lora_fn = jax.jit(_set, donate_argnums=(0, 1))
+        self.lora["layers"], self.lora["scale"] = self._set_lora_fn(
+            self.lora["layers"], self.lora["scale"], jnp.int32(slot),
+            {k: jnp.asarray(v) for k, v in tensors.items()},
+            jnp.float32(scale),
+        )
+
+    def clear_lora_slot(self, slot: int) -> None:
+        if self.lora is None:
+            raise RuntimeError("runner built with enable_lora=False")
+        # per-slot leaf shape: [L, S, d1, d2] -> [L, d1, d2]
+        zeros = {
+            k: np.zeros((v.shape[0],) + v.shape[2:], np.float32)
+            for k, v in self.lora["layers"].items()
+        }
+        self.set_lora_slot(slot, zeros, 0.0)
 
     def get_page(self, pid: int):
         """Fetch one page's K/V to host ([L, page_size, KH, D] each)."""
@@ -134,9 +205,12 @@ class ModelRunner:
 
 
 def _step_fn(forward, cfg, params, k_pages, v_pages, input_ids, positions,
-             page_table, kv_lens, temperature, top_k, top_p, key):
+             page_table, kv_lens, temperature, top_k, top_p, key,
+             lora=None, lora_ids=None):
+    kw = {} if lora is None else {"lora": lora, "lora_ids": lora_ids}
     logits, k_pages, v_pages = forward(
-        params, cfg, input_ids, positions, k_pages, v_pages, page_table, kv_lens
+        params, cfg, input_ids, positions, k_pages, v_pages, page_table, kv_lens,
+        **kw,
     )
     ids = sample(logits, key, temperature, top_k, top_p)
     return ids, logits, k_pages, v_pages

@@ -53,6 +53,8 @@ class Sequence:
     finished: bool = False
     finish_reason: Optional[str] = None
     first_token_time: Optional[float] = None
+    lora_slot: int = 0             # adapter slot (0 = base model)
+    cache_salt: bytes = b""        # prefix-cache salt (adapter identity)
 
     @property
     def num_tokens(self) -> int:
@@ -75,6 +77,7 @@ class ScheduledBatch:
     temperature: np.ndarray
     top_k: np.ndarray
     top_p: np.ndarray
+    lora_ids: np.ndarray = None    # [B] int32 adapter slot per row
     # how many tokens of each seq this step computes (prefill chunking)
     chunk_sizes: list[int] = field(default_factory=list)
 
@@ -139,7 +142,7 @@ class Scheduler:
         while self.waiting and len(self.running) < self.max_num_seqs:
             seq = self.waiting[0]
             if self.enable_prefix_caching:
-                shared, cached = self.kv.match_prefix(seq.prompt_ids)
+                shared, cached = self.kv.match_prefix(seq.prompt_ids, seq.cache_salt)
                 # never serve the *entire* prompt from cache: the last token
                 # must be recomputed to produce logits
                 if cached >= len(seq.prompt_ids):
@@ -179,7 +182,7 @@ class Scheduler:
         seq.finish_reason = reason
         if self.enable_prefix_caching:
             self.kv.register_filled(
-                seq.prompt_ids + seq.output_ids, seq.pages
+                seq.prompt_ids + seq.output_ids, seq.pages, seq.cache_salt
             )
         self.kv.free(seq.pages)
         seq.pages = []
@@ -217,6 +220,7 @@ class Scheduler:
         temperature = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
+        lora_ids = np.zeros((B,), np.int32)
         for i, (s, c) in enumerate(zip(seqs, chunks)):
             lo = s.num_computed
             input_ids[i, :c] = s.prompt_ids[lo : lo + c]
@@ -227,9 +231,10 @@ class Scheduler:
             temperature[i] = s.params.temperature
             top_k[i] = s.params.top_k
             top_p[i] = s.params.top_p
+            lora_ids[i] = s.lora_slot
         return ScheduledBatch(
             "prefill", list(seqs), input_ids, positions, page_table, kv_lens,
-            temperature, top_k, top_p, chunk_sizes=chunks,
+            temperature, top_k, top_p, lora_ids=lora_ids, chunk_sizes=chunks,
         )
 
     def _plan_decode(self, seqs: list[Sequence]) -> Optional[ScheduledBatch]:
@@ -265,6 +270,7 @@ class Scheduler:
         temperature = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
+        lora_ids = np.zeros((B,), np.int32)
         for i, s in enumerate(ready):
             last = (s.prompt_ids + s.output_ids)[-1]
             input_ids[i, 0] = last
@@ -275,9 +281,10 @@ class Scheduler:
             temperature[i] = s.params.temperature
             top_k[i] = s.params.top_k
             top_p[i] = s.params.top_p
+            lora_ids[i] = s.lora_slot
         return ScheduledBatch(
             "decode", ready, input_ids, positions, page_table, kv_lens,
-            temperature, top_k, top_p,
+            temperature, top_k, top_p, lora_ids=lora_ids,
         )
 
     def _preempt(self, seq: Sequence) -> None:

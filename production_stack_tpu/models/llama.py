@@ -221,6 +221,52 @@ def init_kv_pages(
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def lora_dims(cfg: LlamaConfig) -> dict[str, tuple[int, int]]:
+    """(in_dim, out_dim) per LoRA-targetable projection."""
+    H, I = cfg.hidden_size, cfg.intermediate_size
+    dims = {
+        "wq": (H, cfg.num_heads * cfg.head_dim),
+        "wk": (H, cfg.num_kv_heads * cfg.head_dim),
+        "wv": (H, cfg.num_kv_heads * cfg.head_dim),
+        "wo": (cfg.num_heads * cfg.head_dim, H),
+    }
+    if not cfg.num_experts:  # MoE expert weights are not LoRA targets
+        dims.update({"w_gate": (H, I), "w_up": (H, I), "w_down": (I, H)})
+    return dims
+
+
+def init_lora_buffers(
+    cfg: LlamaConfig,
+    max_loras: int,
+    max_rank: int,
+    targets: tuple[str, ...] = ("wq", "wk", "wv", "wo"),
+) -> dict:
+    """Slot-stacked LoRA buffers for batched multi-adapter serving.
+
+    Layout is TPU-first: per target ``a_<t>: [L, S, in, R]`` and
+    ``b_<t>: [L, S, R, out]`` with the layer axis leading so the buffers ride
+    the decoder's ``lax.scan`` alongside the base weights, and the slot axis
+    ``S`` gathered per sequence at trace time (one compiled program serves a
+    batch mixing any adapters — the TPU analogue of punica/S-LoRA batched
+    LoRA, which the reference stack reaches through vLLM's ``--enable-lora``,
+    helm/templates/deployment-vllm-multi.yaml:197-207 in /root/reference).
+
+    Slot 0 is reserved for "no adapter" and stays all-zero; ``scale`` is the
+    per-slot ``alpha / r`` factor.
+    """
+    dims = lora_dims(cfg)
+    unknown = set(targets) - set(dims)
+    if unknown:
+        raise ValueError(f"unknown LoRA targets {sorted(unknown)}; known: {sorted(dims)}")
+    L, S, R = cfg.num_layers, max_loras, max_rank
+    layers = {}
+    for t in targets:
+        din, dout = dims[t]
+        layers["a_" + t] = jnp.zeros((L, S, din, R), cfg.dtype)
+        layers["b_" + t] = jnp.zeros((L, S, R, dout), cfg.dtype)
+    return {"layers": layers, "scale": jnp.zeros((S,), jnp.float32)}
+
+
 def _moe_block(h: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
     """Mixtral sparse-MoE MLP, computed densely over experts.
 
@@ -257,6 +303,8 @@ def forward(
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,
     kv_lens: jnp.ndarray,
+    lora: Optional[dict] = None,
+    lora_ids: Optional[jnp.ndarray] = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
@@ -266,6 +314,8 @@ def forward(
       k_pages/v_pages: [L, P, page_size, KH, D] pools (donate for in-place).
       page_table: [B, max_pages] physical page ids per sequence.
       kv_lens:    [B] total valid KV length *including* this step's tokens.
+      lora:       optional ``init_lora_buffers`` tree for batched multi-LoRA.
+      lora_ids:   [B] int32 adapter slot per sequence (0 = base model).
 
     Returns (logits[B, V] for each sequence's last valid token,
              k_pages, v_pages updated).
@@ -275,13 +325,25 @@ def forward(
     cos, sin = rope_cos_sin(
         jnp.maximum(positions, 0), cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
+    lora_scale = None if lora is None else lora["scale"][lora_ids].astype(cfg.dtype)
 
     def layer(x, layer_in):
-        lp, kp, vp = layer_in  # per-layer params and page pools
+        lp, kp, vp, ll = layer_in  # per-layer params, page pools, LoRA slices
+
+        def proj(h, name):
+            """h @ W with the batched per-sequence LoRA delta folded in."""
+            y = h @ lp[name]
+            if ll is not None and ("a_" + name) in ll:
+                a = ll["a_" + name][lora_ids]  # [B, in, R]
+                b = ll["b_" + name][lora_ids]  # [B, R, out]
+                delta = jnp.einsum("bti,bir->btr", h, a)
+                y = y + jnp.einsum("btr,bro->bto", delta, b) * lora_scale[:, None, None]
+            return y
+
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        q = proj(h, "wq").reshape(B, T, cfg.num_heads, cfg.head_dim)
+        k = proj(h, "wk").reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        v = proj(h, "wv").reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         if cfg.attention_bias:
             q = q + lp["bq"].reshape(cfg.num_heads, cfg.head_dim)
             k = k + lp["bk"].reshape(cfg.num_kv_heads, cfg.head_dim)
@@ -305,15 +367,19 @@ def forward(
                 q, kc, vc, q_positions=positions, kv_lens=kv_lens,
                 window=cfg.sliding_window,
             )
-        x = x + attn.reshape(B, T, -1) @ lp["wo"]
+        x = x + proj(attn.reshape(B, T, -1), "wo")
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         if cfg.num_experts:
             x = x + _moe_block(h, lp, cfg)
         else:
-            x = x + (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+            x = x + proj(jax.nn.silu(proj(h, "w_gate")) * proj(h, "w_up"), "w_down")
         return x, (kp, vp)
 
-    x, (k_pages, v_pages) = lax.scan(layer, x, (params["layers"], k_pages, v_pages))
+    x, (k_pages, v_pages) = lax.scan(
+        layer,
+        x,
+        (params["layers"], k_pages, v_pages, None if lora is None else lora["layers"]),
+    )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     # Select each sequence's last valid token before the vocab projection so the

@@ -1,0 +1,432 @@
+"""Multi-LoRA serving tests: model-level batched-LoRA math, PEFT checkpoint
+loading, prefix-cache isolation, and the engine HTTP contract
+(/v1/load_lora_adapter, /v1/unload_lora_adapter — the endpoints the reference's
+LoraAdapter controller drives, loraadapter_controller.go:586-616)."""
+
+import asyncio
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.lora import LoRAManager, save_peft_adapter
+from production_stack_tpu.engine.runner import ModelRunner, StepInput
+from production_stack_tpu.engine.scheduler import SamplingParams
+from production_stack_tpu.models import llama
+
+CFG = llama.PRESETS["llama-debug"]
+RANK = 4
+TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def _forward_inputs(cfg, B=2, T=8, num_pages=16, page_size=8, seed=0):
+    rng = np.random.RandomState(seed)
+    k_pages, v_pages = llama.init_kv_pages(cfg, num_pages, page_size)
+    input_ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    max_pages = 2
+    page_table = jnp.arange(B * max_pages, dtype=jnp.int32).reshape(B, max_pages)
+    kv_lens = jnp.full((B,), T, jnp.int32)
+    return dict(
+        input_ids=input_ids, positions=positions, k_pages=k_pages,
+        v_pages=v_pages, page_table=page_table, kv_lens=kv_lens,
+    )
+
+
+def _random_lora(cfg, slots_with_weights, scale=0.5, seed=3):
+    """LoRA buffers with random A/B in the given slots, zeros elsewhere."""
+    rng = np.random.RandomState(seed)
+    buf = llama.init_lora_buffers(cfg, max_loras=4, max_rank=RANK, targets=TARGETS)
+    layers = {k: np.asarray(v, np.float32) for k, v in buf["layers"].items()}
+    dims = llama.lora_dims(cfg)
+    for slot in slots_with_weights:
+        for t in TARGETS:
+            din, dout = dims[t]
+            layers["a_" + t][:, slot] = 0.1 * rng.randn(cfg.num_layers, din, RANK)
+            layers["b_" + t][:, slot] = 0.1 * rng.randn(cfg.num_layers, RANK, dout)
+    scale_vec = np.zeros(4, np.float32)
+    for slot in slots_with_weights:
+        scale_vec[slot] = scale
+    return {
+        "layers": {k: jnp.asarray(v, cfg.dtype) for k, v in layers.items()},
+        "scale": jnp.asarray(scale_vec),
+    }
+
+
+def _merged_params(cfg, params, lora, slot):
+    """Base params with slot's LoRA delta folded into the weights."""
+    merged = jax.tree.map(lambda x: x, params)
+    scale = float(lora["scale"][slot])
+    new_layers = dict(merged["layers"])
+    for t in TARGETS:
+        a = np.asarray(lora["layers"]["a_" + t][:, slot], np.float32)  # [L, in, R]
+        b = np.asarray(lora["layers"]["b_" + t][:, slot], np.float32)  # [L, R, out]
+        delta = np.einsum("lir,lro->lio", a, b) * scale
+        new_layers[t] = (np.asarray(new_layers[t], np.float32) + delta).astype(cfg.dtype)
+    merged["layers"] = new_layers
+    return merged
+
+
+def test_zero_slots_match_base():
+    """All-zero LoRA buffers must reproduce the base model exactly."""
+    params = llama.init_params(CFG, jax.random.key(0))
+    inp = _forward_inputs(CFG)
+    base_logits, _, _ = llama.forward(params, CFG, **inp)
+    lora = _random_lora(CFG, slots_with_weights=[])
+    inp2 = _forward_inputs(CFG)
+    lora_ids = jnp.zeros((2,), jnp.int32)
+    lora_logits, _, _ = llama.forward(
+        params, CFG, **inp2, lora=lora, lora_ids=lora_ids
+    )
+    np.testing.assert_allclose(base_logits, lora_logits, rtol=1e-5, atol=1e-5)
+
+
+def test_lora_matches_merged_weights():
+    """Batched LoRA (x@A@B added at runtime) == base weights merged with
+    scale*A@B, the defining LoRA identity."""
+    params = llama.init_params(CFG, jax.random.key(1))
+    lora = _random_lora(CFG, slots_with_weights=[1])
+    inp = _forward_inputs(CFG)
+    lora_ids = jnp.ones((2,), jnp.int32)
+    got, _, _ = llama.forward(params, CFG, **inp, lora=lora, lora_ids=lora_ids)
+    merged = _merged_params(CFG, params, lora, slot=1)
+    inp2 = _forward_inputs(CFG)
+    want, _, _ = llama.forward(merged, CFG, **inp2)
+    # bf16 params: merged-weight rounding differs from runtime-delta rounding
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.15)
+
+
+def test_mixed_batch_per_sequence_adapters():
+    """One batch mixing base (slot 0) and an adapter (slot 2): row 0 must match
+    the base model, row 1 the merged model."""
+    params = llama.init_params(CFG, jax.random.key(2))
+    lora = _random_lora(CFG, slots_with_weights=[2])
+    inp = _forward_inputs(CFG)
+    lora_ids = jnp.asarray([0, 2], jnp.int32)
+    got, _, _ = llama.forward(params, CFG, **inp, lora=lora, lora_ids=lora_ids)
+
+    base, _, _ = llama.forward(params, CFG, **_forward_inputs(CFG))
+    merged, _, _ = llama.forward(
+        _merged_params(CFG, params, lora, slot=2), CFG, **_forward_inputs(CFG)
+    )
+    np.testing.assert_allclose(got[0], base[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got[1], merged[1], rtol=0.1, atol=0.15)
+
+
+# -- PEFT checkpoint loading ------------------------------------------------
+
+
+def _write_adapter(tmp_path, cfg, rank=RANK, alpha=8.0, targets=("wq", "wv"), seed=7):
+    rng = np.random.RandomState(seed)
+    dims = llama.lora_dims(cfg)
+    tensors = {}
+    for t in targets:
+        din, dout = dims[t]
+        a = 0.2 * rng.randn(cfg.num_layers, rank, din)   # PEFT orientation [r, in]
+        b = 0.2 * rng.randn(cfg.num_layers, dout, rank)  # PEFT orientation [out, r]
+        tensors[t] = (a, b)
+    path = str(tmp_path / "adapter")
+    save_peft_adapter(path, cfg, rank, alpha, tensors)
+    return path, tensors
+
+
+def test_peft_load_unload_roundtrip(tmp_path):
+    runner = ModelRunner(
+        CFG, num_pages=16, page_size=8, enable_lora=True,
+        max_loras=4, max_lora_rank=8, lora_targets=TARGETS,
+    )
+    mgr = LoRAManager(runner, max_loras=4, max_rank=8)
+    path, tensors = _write_adapter(tmp_path, CFG)
+    slot = mgr.load("my-adapter", path)
+    assert slot == 1
+    assert mgr.list_adapters() == ["my-adapter"]
+    assert mgr.slot_for("my-adapter") == 1 and mgr.slot_for(None) == 0
+
+    # device buffer holds the transposed, rank-padded weights
+    a_dev = np.asarray(runner.lora["layers"]["a_wq"][:, 1], np.float32)
+    want = np.transpose(tensors["wq"][0], (0, 2, 1))  # [L, in, r]
+    np.testing.assert_allclose(a_dev[:, :, :RANK], want, rtol=0.05, atol=0.05)
+    assert float(runner.lora["scale"][1]) == pytest.approx(8.0 / RANK)
+
+    # duplicate load refused; unload frees the slot and zeroes it
+    with pytest.raises(ValueError):
+        mgr.load("my-adapter", path)
+    mgr.unload("my-adapter")
+    assert mgr.list_adapters() == []
+    assert float(jnp.abs(runner.lora["layers"]["a_wq"][:, 1]).max()) == 0.0
+    with pytest.raises(ValueError):
+        mgr.unload("my-adapter")
+
+
+def test_peft_rank_too_large_refused(tmp_path):
+    runner = ModelRunner(
+        CFG, num_pages=16, page_size=8, enable_lora=True,
+        max_loras=2, max_lora_rank=2, lora_targets=TARGETS,
+    )
+    mgr = LoRAManager(runner, max_loras=2, max_rank=2)
+    path, _ = _write_adapter(tmp_path, CFG, rank=RANK)
+    with pytest.raises(ValueError, match="rank"):
+        mgr.load("big", path)
+
+
+def test_runner_step_with_lora_ids(tmp_path):
+    """ModelRunner.step with mixed lora_ids changes only the flagged row."""
+    runner = ModelRunner(
+        CFG, num_pages=32, page_size=8, enable_lora=True,
+        max_loras=4, max_lora_rank=8, lora_targets=TARGETS, seed=0,
+    )
+    mgr = LoRAManager(runner, max_loras=4, max_rank=8)
+    path, _ = _write_adapter(tmp_path, CFG, alpha=64.0)
+    mgr.load("a1", path)
+
+    rng = np.random.RandomState(0)
+    T = 8
+    ids = rng.randint(0, CFG.vocab_size, (2, T)).astype(np.int32)
+
+    def step(lora_ids):
+        return runner.step(
+            StepInput(
+                input_ids=ids,
+                positions=np.broadcast_to(np.arange(T, dtype=np.int32), (2, T)),
+                page_table=np.arange(4, dtype=np.int32).reshape(2, 2),
+                kv_lens=np.full((2,), T, np.int32),
+                temperature=np.zeros(2, np.float32),
+                top_k=np.zeros(2, np.int32),
+                top_p=np.ones(2, np.float32),
+                lora_ids=np.asarray(lora_ids, np.int32),
+            )
+        )
+
+    _, logits_base = step([0, 0])
+    runner.reset_kv()
+    _, logits_mixed = step([0, 1])
+    np.testing.assert_allclose(logits_base[0], logits_mixed[0], rtol=1e-4, atol=1e-4)
+    assert float(np.abs(np.asarray(logits_base[1] - logits_mixed[1])).max()) > 1e-3
+
+
+# -- engine + HTTP contract --------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(
+        model="llama-debug",
+        max_model_len=256,
+        max_num_seqs=8,
+        num_pages=64,
+        page_size=8,
+        prefill_chunk=32,
+        enable_lora=True,
+        max_loras=4,
+        max_lora_rank=8,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def lora_engine(tmp_path_factory):
+    eng = LLMEngine(_cfg())
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _gen(engine, prompt, lora_name=None, **params):
+    async def run():
+        text = ""
+        async for out in engine.generate(
+            f"t-{np.random.randint(1 << 30)}", prompt=prompt,
+            params=SamplingParams(**params), lora_name=lora_name,
+        ):
+            text += out.text_delta
+        return text
+
+    return asyncio.run(run())
+
+
+def test_engine_generate_with_adapter(lora_engine, tmp_path):
+    path, _ = _write_adapter(tmp_path, CFG, alpha=64.0)
+    lora_engine.load_lora_adapter("sql-lora", path)
+    try:
+        base = _gen(lora_engine, "select all users", max_tokens=12,
+                    temperature=0.0, ignore_eos=True)
+        tuned = _gen(lora_engine, "select all users", lora_name="sql-lora",
+                     max_tokens=12, temperature=0.0, ignore_eos=True)
+        again = _gen(lora_engine, "select all users", lora_name="sql-lora",
+                     max_tokens=12, temperature=0.0, ignore_eos=True)
+        assert tuned == again  # deterministic under greedy
+        assert isinstance(base, str) and isinstance(tuned, str)
+        with pytest.raises(ValueError, match="not loaded"):
+            _gen(lora_engine, "x", lora_name="missing", max_tokens=2)
+    finally:
+        lora_engine.unload_lora_adapter("sql-lora")
+
+
+def test_engine_prefix_cache_isolated_between_adapters(lora_engine, tmp_path):
+    """Same prompt under base and adapter must not share KV pages: the salted
+    hash chains differ, so the adapter run gets no (poisoned) cache hits."""
+    path, _ = _write_adapter(tmp_path, CFG, alpha=64.0, seed=11)
+    lora_engine.load_lora_adapter("iso", path)
+    try:
+        prompt = "tell me a story about caching " * 8  # multiple full pages
+        _gen(lora_engine, prompt, max_tokens=2, temperature=0.0, ignore_eos=True)
+        hits_before = lora_engine.kv.prefix_hits
+        _gen(lora_engine, prompt, lora_name="iso", max_tokens=2,
+             temperature=0.0, ignore_eos=True)
+        assert lora_engine.kv.prefix_hits == hits_before
+    finally:
+        lora_engine.unload_lora_adapter("iso")
+
+
+def test_http_lora_endpoints(tmp_path):
+    """Full HTTP contract: load -> /v1/models lists the adapter -> chat with
+    model=adapter streams -> unload -> 404 for the unloaded name."""
+    import requests
+
+    from production_stack_tpu.testing.procs import (
+        free_port, start_proc, stop_proc, wait_healthy,
+    )
+
+    port = free_port()
+    adapter_dir, _ = _write_adapter(tmp_path, CFG, alpha=16.0)
+    proc = start_proc(
+        [
+            "-m", "production_stack_tpu.engine.api_server",
+            "--model", "llama-debug", "--port", str(port),
+            "--max-model-len", "256", "--num-pages", "64", "--page-size", "8",
+            "--enable-lora", "--max-loras", "4", "--max-lora-rank", "8",
+        ],
+    )
+    try:
+        wait_healthy(f"http://127.0.0.1:{port}/health", proc, timeout=180)
+        base = f"http://127.0.0.1:{port}"
+        r = requests.post(
+            f"{base}/v1/load_lora_adapter",
+            json={"lora_name": "demo-lora", "lora_path": adapter_dir},
+            timeout=60,
+        )
+        assert r.status_code == 200, r.text
+        ids = [m["id"] for m in requests.get(f"{base}/v1/models", timeout=10).json()["data"]]
+        assert "demo-lora" in ids and "llama-debug" in ids
+
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "model": "demo-lora",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0,
+            },
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        assert r.json()["model"] == "demo-lora"
+
+        # unknown model -> 404 (vLLM-compatible error shape)
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={"model": "nope", "messages": [], "max_tokens": 2},
+            timeout=30,
+        )
+        assert r.status_code == 404
+
+        r = requests.post(
+            f"{base}/v1/unload_lora_adapter", json={"lora_name": "demo-lora"},
+            timeout=30,
+        )
+        assert r.status_code == 200
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={"model": "demo-lora", "messages": [], "max_tokens": 2},
+            timeout=30,
+        )
+        assert r.status_code == 404
+    finally:
+        stop_proc(proc)
+
+
+# -- review-finding regressions ----------------------------------------------
+
+
+def test_max_loras_counts_adapters(tmp_path):
+    """max_loras=N must allow N concurrent adapters (slot 0 is the base and
+    comes on top)."""
+    runner = ModelRunner(
+        CFG, num_pages=16, page_size=8, enable_lora=True,
+        max_loras=2, max_lora_rank=8, lora_targets=TARGETS,
+    )
+    mgr = LoRAManager(runner, max_loras=2, max_rank=8)
+    p1, _ = _write_adapter(tmp_path / "1", CFG)
+    p2, _ = _write_adapter(tmp_path / "2", CFG)
+    p3, _ = _write_adapter(tmp_path / "3", CFG)
+    assert mgr.load("a1", p1) == 1
+    assert mgr.load("a2", p2) == 2
+    with pytest.raises(ValueError, match="no free LoRA slots"):
+        mgr.load("a3", p3)
+
+
+def test_reload_same_name_gets_fresh_cache_salt(tmp_path):
+    """Reloading a retrained checkpoint under the same name must change the
+    prefix-cache salt, or stale KV from the old weights would be served."""
+    runner = ModelRunner(
+        CFG, num_pages=16, page_size=8, enable_lora=True,
+        max_loras=2, max_lora_rank=8, lora_targets=TARGETS,
+    )
+    mgr = LoRAManager(runner, max_loras=2, max_rank=8)
+    path, _ = _write_adapter(tmp_path, CFG)
+    mgr.load("x", path)
+    salt1 = mgr.cache_salt("x")
+    mgr.unload("x")
+    mgr.load("x", path)
+    salt2 = mgr.cache_salt("x")
+    assert salt1 and salt2 and salt1 != salt2
+
+
+def test_partially_applicable_adapter_refused(tmp_path):
+    """An adapter targeting modules outside --lora-target-modules must be
+    refused, not silently half-applied."""
+    runner = ModelRunner(
+        CFG, num_pages=16, page_size=8, enable_lora=True,
+        max_loras=2, max_lora_rank=8, lora_targets=("wq", "wv"),
+    )
+    mgr = LoRAManager(runner, max_loras=2, max_rank=8)
+    path, _ = _write_adapter(tmp_path, CFG, targets=("wq", "w_gate"))
+    with pytest.raises(ValueError, match="partial application"):
+        mgr.load("mlp-adapter", path)
+
+
+def test_unload_in_flight_refused(tmp_path):
+    """Unload must refuse while sequences still reference the slot."""
+    from production_stack_tpu.engine.scheduler import Sequence
+
+    eng = LLMEngine(_cfg())  # not started: commands run inline
+    path, _ = _write_adapter(tmp_path, CFG)
+    eng.load_lora_adapter("busy", path)
+    seq = Sequence(
+        seq_id="s1", prompt_ids=[1, 2, 3], params=SamplingParams(),
+        lora_slot=eng.lora.slot_for("busy"),
+    )
+    eng.scheduler.running.append(seq)
+    with pytest.raises(ValueError, match="in-flight"):
+        eng.unload_lora_adapter("busy")
+    eng.scheduler.running.clear()
+    eng.unload_lora_adapter("busy")
+    assert eng.list_lora_adapters() == []
+
+
+def test_lora_unsupported_family_clear_error():
+    from production_stack_tpu.models import opt
+
+    with pytest.raises(ValueError, match="not supported"):
+        ModelRunner(
+            opt.PRESETS["opt-debug"], module=opt, num_pages=16, page_size=8,
+            enable_lora=True,
+        )
+
+
+def test_unknown_target_module_clear_error():
+    with pytest.raises(ValueError, match="lora-target-modules"):
+        LLMEngine(_cfg(lora_target_modules="qproj"))
