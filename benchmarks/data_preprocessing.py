@@ -1,0 +1,54 @@
+"""ShareGPT -> multi-round-qa conversation format.
+
+Parity: /root/reference benchmarks/multi-round-qa/data_preprocessing.py —
+filters conversations to those starting with a human turn, keeps alternating
+human/gpt rounds, drops short dialogues, and emits
+[{"num_round", "conversations": [{"role", "content"}...]}] consumed by
+multi_round_qa.py's --sharegpt mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def convert(conversations: list[dict], min_rounds: int = 4) -> list[dict]:
+    out = []
+    for conv in conversations:
+        turns = conv.get("conversations") or []
+        # drop leading non-human turns so dialogues start with the user
+        while turns and turns[0].get("from") != "human":
+            turns = turns[1:]
+        rounds = []
+        expect = "human"
+        for t in turns:
+            who = t.get("from")
+            if who != expect:
+                break  # enforce strict alternation
+            rounds.append(
+                {"role": "user" if who == "human" else "assistant",
+                 "content": t.get("value", "")}
+            )
+            expect = "gpt" if expect == "human" else "human"
+        if len(rounds) >= min_rounds:
+            out.append({"num_round": len(rounds), "conversations": rounds})
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--min-rounds", type=int, default=4)
+    args = p.parse_args()
+    with open(args.input) as f:
+        data = json.load(f)
+    processed = convert(data, args.min_rounds)
+    with open(args.output, "w") as f:
+        json.dump(processed, f)
+    print(f"kept {len(processed)}/{len(data)} conversations")
+
+
+if __name__ == "__main__":
+    main()

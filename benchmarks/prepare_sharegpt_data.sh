@@ -1,0 +1,12 @@
+#!/bin/bash
+# Download + preprocess ShareGPT into the multi-round-qa input format
+# (parity: /root/reference benchmarks/multi-round-qa/prepare_sharegpt_data.sh).
+set -euo pipefail
+cd "$(dirname "$0")"
+URL="https://huggingface.co/datasets/anon8231489123/ShareGPT_Vicuna_unfiltered/resolve/main/ShareGPT_V3_unfiltered_cleaned_split.json"
+OUT=${1:-sharegpt.json}
+if [ ! -f "$OUT" ]; then
+  curl -L "$URL" -o "$OUT"
+fi
+python data_preprocessing.py --input "$OUT" --output sharegpt_processed.json
+echo "wrote sharegpt_processed.json"

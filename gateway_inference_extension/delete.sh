@@ -1,0 +1,6 @@
+#!/bin/bash
+set -euo pipefail
+cd "$(dirname "$0")"
+kubectl delete -f configs/gateway.yaml --ignore-not-found
+kubectl delete -f configs/inferencepool.yaml --ignore-not-found
+echo "gateway inference extension removed"

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Install the gateway inference extension for the TPU stack:
+# build the picker image, apply the InferencePool/Gateway resources.
+# Counterpart of /root/reference src/gateway_inference_extension/install.sh.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+cmake -S . -B build -G Ninja
+ninja -C build picker picker_test
+./build/picker_test ./build/picker
+
+if command -v docker >/dev/null; then
+  docker build -t production-stack-tpu/picker:latest -f Dockerfile ..
+fi
+
+kubectl apply -f configs/inferencepool.yaml
+kubectl apply -f configs/gateway.yaml
+echo "gateway inference extension installed"

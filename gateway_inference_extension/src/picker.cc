@@ -1,0 +1,231 @@
+// production-stack-tpu gateway inference extension: endpoint picker service.
+//
+// TPU-native counterpart of the reference's kgateway scheduler plugin
+// (/root/reference src/gateway_inference_extension/roundrobin_picker.go):
+// a Gateway API InferencePool endpoint picker that cycles through the pool's
+// candidates round-robin. Where the reference patches a Go plugin into the
+// kgateway endpoint-picker binary, this is a freestanding sidecar the gateway
+// (or any L7 proxy) queries per request; the chosen backend is returned both
+// in the JSON body and in the `x-gateway-destination-endpoint` header — the
+// header contract the Gateway API inference extension uses to steer Envoy.
+//
+// Semantics mirrored from the reference picker:
+//   - candidates are sorted by name before picking (stable order across
+//     watchers), then an atomic counter indexes round-robin;
+//   - an empty pool returns an empty result (503 here, since HTTP needs a
+//     status).
+//
+// API:
+//   GET  /healthz                      -> 200 "ok"
+//   POST /endpoints {"pool":P,"endpoints":["ip:port",...]} -> replace pool
+//   GET  /pick?pool=P                  -> {"endpoint": "..."} + header
+//   GET  /pools                        -> current pool membership
+//
+// Endpoints can also be seeded statically: --pool default=ip1:port,ip2:port
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <csignal>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.h"  // operator/src/json.h (shared single-header JSON)
+
+namespace {
+
+struct Pool {
+  std::vector<std::string> endpoints;  // kept sorted
+  std::atomic<uint64_t> counter{0};
+};
+
+class PickerState {
+ public:
+  void set_endpoints(const std::string& pool, std::vector<std::string> eps) {
+    std::sort(eps.begin(), eps.end());
+    std::lock_guard<std::mutex> g(mu_);
+    pools_[pool].endpoints = std::move(eps);
+  }
+
+  // Returns empty string when the pool has no candidates.
+  std::string pick(const std::string& pool) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pools_.find(pool);
+    if (it == pools_.end() || it->second.endpoints.empty()) return "";
+    uint64_t idx = it->second.counter.fetch_add(1);
+    return it->second.endpoints[idx % it->second.endpoints.size()];
+  }
+
+  std::string pools_json() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::ostringstream os;
+    os << "{";
+    bool first_pool = true;
+    for (auto& [name, pool] : pools_) {
+      if (!first_pool) os << ",";
+      first_pool = false;
+      os << "\"" << name << "\":[";
+      for (size_t i = 0; i < pool.endpoints.size(); i++) {
+        if (i) os << ",";
+        os << "\"" << pool.endpoints[i] << "\"";
+      }
+      os << "]";
+    }
+    os << "}";
+    return os.str();
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::string, Pool> pools_;
+};
+
+PickerState g_state;
+std::atomic<bool> g_stop{false};
+
+std::string query_param(const std::string& target, const std::string& key) {
+  auto qpos = target.find('?');
+  if (qpos == std::string::npos) return "";
+  std::string qs = target.substr(qpos + 1);
+  std::istringstream ss(qs);
+  std::string kv;
+  while (std::getline(ss, kv, '&')) {
+    auto eq = kv.find('=');
+    if (eq != std::string::npos && kv.substr(0, eq) == key)
+      return kv.substr(eq + 1);
+  }
+  return "";
+}
+
+void respond(int fd, int status, const std::string& body,
+             const std::string& extra_headers = "") {
+  const char* reason = status == 200   ? "OK"
+                       : status == 400 ? "Bad Request"
+                       : status == 404 ? "Not Found"
+                                       : "Service Unavailable";
+  std::ostringstream os;
+  os << "HTTP/1.1 " << status << " " << reason << "\r\n"
+     << "Content-Type: application/json\r\n"
+     << "Content-Length: " << body.size() << "\r\n"
+     << extra_headers << "Connection: close\r\n\r\n"
+     << body;
+  std::string out = os.str();
+  (void)!write(fd, out.data(), out.size());
+}
+
+void handle(int fd) {
+  std::string req;
+  char buf[4096];
+  // read until header terminator, then honor Content-Length
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    ssize_t n = read(fd, buf, sizeof(buf));
+    if (n <= 0) { close(fd); return; }
+    req.append(buf, n);
+    header_end = req.find("\r\n\r\n");
+    if (req.size() > 1 << 20) { close(fd); return; }
+  }
+  size_t content_len = 0;
+  {
+    auto pos = req.find("Content-Length:");
+    if (pos == std::string::npos) pos = req.find("content-length:");
+    if (pos != std::string::npos) content_len = std::strtoul(req.c_str() + pos + 15, nullptr, 10);
+  }
+  while (req.size() < header_end + 4 + content_len) {
+    ssize_t n = read(fd, buf, sizeof(buf));
+    if (n <= 0) break;
+    req.append(buf, n);
+  }
+
+  std::istringstream line(req.substr(0, req.find("\r\n")));
+  std::string method, target;
+  line >> method >> target;
+  std::string body = req.substr(header_end + 4);
+
+  if (method == "GET" && target == "/healthz") {
+    respond(fd, 200, "\"ok\"");
+  } else if (method == "GET" && target.rfind("/pick", 0) == 0) {
+    std::string pool = query_param(target, "pool");
+    if (pool.empty()) pool = "default";
+    std::string ep = g_state.pick(pool);
+    if (ep.empty()) {
+      respond(fd, 503, "{\"error\":\"no endpoints in pool '" + pool + "'\"}");
+    } else {
+      respond(fd, 200, "{\"endpoint\":\"" + ep + "\"}",
+              "x-gateway-destination-endpoint: " + ep + "\r\n");
+    }
+  } else if (method == "GET" && target == "/pools") {
+    respond(fd, 200, g_state.pools_json());
+  } else if (method == "POST" && target == "/endpoints") {
+    try {
+      auto v = json::parse(body);
+      std::string pool = v["pool"].is_string() ? v["pool"].as_string() : "default";
+      std::vector<std::string> eps;
+      for (const auto& e : v["endpoints"].as_array()) eps.push_back(e.as_string());
+      g_state.set_endpoints(pool, std::move(eps));
+      respond(fd, 200, "{\"status\":\"ok\"}");
+    } catch (const std::exception& e) {
+      respond(fd, 400, std::string("{\"error\":\"") + e.what() + "\"}");
+    }
+  } else {
+    respond(fd, 404, "{\"error\":\"not found\"}");
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 9002;
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    auto next = [&]() -> std::string { return i + 1 < argc ? argv[++i] : ""; };
+    if (arg == "--port") {
+      port = std::stoi(next());
+    } else if (arg == "--pool") {
+      // --pool name=ep1,ep2
+      std::string spec = next();
+      auto eq = spec.find('=');
+      if (eq == std::string::npos) { fprintf(stderr, "bad --pool %s\n", spec.c_str()); return 2; }
+      std::vector<std::string> eps;
+      std::istringstream ss(spec.substr(eq + 1));
+      std::string ep;
+      while (std::getline(ss, ep, ',')) if (!ep.empty()) eps.push_back(ep);
+      g_state.set_endpoints(spec.substr(0, eq), std::move(eps));
+    } else {
+      fprintf(stderr, "usage: picker [--port N] [--pool name=ep1,ep2]...\n");
+      return 2;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr = {};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(srv, 64) != 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  fprintf(stderr, "picker listening on :%d\n", port);
+  while (!g_stop) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(handle, fd).detach();
+  }
+  return 0;
+}

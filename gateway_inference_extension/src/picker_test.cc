@@ -1,0 +1,99 @@
+// End-to-end test for the endpoint picker: starts the real binary, drives the
+// HTTP API, checks round-robin order, pool replacement, and the
+// x-gateway-destination-endpoint header contract.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+std::string http(int port, const std::string& raw) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr = {};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return "";
+  }
+  (void)!write(fd, raw.data(), raw.size());
+  std::string out;
+  char buf[4096];
+  ssize_t n;
+  while ((n = read(fd, buf, sizeof(buf))) > 0) out.append(buf, n);
+  close(fd);
+  return out;
+}
+
+std::string get(int port, const std::string& target) {
+  return http(port, "GET " + target + " HTTP/1.1\r\nHost: x\r\n\r\n");
+}
+
+std::string post(int port, const std::string& target, const std::string& body) {
+  return http(port, "POST " + target + " HTTP/1.1\r\nHost: x\r\nContent-Length: " +
+                        std::to_string(body.size()) + "\r\n\r\n" + body);
+}
+
+bool contains(const std::string& hay, const std::string& needle) {
+  return hay.find(needle) != std::string::npos;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* bin = argc > 1 ? argv[1] : "./picker";
+  int port = 19391;
+  pid_t pid = fork();
+  if (pid == 0) {
+    execl(bin, bin, "--port", std::to_string(port).c_str(), "--pool",
+          "default=10.0.0.2:8100,10.0.0.1:8100", nullptr);
+    perror("execl");
+    _exit(127);
+  }
+  // wait for readiness
+  bool up = false;
+  for (int i = 0; i < 100 && !up; i++) {
+    up = contains(get(port, "/healthz"), "200 OK");
+    if (!up) usleep(50 * 1000);
+  }
+  assert(up && "picker did not come up");
+
+  // round-robin over the *sorted* endpoint list (reference picker sorts by
+  // name first), header contract included
+  std::string p1 = get(port, "/pick?pool=default");
+  std::string p2 = get(port, "/pick?pool=default");
+  std::string p3 = get(port, "/pick?pool=default");
+  assert(contains(p1, "10.0.0.1:8100"));
+  assert(contains(p1, "x-gateway-destination-endpoint: 10.0.0.1:8100"));
+  assert(contains(p2, "10.0.0.2:8100"));
+  assert(contains(p3, "10.0.0.1:8100"));  // wrapped around
+
+  // unknown pool -> 503 empty-result semantics
+  assert(contains(get(port, "/pick?pool=nope"), "503"));
+
+  // pool replacement via POST /endpoints
+  assert(contains(
+      post(port, "/endpoints",
+           R"({"pool":"prefill","endpoints":["10.1.0.9:8100","10.1.0.3:8100"]})"),
+      "200 OK"));
+  assert(contains(get(port, "/pick?pool=prefill"), "10.1.0.3:8100"));
+  assert(contains(get(port, "/pools"), "prefill"));
+
+  // malformed body -> 400
+  assert(contains(post(port, "/endpoints", "{nope"), "400"));
+
+  kill(pid, SIGKILL);
+  waitpid(pid, nullptr, 0);
+  printf("picker_test: all checks passed\n");
+  return 0;
+}

@@ -288,6 +288,168 @@ class EngineServer:
         await resp.write_eof()
         return resp
 
+    def _check_pooling_model(self, body: dict):
+        """404/400 for unknown or adapter model names on the pooling endpoints
+        (embeddings run the base weights only)."""
+        model = body.get("model", self.cfg.name)
+        if model == self.cfg.name:
+            return None
+        if self.engine.lora is not None and self.engine.lora.is_adapter(model):
+            return web.json_response(
+                {"error": {"message": f"model {model!r} is a LoRA adapter; "
+                                      "pooling endpoints serve the base model"}},
+                status=400,
+            )
+        return web.json_response(
+            {"error": {"message": f"model {model!r} does not exist",
+                       "type": "NotFoundError", "code": 404}},
+            status=404,
+        )
+
+    def _tokenize_inputs(self, raw) -> list[list[int]]:
+        """OpenAI `input` field: str | [str] | [int] | [[int]] -> token lists."""
+        if isinstance(raw, str):
+            raw = [raw]
+        if not isinstance(raw, list):
+            raise ValueError("'input' must be a string or a list")
+        if raw and isinstance(raw[0], int):
+            raw = [raw]
+        out = []
+        for item in raw:
+            if isinstance(item, str):
+                out.append(self.engine.tokenizer.encode(item))
+            elif isinstance(item, list):
+                out.append([int(t) for t in item])
+            else:
+                raise ValueError(
+                    "'input' items must be strings or token-id lists"
+                )
+        return out
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI-compatible /v1/embeddings: mean-pooled, L2-normalized last
+        hidden states (surface parity with the router passthrough endpoint,
+        routers/main_router.py in /root/reference)."""
+        try:
+            body = await request.json()
+            inputs = self._tokenize_inputs(body.get("input", []))
+        except (ValueError, TypeError) as e:
+            return web.json_response({"error": {"message": f"invalid request: {e}"}}, status=400)
+        err = self._check_pooling_model(body)
+        if err is not None:
+            return err
+        if not inputs:
+            return web.json_response({"error": {"message": "'input' is required"}}, status=400)
+        try:
+            vecs = await self.engine.embed(inputs)
+        except (ValueError, RuntimeError) as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        total = sum(len(i) for i in inputs)
+        return web.json_response(
+            {
+                "object": "list",
+                "model": body.get("model", self.cfg.name),
+                "data": [
+                    {"object": "embedding", "index": i, "embedding": v.tolist()}
+                    for i, v in enumerate(vecs)
+                ],
+                "usage": {"prompt_tokens": total, "total_tokens": total},
+            }
+        )
+
+    async def rerank(self, request: web.Request) -> web.Response:
+        """/v1/rerank: order documents by cosine relevance to the query."""
+        try:
+            body = await request.json()
+            query = body["query"]
+            documents = list(body["documents"])
+            top_n = max(0, int(body.get("top_n", len(documents))))
+        except (KeyError, ValueError, TypeError) as e:
+            return web.json_response(
+                {"error": {"message": f"invalid request (need query, documents): {e}"}},
+                status=400,
+            )
+        err = self._check_pooling_model(body)
+        if err is not None:
+            return err
+        if not documents:
+            return web.json_response({"error": {"message": "'documents' is empty"}}, status=400)
+        try:
+            vecs = await self.engine.embed(self._tokenize_inputs([query] + documents))
+        except (ValueError, RuntimeError) as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        scores = vecs[1:] @ vecs[0]
+        order = sorted(range(len(documents)), key=lambda i: -float(scores[i]))[:top_n]
+        return web.json_response(
+            {
+                "id": f"rerank-{uuid.uuid4().hex[:16]}",
+                "model": body.get("model", self.cfg.name),
+                "results": [
+                    {
+                        "index": i,
+                        "document": {"text": documents[i]},
+                        "relevance_score": float(scores[i]),
+                    }
+                    for i in order
+                ],
+            }
+        )
+
+    async def score(self, request: web.Request) -> web.Response:
+        """/v1/score: cosine similarity for (text_1, text_2) pairs."""
+        try:
+            body = await request.json()
+            t1, t2 = body["text_1"], body["text_2"]
+        except (KeyError, ValueError, TypeError) as e:
+            return web.json_response(
+                {"error": {"message": f"invalid request (need text_1, text_2): {e}"}},
+                status=400,
+            )
+        err = self._check_pooling_model(body)
+        if err is not None:
+            return err
+        def as_items(x):
+            """str -> [str]; [int,...] -> [[int,...]]; [str|list,...] -> itself."""
+            if isinstance(x, str):
+                return [x]
+            if isinstance(x, list) and x and isinstance(x[0], int):
+                return [x]
+            if isinstance(x, list):
+                return x
+            raise TypeError("text fields must be strings or token-id lists")
+
+        try:
+            left = as_items(t1)
+            right = as_items(t2)
+        except TypeError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        if len(left) == 1:
+            left = left * len(right)
+        if len(left) != len(right):
+            return web.json_response(
+                {"error": {"message": "text_1 and text_2 lengths do not match"}},
+                status=400,
+            )
+        try:
+            inputs = self._tokenize_inputs(left + right)
+            vecs = await self.engine.embed(inputs)
+        except (ValueError, RuntimeError) as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        n = len(left)
+        return web.json_response(
+            {
+                "id": f"score-{uuid.uuid4().hex[:16]}",
+                "object": "list",
+                "model": body.get("model", self.cfg.name),
+                "data": [
+                    {"index": i, "object": "score",
+                     "score": float(vecs[i] @ vecs[n + i])}
+                    for i in range(n)
+                ],
+                "usage": {"prompt_tokens": sum(len(i) for i in inputs)},
+            }
+        )
+
     async def sleep(self, request: web.Request) -> web.Response:
         if not self.cfg.enable_sleep_mode:
             return web.json_response({"error": "sleep mode disabled"}, status=400)
@@ -327,7 +489,9 @@ class EngineServer:
         if not name:
             return web.json_response({"error": "lora_name is required"}, status=400)
         try:
-            self.engine.unload_lora_adapter(name)
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.unload_lora_adapter, name
+            )
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=400)
         return web.json_response({"status": "success", "lora_name": name})
@@ -346,6 +510,10 @@ class EngineServer:
         r.add_post("/detokenize", self.detokenize)
         r.add_post("/v1/chat/completions", self.chat_completions)
         r.add_post("/v1/completions", self.completions)
+        r.add_post("/v1/embeddings", self.embeddings)
+        r.add_post("/v1/rerank", self.rerank)
+        r.add_post("/v2/rerank", self.rerank)
+        r.add_post("/v1/score", self.score)
         r.add_post("/sleep", self.sleep)
         r.add_post("/wake_up", self.wake_up)
         r.add_get("/is_sleeping", self.is_sleeping)

@@ -124,6 +124,7 @@ class LLMEngine:
         self.total_generation_tokens = 0
         self.num_preemptions = 0
 
+
     def _make_offload_connector(self, cfg: EngineConfig):
         """Build the LMCache-equivalent offload connector when any tier or the
         KV-index controller is configured (SURVEY.md §7 step 5). A
@@ -195,14 +196,8 @@ class LLMEngine:
         lora_name: Optional[str] = None,
     ) -> AsyncIterator[RequestOutput]:
         params = params or SamplingParams()
-        lora_slot, cache_salt = 0, b""
-        if lora_name:
-            if self.lora is None:
-                raise ValueError("LoRA is not enabled (--enable-lora)")
-            if not self.lora.is_adapter(lora_name):
-                raise ValueError(f"LoRA adapter {lora_name!r} is not loaded")
-            lora_slot = self.lora.slot_for(lora_name)
-            cache_salt = self.lora.cache_salt(lora_name)
+        if lora_name and self.lora is None:
+            raise ValueError("LoRA is not enabled (--enable-lora)")
         if prompt_token_ids is None:
             prompt_token_ids = self.tokenizer.encode(prompt or "")
         if not prompt_token_ids:
@@ -214,6 +209,11 @@ class LLMEngine:
             )
         if self._sleeping:
             raise RuntimeError("engine is sleeping")
+        lora_slot, cache_salt = 0, b""
+        if lora_name:
+            # atomic resolve+pin, LAST before enqueue: every later path runs
+            # inside the try/finally, so the ref is always released
+            lora_slot, cache_salt = self.lora.acquire(lora_name)
         out_q: asyncio.Queue = asyncio.Queue()
         loop = asyncio.get_running_loop()
         with self._lock:
@@ -234,6 +234,8 @@ class LLMEngine:
             with self._lock:
                 self._outputs.pop(seq_id, None)
                 self._texts.pop(seq_id, None)
+            if lora_slot:
+                self.lora.release(lora_slot)
             self._inbox.put(("abort", seq_id))
 
     def abort(self, seq_id: str) -> None:
@@ -251,8 +253,8 @@ class LLMEngine:
             block = False
             if item is None:
                 return
-            if isinstance(item, tuple) and item[0] == "lora_cmd":
-                item[1]()  # adapter load/unload, serialized with the step loop
+            if isinstance(item, tuple) and item[0] == "device_cmd":
+                item[1]()  # LoRA update / embed forward, serialized with steps
             elif isinstance(item, tuple) and item[0] == "abort":
                 for s in self.scheduler.waiting + self.scheduler.running:
                     if s.seq_id == item[1] and not s.finished:
@@ -382,33 +384,52 @@ class LLMEngine:
         if self.lora is None:
             raise ValueError("LoRA is not enabled (--enable-lora)")
 
-        def run():
-            if op == "load":
-                return self.lora.load(name, path)
-            slot = self.lora.slot_for(name)  # 0 when not loaded
-            in_use = slot != 0 and any(
-                s.lora_slot == slot
-                for s in self.scheduler.waiting + self.scheduler.running
-                if not s.finished
-            )
-            return self.lora.unload(name, in_use=in_use)
+        if op == "load":
+            # cheap prechecks before the (possibly large) checkpoint read;
+            # load_parsed re-checks authoritatively under the manager lock
+            from production_stack_tpu.engine.lora import LoRAError
 
+            if self.lora.is_adapter(name):
+                raise LoRAError(f"adapter {name!r} is already loaded")
+            if not self.lora.has_free_slot():
+                raise LoRAError(f"no free LoRA slots (max_loras={self.cfg.max_loras})")
+            # parse on the caller thread: no disk I/O on the device thread
+            tensors, scale = self.lora.read_checkpoint(path)
+
+            def run():
+                return self.lora.load_parsed(name, tensors, scale)
+        else:
+            def run():
+                slot = self.lora.slot_for(name)  # 0 when not loaded
+                in_use = slot != 0 and any(
+                    s.lora_slot == slot
+                    for s in self.scheduler.waiting + self.scheduler.running
+                    if not s.finished
+                )
+                return self.lora.unload(name, in_use=in_use)
+
+        return self._run_on_device_thread(run, what=f"LoRA {op} of {name!r}")
+
+    def _run_on_device_thread(self, fn, what: str = "device command"):
+        """Execute `fn` on the engine-loop thread between steps (device-state
+        mutations and extra forwards must not race the step loop). Runs inline
+        when the loop is not running."""
         if self._thread is None or not self._thread.is_alive():
-            return run()
+            return fn()
         done = threading.Event()
         box: dict = {}
 
         def cmd():
             try:
-                box["result"] = run()
+                box["result"] = fn()
             except BaseException as e:  # surfaced on the caller thread
                 box["error"] = e
             finally:
                 done.set()
 
-        self._inbox.put(("lora_cmd", cmd))
+        self._inbox.put(("device_cmd", cmd))
         if not done.wait(timeout=120):
-            raise TimeoutError(f"LoRA {op} of {name!r} timed out")
+            raise TimeoutError(f"{what} timed out")
         if "error" in box:
             raise box["error"]
         return box.get("result")
@@ -427,6 +448,66 @@ class LLMEngine:
 
     def list_lora_adapters(self) -> list[str]:
         return self.lora.list_adapters() if self.lora is not None else []
+
+    _EMBED_T_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+    _EMBED_B_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+    async def embed(self, token_id_lists: list[list[int]]) -> np.ndarray:
+        """Pooled unit-norm embeddings for a batch of tokenized inputs
+        ([N, hidden_size] float32). Serves /v1/embeddings, /v1/rerank,
+        /v1/score. Runs on the device thread, bucketed like generation."""
+        if self._sleeping:
+            raise RuntimeError("engine is sleeping")
+        for ids in token_id_lists:
+            if len(ids) > self.cfg.max_model_len:
+                raise ValueError(
+                    f"input has {len(ids)} tokens, max_model_len is "
+                    f"{self.cfg.max_model_len}"
+                )
+
+        def bucket(n, buckets):
+            for b in buckets:
+                if n <= b:
+                    return b
+            return buckets[-1]
+
+        out = np.zeros((len(token_id_lists), self.model_cfg.hidden_size), np.float32)
+        loop = asyncio.get_running_loop()
+        # one device pass per B-bucket group of similar lengths
+        order = sorted(range(len(token_id_lists)), key=lambda i: len(token_id_lists[i]))
+        pos = 0
+        while pos < len(order):
+            group = order[pos : pos + self._EMBED_B_BUCKETS[-1]]
+            pos += len(group)
+            B = bucket(len(group), self._EMBED_B_BUCKETS)
+            t_raw = max(max(len(token_id_lists[i]) for i in group), 1)
+            T = bucket(t_raw, self._EMBED_T_BUCKETS)
+            if T < t_raw:  # longer than the largest preset bucket: next pow2
+                T = 1 << (t_raw - 1).bit_length()
+            input_ids = np.zeros((B, T), np.int32)
+            positions = np.full((B, T), -1, np.int32)
+            for row, i in enumerate(group):
+                ids = token_id_lists[i]
+                input_ids[row, : len(ids)] = ids
+                positions[row, : len(ids)] = np.arange(len(ids))
+            def encode_cmd(input_ids=input_ids, positions=positions):
+                if self._sleeping:  # may have gone to sleep since the check above
+                    raise RuntimeError("engine is sleeping")
+                return self.runner.encode(input_ids, positions)
+
+            vecs = await loop.run_in_executor(
+                None,
+                lambda: np.asarray(
+                    self._run_on_device_thread(encode_cmd, what="embedding forward")
+                ),
+            )
+            for row, i in enumerate(group):
+                out[i] = vecs[row]
+            with self._lock:
+                self.total_prompt_tokens += sum(
+                    len(token_id_lists[i]) for i in group
+                )
+        return out
 
     def sleep(self, level: int = 1) -> None:
         """Free HBM without killing the process. Level 1 drops the KV pools;

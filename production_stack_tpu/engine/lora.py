@@ -60,6 +60,7 @@ class LoRAManager:
         self._slots: dict[str, int] = {}  # name -> slot (1-based; 0 = base)
         self._gen = 0  # bumped per load: versions the prefix-cache salt
         self._salt_gen: dict[str, int] = {}  # name -> generation of current load
+        self._refs: dict[int, int] = {}  # slot -> in-flight request count
 
     # -- queries -------------------------------------------------------------
 
@@ -78,6 +79,31 @@ class LoRAManager:
         with self._lock:
             return name in self._slots
 
+    def acquire(self, name: str) -> tuple[int, bytes]:
+        """Atomically resolve an adapter for a request and pin its slot
+        (refcounted) so unload cannot clear or re-target it while the request
+        is in flight. Pair with release(). Single-lock atomicity closes the
+        resolve-then-increment race a separate counter would have."""
+        with self._lock:
+            slot = self._slots.get(name)
+            if slot is None:
+                raise LoRAError(f"LoRA adapter {name!r} is not loaded")
+            self._refs[slot] = self._refs.get(slot, 0) + 1
+            gen = self._salt_gen[name]
+            return slot, f"lora:{name}:{gen}".encode()
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            n = self._refs.get(slot, 0) - 1
+            if n > 0:
+                self._refs[slot] = n
+            else:
+                self._refs.pop(slot, None)
+
+    def has_free_slot(self) -> bool:
+        with self._lock:
+            return len(self._slots) < self.max_loras
+
     def cache_salt(self, name: str) -> bytes:
         """Prefix-cache salt for an adapter. Versioned per load(): reloading a
         retrained checkpoint under the same name gets a fresh salt, so pages
@@ -89,12 +115,18 @@ class LoRAManager:
     # -- load / unload -------------------------------------------------------
 
     def load(self, name: str, path: str) -> int:
-        """Load a PEFT adapter directory into a free slot; returns the slot.
+        """Parse + load a PEFT adapter directory into a free slot."""
+        tensors, scale = self.read_checkpoint(path)
+        return self.load_parsed(name, tensors, scale)
+
+    def load_parsed(self, name: str, tensors: dict, scale: float) -> int:
+        """Write pre-parsed adapter weights into a free slot; returns the slot.
 
         Device-buffer writes must be serialized with the engine step loop —
-        LLMEngine routes load/unload through its inbox so they execute on the
-        device thread between steps (no concurrent donation of live buffers).
-        """
+        LLMEngine parses the checkpoint on the HTTP executor thread
+        (read_checkpoint) and routes only this device write through its inbox
+        so it executes on the device thread between steps (no concurrent
+        donation of live buffers, no disk I/O under the lock)."""
         with self._lock:
             if name in self._slots:
                 raise LoRAError(f"adapter {name!r} is already loaded")
@@ -108,20 +140,22 @@ class LoRAManager:
                     f"loaded={sorted(self._slots)})"
                 )
             slot = free[0]
-            tensors, scale = self._read_peft(path)
             self.runner.set_lora_slot(slot, tensors, scale)
             self._gen += 1
             self._salt_gen[name] = self._gen
             self._slots[name] = slot
-            logger.info("loaded LoRA adapter %r from %s into slot %d", name, path, slot)
+            logger.info("loaded LoRA adapter %r into slot %d", name, slot)
             return slot
 
     def unload(self, name: str, in_use: bool = False) -> None:
+        """Unload an adapter. Refuses while requests hold the slot (acquire()
+        refs, checked under the same lock) or when the caller supplies an
+        extra in-use signal (e.g. a scheduler scan)."""
         with self._lock:
             slot = self._slots.get(name)
             if slot is None:
                 raise LoRAError(f"adapter {name!r} is not loaded")
-            if in_use:
+            if in_use or self._refs.get(slot, 0) > 0:
                 raise LoRAError(
                     f"adapter {name!r} has in-flight requests; retry when drained"
                 )
@@ -132,7 +166,7 @@ class LoRAManager:
 
     # -- PEFT checkpoint parsing --------------------------------------------
 
-    def _read_peft(self, path: str) -> tuple[dict, float]:
+    def read_checkpoint(self, path: str) -> tuple[dict, float]:
         """Read adapter_config.json + adapter_model.safetensors into stacked
         per-target arrays ``{a_<t>: [L, in, R], b_<t>: [L, R, out]}``."""
         cfg_path = os.path.join(path, "adapter_config.json")

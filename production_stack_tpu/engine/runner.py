@@ -113,6 +113,7 @@ class ModelRunner:
             donate_argnums=(1, 2),
         )
         self._set_page_fn = None  # built lazily in set_page
+        self._encode = None       # built lazily in encode (pooled embeddings)
 
     def step(self, inp: StepInput) -> tuple[jnp.ndarray, jnp.ndarray]:
         """Run one forward+sample step. Returns (token_ids [B], logits [B, V])."""
@@ -143,6 +144,23 @@ class ModelRunner:
             lora_ids,
         )
         return ids, logits
+
+    def encode(self, input_ids, positions) -> jnp.ndarray:
+        """Pooled-embedding forward ([B, T] -> [B, H] unit vectors). Shapes
+        must arrive bucketed (engine quantizes B and T)."""
+        if self._encode is None:
+            if not hasattr(self.module, "encode"):
+                raise ValueError(
+                    f"embeddings are not supported for model family "
+                    f"{self.module.__name__.rsplit('.', 1)[-1]!r}"
+                )
+            self._encode = jax.jit(
+                functools.partial(self.module.encode, cfg=self.cfg)
+            )
+        row = lambda x: jax.device_put(jnp.asarray(x, jnp.int32), self._row_sh)
+        return self._encode(
+            params=self.params, input_ids=row(input_ids), positions=row(positions)
+        )
 
     # -- LoRA slot management (engine/lora.py drives these) ------------------
 

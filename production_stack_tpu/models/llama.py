@@ -267,6 +267,73 @@ def init_lora_buffers(
     return {"layers": layers, "scale": jnp.zeros((S,), jnp.float32)}
 
 
+def _qkv(h, lp, cfg: LlamaConfig, B: int, T: int, cos, sin, proj):
+    """Shared q/k/v projection + bias + rope (forward and encode paths)."""
+    q = proj(h, "wq").reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = proj(h, "wk").reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = proj(h, "wv").reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.attention_bias:
+        q = q + lp["bq"].reshape(cfg.num_heads, cfg.head_dim)
+        k = k + lp["bk"].reshape(cfg.num_kv_heads, cfg.head_dim)
+        v = v + lp["bv"].reshape(cfg.num_kv_heads, cfg.head_dim)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+
+def _mlp_residual(x, lp, cfg: LlamaConfig, proj):
+    """Shared post-attention MLP (dense or MoE) residual block."""
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    if cfg.num_experts:
+        return x + _moe_block(h, lp, cfg)
+    return x + proj(jax.nn.silu(proj(h, "w_gate")) * proj(h, "w_up"), "w_down")
+
+
+def _plain_proj(lp):
+    return lambda h, name: h @ lp[name]
+
+
+def encode(
+    params: dict,
+    cfg: LlamaConfig,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+) -> jnp.ndarray:
+    """Pooled-embedding forward: one dense causal pass (no KV pages), masked
+    mean-pool over valid tokens of the final hidden layer, L2-normalized.
+
+    Serves /v1/embeddings, /v1/rerank, /v1/score — surface parity with the
+    reference router's passthrough endpoints (routers/main_router.py:45-231 in
+    /root/reference), which assume an engine that can embed.
+
+    Args:
+      input_ids: [B, T] int32, padded rows have position -1.
+      positions: [B, T] absolute positions, -1 for padding.
+    Returns [B, H] float32 unit vectors.
+    """
+    B, T = input_ids.shape
+    x = params["embed"][input_ids].astype(cfg.dtype)
+    cos, sin = rope_cos_sin(
+        jnp.maximum(positions, 0), cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+    valid = positions >= 0  # [B, T]
+
+    def layer(x, lp):
+        proj = _plain_proj(lp)
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(h, lp, cfg, B, T, cos, sin, proj)
+        attn = flash_attention(
+            q, k, v, q_positions=positions, kv_lens=jnp.sum(valid, axis=1),
+            window=cfg.sliding_window,
+        )
+        x = x + proj(attn.reshape(B, T, -1), "wo")
+        return _mlp_residual(x, lp, cfg, proj), None
+
+    x, _ = lax.scan(layer, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps).astype(jnp.float32)
+    mask = valid.astype(jnp.float32)[..., None]
+    pooled = (x * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
 def _moe_block(h: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
     """Mixtral sparse-MoE MLP, computed densely over experts.
 
@@ -341,15 +408,7 @@ def forward(
             return y
 
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = proj(h, "wq").reshape(B, T, cfg.num_heads, cfg.head_dim)
-        k = proj(h, "wk").reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        v = proj(h, "wv").reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        if cfg.attention_bias:
-            q = q + lp["bq"].reshape(cfg.num_heads, cfg.head_dim)
-            k = k + lp["bk"].reshape(cfg.num_kv_heads, cfg.head_dim)
-            v = v + lp["bv"].reshape(cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = _qkv(h, lp, cfg, B, T, cos, sin, proj)
         kp, vp = write_kv_pages(kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions)
         if T == 1 and cfg.attn_impl.startswith("pallas") and cfg.sliding_window is None:
             # decode: stream pages HBM->VMEM, no gather materialization
@@ -368,12 +427,7 @@ def forward(
                 window=cfg.sliding_window,
             )
         x = x + proj(attn.reshape(B, T, -1), "wo")
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        if cfg.num_experts:
-            x = x + _moe_block(h, lp, cfg)
-        else:
-            x = x + proj(jax.nn.silu(proj(h, "w_gate")) * proj(h, "w_up"), "w_down")
-        return x, (kp, vp)
+        return _mlp_residual(x, lp, cfg, proj), (kp, vp)
 
     x, (k_pages, v_pages) = lax.scan(
         layer,

@@ -1,0 +1,181 @@
+"""Embeddings / rerank / score: model-level pooled encoder and the OpenAI
+HTTP surface (parity with the router's passthrough endpoints /v1/embeddings,
+/v1/rerank, /v1/score — routers/main_router.py in /root/reference)."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.models import llama
+
+CFG = llama.PRESETS["llama-debug"]
+
+
+def test_encode_pooling_and_norm():
+    """Unit vectors; padding must not affect the pooled embedding."""
+    params = llama.init_params(CFG, jax.random.key(0))
+    ids = np.array([[5, 6, 7, 8]], np.int32)
+    pos = np.array([[0, 1, 2, 3]], np.int32)
+    v1 = llama.encode(params, CFG, ids, pos)
+    assert v1.shape == (1, CFG.hidden_size)
+    np.testing.assert_allclose(np.linalg.norm(v1, axis=-1), 1.0, rtol=1e-5)
+
+    # same tokens, longer padded buffer -> same embedding
+    ids2 = np.zeros((1, 16), np.int32)
+    pos2 = np.full((1, 16), -1, np.int32)
+    ids2[0, :4] = [5, 6, 7, 8]
+    pos2[0, :4] = range(4)
+    v2 = llama.encode(params, CFG, ids2, pos2)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), atol=2e-2)
+
+    # identical inputs agree, different inputs differ
+    batch_ids = np.zeros((2, 4), np.int32)
+    batch_pos = np.broadcast_to(np.arange(4, dtype=np.int32), (2, 4)).copy()
+    batch_ids[0] = [5, 6, 7, 8]
+    batch_ids[1] = [9, 10, 11, 12]
+    vb = np.asarray(llama.encode(params, CFG, batch_ids, batch_pos))
+    sim_self = float(np.asarray(v1)[0] @ np.asarray(vb)[0])
+    sim_other = float(np.asarray(v1)[0] @ np.asarray(vb)[1])
+    assert sim_self > 0.999
+    assert sim_other < sim_self
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = LLMEngine(
+        EngineConfig(model="llama-debug", max_model_len=256, num_pages=64,
+                     page_size=8)
+    )
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_engine_embed_batched_buckets(engine):
+    texts = ["alpha beta", "gamma", "delta epsilon zeta eta theta", "iota"]
+    token_lists = [engine.tokenizer.encode(t) for t in texts]
+    vecs = asyncio.run(engine.embed(token_lists))
+    assert vecs.shape == (4, CFG.hidden_size)
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=-1), 1.0, rtol=1e-4)
+    # results keyed to input order regardless of length-sorted batching
+    solo = asyncio.run(engine.embed([token_lists[2]]))
+    assert float(solo[0] @ vecs[2]) > 0.999
+
+
+def test_engine_embed_too_long_rejected(engine):
+    with pytest.raises(ValueError, match="max_model_len"):
+        asyncio.run(engine.embed([[1] * 500]))
+
+
+def test_http_embeddings_rerank_score():
+    import requests
+
+    from production_stack_tpu.testing.procs import (
+        free_port, start_proc, stop_proc, wait_healthy,
+    )
+
+    port = free_port()
+    proc = start_proc(
+        [
+            "-m", "production_stack_tpu.engine.api_server",
+            "--model", "llama-debug", "--port", str(port),
+            "--max-model-len", "256", "--num-pages", "64", "--page-size", "8",
+        ],
+    )
+    try:
+        wait_healthy(f"http://127.0.0.1:{port}/health", proc, timeout=180)
+        base = f"http://127.0.0.1:{port}"
+
+        r = requests.post(
+            f"{base}/v1/embeddings",
+            json={"input": ["hello world", "goodbye"]}, timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        data = r.json()
+        assert len(data["data"]) == 2
+        assert data["usage"]["prompt_tokens"] > 0
+        v0 = np.array(data["data"][0]["embedding"])
+        assert abs(np.linalg.norm(v0) - 1.0) < 1e-3
+
+        r = requests.post(
+            f"{base}/v1/rerank",
+            json={"query": "hello world",
+                  "documents": ["hello world", "unrelated text", "hello"],
+                  "top_n": 2},
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        results = r.json()["results"]
+        assert len(results) == 2
+        # identical document must rank first with ~1.0 relevance
+        assert results[0]["index"] == 0
+        assert results[0]["relevance_score"] > 0.99
+
+        r = requests.post(
+            f"{base}/v1/score",
+            json={"text_1": "hello world", "text_2": ["hello world", "other"]},
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        scores = r.json()["data"]
+        assert scores[0]["score"] > 0.99
+        assert scores[0]["score"] >= scores[1]["score"]
+
+        # malformed bodies -> 400
+        assert requests.post(f"{base}/v1/embeddings", json={}, timeout=30).status_code == 400
+        assert requests.post(f"{base}/v1/rerank", json={"query": "x"}, timeout=30).status_code == 400
+        assert requests.post(f"{base}/v1/score", json={"text_1": "x"}, timeout=30).status_code == 400
+    finally:
+        stop_proc(proc)
+
+
+def test_embed_rounds_t_bucket_up_not_down(engine, monkeypatch):
+    """Inputs longer than the largest preset T bucket must round UP to the
+    next power of two (bounded by max_model_len), never clamp down."""
+    monkeypatch.setattr(LLMEngine, "_EMBED_T_BUCKETS", (16, 32))
+    ids = list(range(1, 101))  # 100 tokens > largest patched bucket (32)
+    vecs = asyncio.run(engine.embed([ids]))
+    assert vecs.shape[0] == 1
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=-1), 1.0, rtol=1e-4)
+
+
+def test_embed_unknown_model_rejected():
+    import requests
+
+    from production_stack_tpu.testing.procs import (
+        free_port, start_proc, stop_proc, wait_healthy,
+    )
+
+    port = free_port()
+    proc = start_proc(
+        [
+            "-m", "production_stack_tpu.engine.api_server",
+            "--model", "llama-debug", "--port", str(port),
+            "--max-model-len", "256", "--num-pages", "64", "--page-size", "8",
+        ],
+    )
+    try:
+        wait_healthy(f"http://127.0.0.1:{port}/health", proc, timeout=180)
+        base = f"http://127.0.0.1:{port}"
+        r = requests.post(
+            f"{base}/v1/embeddings", json={"model": "nope", "input": "x"},
+            timeout=60,
+        )
+        assert r.status_code == 404
+        r = requests.post(
+            f"{base}/v1/rerank",
+            json={"model": "nope", "query": "q", "documents": ["d"]}, timeout=30,
+        )
+        assert r.status_code == 404
+        # malformed top_n -> 400, not 500
+        r = requests.post(
+            f"{base}/v1/rerank",
+            json={"query": "q", "documents": ["d"], "top_n": "all"}, timeout=30,
+        )
+        assert r.status_code == 400
+    finally:
+        stop_proc(proc)

@@ -1,0 +1,47 @@
+"""Native (C++) components: build + run their self-tests.
+
+Covers the operator (json_test) and the gateway inference extension's
+endpoint picker (picker_test) — the reference exercises its Go operator via
+envtest and its picker via the kgateway plugin harness (SURVEY.md §4.4); here
+both are compiled binaries with freestanding tests.
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("cmake") is None or shutil.which("ninja") is None,
+    reason="needs cmake + ninja",
+)
+
+
+def _build(src_dir: Path) -> Path:
+    build = src_dir / "build"
+    subprocess.run(
+        ["cmake", "-S", str(src_dir), "-B", str(build), "-G", "Ninja"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(["ninja", "-C", str(build)], check=True, capture_output=True)
+    return build
+
+
+def test_operator_json_test():
+    build = _build(REPO / "operator")
+    out = subprocess.run(
+        [str(build / "json_test")], check=True, capture_output=True, text=True
+    )
+    assert "all checks passed" in out.stdout
+
+
+def test_gateway_picker_test():
+    build = _build(REPO / "gateway_inference_extension")
+    out = subprocess.run(
+        [str(build / "picker_test"), str(build / "picker")],
+        check=True, capture_output=True, text=True, timeout=60,
+    )
+    assert "all checks passed" in out.stdout

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Render the chart against every example values file to catch template errors
+# (parity: /root/reference utils/helm-chart-test-values.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+for v in helm/values.yaml; do
+  echo "=== helm template with $v"
+  helm template test-release ./helm -f "$v" >/dev/null
+done
+echo "all values files render"

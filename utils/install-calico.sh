@@ -1,0 +1,6 @@
+#!/bin/bash
+# Install the Calico CNI (parity: /root/reference utils/install-calico.sh).
+set -euo pipefail
+kubectl create -f https://raw.githubusercontent.com/projectcalico/calico/v3.28.0/manifests/tigera-operator.yaml
+kubectl create -f https://raw.githubusercontent.com/projectcalico/calico/v3.28.0/manifests/custom-resources.yaml
+kubectl wait --for=condition=Available tigera-operator -n tigera-operator --timeout=300s || true

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Install the CRI-O container runtime (parity: /root/reference utils/install-cri-o.sh).
+set -euo pipefail
+CRIO_VERSION=${CRIO_VERSION:-v1.30}
+curl -fsSL "https://pkgs.k8s.io/addons:/cri-o:/stable:/${CRIO_VERSION}/deb/Release.key" \
+  | sudo gpg --dearmor -o /etc/apt/keyrings/cri-o-apt-keyring.gpg
+echo "deb [signed-by=/etc/apt/keyrings/cri-o-apt-keyring.gpg] https://pkgs.k8s.io/addons:/cri-o:/stable:/${CRIO_VERSION}/deb/ /" \
+  | sudo tee /etc/apt/sources.list.d/cri-o.list
+sudo apt-get update && sudo apt-get install -y cri-o
+sudo systemctl enable --now crio

@@ -1,0 +1,6 @@
+#!/bin/bash
+# Install helm (parity: /root/reference utils/install-helm.sh).
+set -euo pipefail
+if command -v helm >/dev/null; then echo "helm already installed"; exit 0; fi
+curl -fsSL https://raw.githubusercontent.com/helm/helm/main/scripts/get-helm-3 | bash
+helm version

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Create a kind cluster for stack e2e tests (parity:
+# /root/reference utils/install-kind-cluster.sh). No accelerator needed:
+# engines run the fake-tpu backend or CPU debug models in CI.
+set -euo pipefail
+"$(dirname "$0")/install-kind.sh"
+"$(dirname "$0")/install-kubectl.sh"
+"$(dirname "$0")/install-helm.sh"
+kind create cluster --name production-stack-tpu --wait 120s || true
+kubectl cluster-info --context kind-production-stack-tpu

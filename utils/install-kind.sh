@@ -1,0 +1,8 @@
+#!/bin/bash
+# Install kind (parity: /root/reference utils/install-kind.sh).
+set -euo pipefail
+if command -v kind >/dev/null; then echo "kind already installed"; exit 0; fi
+ARCH=$(uname -m); case "$ARCH" in x86_64) ARCH=amd64;; aarch64) ARCH=arm64;; esac
+curl -Lo ./kind "https://kind.sigs.k8s.io/dl/latest/kind-linux-${ARCH}"
+chmod +x ./kind && sudo mv ./kind /usr/local/bin/kind
+kind version

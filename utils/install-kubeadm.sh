@@ -1,0 +1,16 @@
+#!/bin/bash
+# kubeadm-based multi-node bootstrap (parity: /root/reference
+# utils/install-kubeadm.sh). Run on each node; `kubeadm init` on the control
+# plane, then join workers with the printed token.
+set -euo pipefail
+KUBE_VERSION=${KUBE_VERSION:-v1.30}
+sudo apt-get update
+sudo apt-get install -y apt-transport-https ca-certificates curl gpg
+curl -fsSL "https://pkgs.k8s.io/core:/stable:/${KUBE_VERSION}/deb/Release.key" \
+  | sudo gpg --dearmor -o /etc/apt/keyrings/kubernetes-apt-keyring.gpg
+echo "deb [signed-by=/etc/apt/keyrings/kubernetes-apt-keyring.gpg] https://pkgs.k8s.io/core:/stable:/${KUBE_VERSION}/deb/ /" \
+  | sudo tee /etc/apt/sources.list.d/kubernetes.list
+sudo apt-get update
+sudo apt-get install -y kubelet kubeadm kubectl
+sudo apt-mark hold kubelet kubeadm kubectl
+echo "run: sudo kubeadm init --pod-network-cidr=192.168.0.0/16 (control plane)"

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Install kubectl (parity: /root/reference utils/install-kubectl.sh).
+set -euo pipefail
+if command -v kubectl >/dev/null; then echo "kubectl already installed"; exit 0; fi
+ARCH=$(uname -m); case "$ARCH" in x86_64) ARCH=amd64;; aarch64) ARCH=arm64;; esac
+VER=$(curl -Ls https://dl.k8s.io/release/stable.txt)
+curl -LO "https://dl.k8s.io/release/${VER}/bin/linux/${ARCH}/kubectl"
+sudo install -o root -g root -m 0755 kubectl /usr/local/bin/kubectl
+rm kubectl
+kubectl version --client

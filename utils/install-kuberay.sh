@@ -1,0 +1,6 @@
+#!/bin/bash
+# The reference needs KubeRay for pipeline-parallel vLLM (ray-cluster.yaml).
+# The TPU stack does NOT use Ray: multi-host PP runs on the JAX multi-controller
+# runtime with a coordination-service rendezvous (parallel/pipeline.py), so
+# this script exists only to document the difference and is a no-op.
+echo "production-stack-tpu: KubeRay is not required (JAX multi-host replaces Ray PP)."

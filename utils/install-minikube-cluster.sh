@@ -1,0 +1,14 @@
+#!/bin/bash
+# Bootstrap a single-node minikube cluster for the TPU stack (parity:
+# /root/reference utils/install-minikube-cluster.sh, minus the GPU operator —
+# TPU nodes advertise google.com/tpu via the GKE device plugin instead of
+# nvidia.com/gpu, and minikube runs engines in CPU/fake mode).
+set -euo pipefail
+"$(dirname "$0")/install-kubectl.sh"
+"$(dirname "$0")/install-helm.sh"
+if ! command -v minikube >/dev/null; then
+  curl -LO https://storage.googleapis.com/minikube/releases/latest/minikube-linux-amd64
+  sudo install minikube-linux-amd64 /usr/local/bin/minikube && rm minikube-linux-amd64
+fi
+minikube start --driver=docker --memory=8g --cpus=4
+kubectl get nodes
