@@ -22,6 +22,7 @@ import numpy as np
 def main() -> None:
     import dataclasses
 
+    from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.engine.runner import ModelRunner, StepInput
     from production_stack_tpu.models import llama
 
@@ -64,7 +65,10 @@ def main() -> None:
 
     # --- decode throughput: batch of decode_batch sequences at ~1k context ---
     B = decode_batch
-    ctx = ctx_pages * page_size - 1
+    k = EngineConfig().decode_steps  # fused burst length, as LLMEngine serves
+    # leave k KV slots of headroom so the burst never writes past the pages
+    # each row owns
+    ctx = ctx_pages * page_size - k - 1
     pt = np.arange(B * ctx_pages).reshape(B, ctx_pages)
     dec = StepInput(
         input_ids=rng.randint(0, cfg.vocab_size, (B, 1)),
@@ -75,15 +79,18 @@ def main() -> None:
         top_k=np.full(B, 40),
         top_p=np.full(B, 0.95),
     )
-    ids, _ = runner.step(dec)  # compile
-    jax.block_until_ready(ids)
-    steps = 50
+    # engine decode path: fused multi-step bursts — one dispatch yields k
+    # tokens/seq, amortizing host<->device round trips exactly as LLMEngine
+    # serves
+    toks = runner.step_multi(dec, k)  # compile
+    jax.block_until_ready(toks)
+    bursts = 16
     t0 = time.perf_counter()
-    for _ in range(steps):
-        ids, _ = runner.step(dec)
-    jax.block_until_ready(ids)
+    for _ in range(bursts):
+        toks = runner.step_multi(dec, k)
+    jax.block_until_ready(toks)
     dt = time.perf_counter() - t0
-    decode_tps = B * steps / dt
+    decode_tps = B * k * bursts / dt
 
     print(
         json.dumps(

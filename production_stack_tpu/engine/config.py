@@ -27,6 +27,10 @@ class EngineConfig:
     kv_cache_memory_gb: float = 4.0
     prefill_chunk: int = 512
     prefill_batch: int = 4
+    # fused decode burst: tokens produced per device program dispatch. >1
+    # amortizes host<->device round trips (runner.step_multi); surplus tokens
+    # after EOS are discarded host-side.
+    decode_steps: int = 8
     enable_prefix_caching: bool = True
     enable_chunked_prefill: bool = True
     tensor_parallel_size: int = 1

@@ -109,6 +109,7 @@ class LLMEngine:
             prefill_chunk=cfg.prefill_chunk if cfg.enable_chunked_prefill else 10**9,
             prefill_batch=cfg.prefill_batch,
             enable_prefix_caching=cfg.enable_prefix_caching,
+            decode_steps=cfg.decode_steps,
         )
         self._inbox: queue_mod.Queue = queue_mod.Queue()
         self._outputs: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
@@ -277,14 +278,18 @@ class LLMEngine:
             if batch is None:
                 continue
             try:
-                ids, _ = self.runner.step(
-                    StepInput(
-                        batch.input_ids, batch.positions, batch.page_table,
-                        batch.kv_lens, batch.temperature, batch.top_k, batch.top_p,
-                        lora_ids=batch.lora_ids,
-                    )
+                inp = StepInput(
+                    batch.input_ids, batch.positions, batch.page_table,
+                    batch.kv_lens, batch.temperature, batch.top_k, batch.top_p,
+                    lora_ids=batch.lora_ids, kv_limits=batch.kv_limits,
                 )
-                tokens = np.asarray(ids)
+                if batch.kind == "decode" and self.scheduler.decode_steps > 1:
+                    tokens = np.asarray(
+                        self.runner.step_multi(inp, self.scheduler.decode_steps)
+                    )  # [B, k]
+                else:
+                    ids, _ = self.runner.step(inp)
+                    tokens = np.asarray(ids)
             except Exception:
                 logger.exception("engine step failed; aborting batch")
                 for s in batch.seqs:
@@ -301,12 +306,20 @@ class LLMEngine:
             if self._kv_sender is not None:
                 # ship KV before emitting the finish event: the prefill HTTP
                 # response must not return until the decode peer holds the KV
+                pushed = set()
                 for s, _ in events:
-                    if s.finished:
+                    if s.finished and s.seq_id not in pushed:
+                        pushed.add(s.seq_id)
                         self._push_finished_kv(s)
+            # group burst events per sequence: one RequestOutput per seq per
+            # device step, carrying every new token (finished only on the
+            # last, so consumers never drop trailing burst tokens)
+            grouped: dict[str, tuple[Sequence, list[int]]] = {}
             for s, tok in events:
-                self.total_generation_tokens += 1
-                self._process_token(s)
+                grouped.setdefault(s.seq_id, (s, []))[1].append(tok)
+            for s, toks in grouped.values():
+                self.total_generation_tokens += len(toks)
+                self._process_token(s, toks)
         logger.info("engine loop exited")
 
     def _push_finished_kv(self, seq: Sequence) -> None:
@@ -340,8 +353,9 @@ class LLMEngine:
 
         return get_serde(self.cfg.kv_serde)
 
-    def _process_token(self, seq: Sequence) -> None:
-        """Detokenize incrementally, check stop strings, emit the delta."""
+    def _process_token(self, seq: Sequence, new_tokens: list[int]) -> None:
+        """Detokenize incrementally, check stop strings, emit the delta (with
+        this step's new tokens — one or a whole decode burst)."""
         full = self.tokenizer.decode(seq.output_ids)
         prev = self._texts.get(seq.seq_id, "")
         delta = full[len(prev):] if full.startswith(prev) else full
@@ -349,14 +363,38 @@ class LLMEngine:
             idx = full.find(stop)
             if idx >= 0:
                 delta = full[len(prev): idx]
+                # drop burst tokens past the stop: keep the smallest token
+                # prefix whose decode contains the stop string — exactly the
+                # token at which a decode_steps=1 engine detects it — so
+                # token_ids / completion_tokens match single-step accounting
+                base = len(seq.output_ids) - len(new_tokens)
+                keep = len(new_tokens)
+                for m in range(1, len(new_tokens) + 1):
+                    if stop in self.tokenizer.decode(seq.output_ids[: base + m]):
+                        keep = m
+                        break
+                del seq.output_ids[base + keep:]
+                # the loop already counted the whole burst
+                self.total_generation_tokens -= len(new_tokens) - keep
+                new_tokens = new_tokens[:keep]
                 if not seq.finished:
                     self.scheduler._finish(seq, "stop")
+                elif seq.finish_reason == "length":
+                    # the length cap landed in the same step the stop text
+                    # appeared; the emitted text ends at the stop, so report it
+                    seq.finish_reason = "stop"
                 break
         with self._lock:
             self._texts[seq.seq_id] = prev + delta
-        self._emit(seq, delta)
+        self._emit(seq, delta, tokens=new_tokens)
 
-    def _emit(self, seq: Sequence, delta: str, error: bool = False) -> None:
+    def _emit(
+        self,
+        seq: Sequence,
+        delta: str,
+        tokens: Optional[list[int]] = None,
+        error: bool = False,
+    ) -> None:
         with self._lock:
             entry = self._outputs.get(seq.seq_id)
         if entry is None:
@@ -365,7 +403,11 @@ class LLMEngine:
         out = RequestOutput(
             seq_id=seq.seq_id,
             text_delta=delta,
-            token_ids=[seq.output_ids[-1]] if seq.output_ids else [],
+            token_ids=(
+                tokens
+                if tokens is not None
+                else [seq.output_ids[-1]] if seq.output_ids else []
+            ),
             finished=seq.finished,
             finish_reason=("error" if error else seq.finish_reason) if seq.finished else None,
             prompt_tokens=len(seq.prompt_ids),

@@ -39,6 +39,7 @@ class StepInput:
     top_k: Any          # [B] int32
     top_p: Any          # [B] float32
     lora_ids: Any = None  # [B] int32 adapter slot (0 = base); None when LoRA off
+    kv_limits: Any = None  # [B] int32 max kv_len (multi-step decode bound)
 
 
 class ModelRunner:
@@ -114,9 +115,11 @@ class ModelRunner:
         )
         self._set_page_fn = None  # built lazily in set_page
         self._encode = None       # built lazily in encode (pooled embeddings)
+        self._multi_steps: dict[int, Any] = {}  # k -> jitted k-step decode
 
-    def step(self, inp: StepInput) -> tuple[jnp.ndarray, jnp.ndarray]:
-        """Run one forward+sample step. Returns (token_ids [B], logits [B, V])."""
+    def _stage(self, inp: StepInput, with_limits: bool = False) -> dict:
+        """Host→device staging shared by step/step_multi: split the RNG and
+        device_put every input with the runner's shardings."""
         self._rng, key = jax.random.split(self._rng)
         row = lambda x, dt: jax.device_put(jnp.asarray(x, dt), self._row_sh)
         vec = lambda x, dt: jax.device_put(jnp.asarray(x, dt), self._vec_sh)
@@ -125,25 +128,88 @@ class ModelRunner:
             ids_arr = (
                 inp.lora_ids
                 if inp.lora_ids is not None
-                else jnp.zeros(jnp.asarray(inp.kv_lens).shape, jnp.int32)
+                else np.zeros(np.asarray(inp.kv_lens).shape, np.int32)
             )
             lora_ids = vec(ids_arr, jnp.int32)
+        staged = dict(
+            input_ids=row(inp.input_ids, jnp.int32),
+            positions=row(inp.positions, jnp.int32),
+            page_table=row(inp.page_table, jnp.int32),
+            kv_lens=vec(inp.kv_lens, jnp.int32),
+            temperature=vec(inp.temperature, jnp.float32),
+            top_k=vec(inp.top_k, jnp.int32),
+            top_p=vec(inp.top_p, jnp.float32),
+            key=key,
+            lora_ids=lora_ids,
+        )
+        if with_limits:
+            B = np.asarray(inp.kv_lens).shape[0]
+            limits = (
+                inp.kv_limits
+                if inp.kv_limits is not None
+                else np.full((B,), np.iinfo(np.int32).max // 2, np.int32)
+            )
+            staged["kv_limits"] = vec(limits, jnp.int32)
+        return staged
+
+    def step(self, inp: StepInput) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Run one forward+sample step. Returns (token_ids [B], logits [B, V])."""
+        s = self._stage(inp)
         ids, logits, self.k_pages, self.v_pages = self._step(
             self.params,
             self.k_pages,
             self.v_pages,
-            row(inp.input_ids, jnp.int32),
-            row(inp.positions, jnp.int32),
-            row(inp.page_table, jnp.int32),
-            vec(inp.kv_lens, jnp.int32),
-            vec(inp.temperature, jnp.float32),
-            vec(inp.top_k, jnp.int32),
-            vec(inp.top_p, jnp.float32),
-            key,
+            s["input_ids"],
+            s["positions"],
+            s["page_table"],
+            s["kv_lens"],
+            s["temperature"],
+            s["top_k"],
+            s["top_p"],
+            s["key"],
             self.lora,
-            lora_ids,
+            s["lora_ids"],
         )
         return ids, logits
+
+    def step_multi(self, inp: StepInput, k: int) -> jnp.ndarray:
+        """Run k fused decode steps in ONE device program (lax.scan feeding
+        each sampled token back as the next input). Returns tokens [B, k].
+
+        Why: on serving hosts every dispatch pays host<->device latency (and
+        per-call device_puts); at decode, compute per step is a few ms, so the
+        round trip dominates. Fusing k steps amortizes it k-fold — the
+        TPU-native answer to the reference's multi-step scheduling knob.
+        Sequences that run out of budget mid-burst (EOS handling is host-side)
+        are masked via ``kv_limits``: their positions go to -1, so KV writes
+        drop and attention masks, and the host discards their surplus tokens.
+        """
+        if k == 1:
+            ids, _ = self.step(inp)
+            return jnp.asarray(ids)[:, None]
+        if k not in self._multi_steps:
+            self._multi_steps[k] = jax.jit(
+                functools.partial(_multi_step_fn, self.module.forward, self.cfg, k),
+                donate_argnums=(1, 2),
+            )
+        s = self._stage(inp, with_limits=True)
+        toks, self.k_pages, self.v_pages = self._multi_steps[k](
+            self.params,
+            self.k_pages,
+            self.v_pages,
+            s["input_ids"],
+            s["positions"],
+            s["page_table"],
+            s["kv_lens"],
+            s["kv_limits"],
+            s["temperature"],
+            s["top_k"],
+            s["top_p"],
+            s["key"],
+            self.lora,
+            s["lora_ids"],
+        )
+        return toks
 
     def encode(self, input_ids, positions) -> jnp.ndarray:
         """Pooled-embedding forward ([B, T] -> [B, H] unit vectors). Shapes
@@ -220,6 +286,58 @@ class ModelRunner:
         kv_sh = NamedSharding(self.mesh, shardings.KV_PAGES_SPEC)
         self.k_pages = jax.device_put(kp, kv_sh)
         self.v_pages = jax.device_put(vp, kv_sh)
+
+
+def _multi_step_fn(forward, cfg, k, params, k_pages, v_pages, input_ids,
+                   positions, page_table, kv_lens, kv_limits, temperature,
+                   top_k, top_p, key, lora=None, lora_ids=None):
+    """k fused decode steps; see ModelRunner.step_multi. input_ids/positions
+    are [B, 1] (decode shape).
+
+    The scan carries only the batch's gathered KV block, NOT the whole pool:
+    XLA double-buffers while-loop carries, so carrying a multi-GB pool through
+    the scan 2-3x's KV memory and OOMs real chips. The block is a local pool
+    of B*P pages indexed by an identity page table, so ``forward`` is reused
+    unchanged; pages the burst wrote are scattered back afterwards."""
+    B, P = page_table.shape
+    pool_pages = k_pages.shape[1]
+    page_size = k_pages.shape[2]
+    flat = page_table.reshape(-1)
+    k_blk = jnp.take(k_pages, flat, axis=1)  # [L, B*P, page, KH, D]
+    v_blk = jnp.take(v_pages, flat, axis=1)
+    local_pt = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P)
+    kw = {} if lora is None else {"lora": lora, "lora_ids": lora_ids}
+    keys = jax.random.split(key, k)
+
+    def body(carry, key_i):
+        ids, pos, lens, kp, vp = carry
+        logits, kp, vp = forward(
+            params, cfg, ids, pos, kp, vp, local_pt, lens, **kw
+        )
+        nxt = sample(logits, key_i, temperature, top_k, top_p)  # [B]
+        # a row continues while it was active this step and has budget left
+        active = (pos[:, 0] >= 0) & (lens < kv_limits)
+        pos = jnp.where(active, pos[:, 0] + 1, -1)[:, None]
+        lens = lens + active.astype(lens.dtype)
+        ids = jnp.where(active, nxt, 0)[:, None]
+        return (ids, pos, lens, kp, vp), nxt
+
+    (_, _, lens_f, k_blk, v_blk), toks = jax.lax.scan(
+        body, (input_ids, positions, kv_lens, k_blk, v_blk), keys
+    )
+    # scatter back only the logical pages the burst wrote
+    # ([(lens0-1)//page, (lens_f-1)//page] per row): those are uniquely owned
+    # by each row, so no duplicate indices; everything else in the block is an
+    # unmodified copy (incl. shared prefix pages and padding), dropped via an
+    # out-of-range index.
+    p_idx = jnp.arange(P, dtype=jnp.int32)[None, :]
+    first = (kv_lens - 1) // page_size
+    last = (lens_f - 1) // page_size
+    written = (p_idx >= first[:, None]) & (p_idx <= last[:, None])
+    safe = jnp.where(written, page_table, pool_pages).reshape(-1)
+    k_pages = k_pages.at[:, safe].set(k_blk, mode="drop")
+    v_pages = v_pages.at[:, safe].set(v_blk, mode="drop")
+    return toks.T, k_pages, v_pages  # [B, k]
 
 
 def _step_fn(forward, cfg, params, k_pages, v_pages, input_ids, positions,

@@ -78,6 +78,7 @@ class ScheduledBatch:
     top_k: np.ndarray
     top_p: np.ndarray
     lora_ids: np.ndarray = None    # [B] int32 adapter slot per row
+    kv_limits: np.ndarray = None   # [B] int32 KV capacity bound (multi-step)
     # how many tokens of each seq this step computes (prefill chunking)
     chunk_sizes: list[int] = field(default_factory=list)
 
@@ -103,6 +104,7 @@ class Scheduler:
         prefill_chunk: int = 512,
         prefill_batch: int = 4,
         enable_prefix_caching: bool = True,
+        decode_steps: int = 1,
     ):
         self.kv = kv
         self.max_num_seqs = max_num_seqs
@@ -110,6 +112,9 @@ class Scheduler:
         self.prefill_chunk = prefill_chunk
         self.prefill_batch = prefill_batch
         self.enable_prefix_caching = enable_prefix_caching
+        # decode burst length: tokens produced per device program (fused
+        # multi-step decode, runner.step_multi); 1 = classic per-token steps
+        self.decode_steps = max(1, decode_steps)
         self.waiting: list[Sequence] = []
         self.running: list[Sequence] = []
 
@@ -166,9 +171,19 @@ class Scheduler:
             self.waiting.pop(0)
             self.running.append(seq)
 
+    def _burst_budget(self, seq: Sequence) -> int:
+        """Tokens this sequence can still usefully produce in one decode burst:
+        the configured burst length, capped by its remaining max_tokens budget
+        (so near-finished requests don't reserve KV for tokens that would be
+        discarded)."""
+        return max(1, min(self.decode_steps, seq.params.max_tokens - len(seq.output_ids)))
+
     def _ensure_decode_page(self, seq: Sequence) -> bool:
-        """Make sure the next token has a slot; grow the page list if needed."""
-        need = self._pages_needed(seq.num_tokens + 1) - len(seq.pages)
+        """Make sure the next decode burst has KV slots; grow the page list if
+        needed (one burst of lookahead)."""
+        need = self._pages_needed(
+            min(seq.num_tokens + self._burst_budget(seq), self.max_model_len + 1)
+        ) - len(seq.pages)
         if need <= 0:
             return True
         extra = self.kv.allocate(need)
@@ -261,7 +276,10 @@ class Scheduler:
             return None
         B = _bucket(len(ready), self.DECODE_BATCH_BUCKETS)
         max_pages = _bucket(
-            max(self._pages_needed(s.num_tokens + 1) for s in ready), self.PAGE_BUCKETS
+            max(self._pages_needed(
+                min(s.num_tokens + self._burst_budget(s), self.max_model_len + 1)
+            ) for s in ready),
+            self.PAGE_BUCKETS,
         )
         input_ids = np.zeros((B, 1), np.int32)
         positions = np.full((B, 1), -1, np.int32)
@@ -271,6 +289,7 @@ class Scheduler:
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
         lora_ids = np.zeros((B,), np.int32)
+        kv_limits = np.zeros((B,), np.int32)
         for i, s in enumerate(ready):
             last = (s.prompt_ids + s.output_ids)[-1]
             input_ids[i, 0] = last
@@ -282,9 +301,19 @@ class Scheduler:
             top_k[i] = s.params.top_k
             top_p[i] = s.params.top_p
             lora_ids[i] = s.lora_slot
+            # device-side burst bound: never write KV past the pages this seq
+            # owns, past the model context, or past its max_tokens budget
+            # (host discards surplus tokens). With initial lens L0 = num_tokens
+            # the burst produces (kv_limits - L0 + 1) real tokens, so a budget
+            # of b tokens means kv_limits = num_tokens + b - 1.
+            kv_limits[i] = min(
+                len(s.pages) * self.kv.page_size,
+                self.max_model_len,
+                s.num_tokens + self._burst_budget(s) - 1,
+            )
         return ScheduledBatch(
             "decode", ready, input_ids, positions, page_table, kv_lens,
-            temperature, top_k, top_p, lora_ids=lora_ids,
+            temperature, top_k, top_p, lora_ids=lora_ids, kv_limits=kv_limits,
         )
 
     def _preempt(self, seq: Sequence) -> None:
@@ -300,19 +329,18 @@ class Scheduler:
     # -- result application -------------------------------------------------
 
     def apply_step(self, batch: ScheduledBatch, token_ids: np.ndarray, eos_token_id: int):
-        """Apply sampled tokens; returns list of (seq, new_token or None)."""
+        """Apply sampled tokens; returns list of (seq, new_token).
+
+        ``token_ids`` is [B] (prefill / single-step decode) or [B, k] (fused
+        multi-step decode); surplus burst tokens after a sequence finishes
+        (EOS, max_tokens, context limit) are discarded.
+        """
+        tokens = np.asarray(token_ids)
+        if tokens.ndim == 1:
+            tokens = tokens[:, None]
         events = []
-        for i, s in enumerate(batch.seqs):
-            if s.finished:
-                continue
-            if batch.kind == "prefill":
-                c = batch.chunk_sizes[i]
-                s.num_computed += c
-                if s.in_prefill:
-                    continue  # more prompt chunks to go
-                if s.first_token_time is None:
-                    s.first_token_time = time.monotonic()
-            tok = int(token_ids[i])
+
+        def consume(s, tok) -> None:
             s.output_ids.append(tok)
             events.append((s, tok))
             if (not s.params.ignore_eos) and tok == eos_token_id:
@@ -321,4 +349,22 @@ class Scheduler:
                 self._finish(s, "length")
             elif s.num_tokens >= self.max_model_len:
                 self._finish(s, "length")
+
+        if batch.kind == "prefill":
+            for i, s in enumerate(batch.seqs):
+                if s.finished:
+                    continue
+                c = batch.chunk_sizes[i]
+                s.num_computed += c
+                if s.in_prefill:
+                    continue  # more prompt chunks to go
+                if s.first_token_time is None:
+                    s.first_token_time = time.monotonic()
+                consume(s, int(tokens[i, 0]))
+            return events
+
+        for j in range(tokens.shape[1]):
+            for i, s in enumerate(batch.seqs):
+                if not s.finished:
+                    consume(s, int(tokens[i, j]))
         return events

@@ -1,0 +1,185 @@
+"""Fused multi-step decode (runner.step_multi + scheduler burst handling).
+
+One device program produces k tokens per dispatch, amortizing host<->device
+round trips — the TPU-native counterpart of multi-step scheduling. Greedy
+outputs must be bit-identical to per-token stepping, and finish conditions
+(EOS, max_tokens, context limit) must hold exactly despite surplus burst
+tokens being computed device-side.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.runner import ModelRunner, StepInput
+from production_stack_tpu.engine.scheduler import SamplingParams
+from production_stack_tpu.models import llama
+
+CFG = llama.PRESETS["llama-debug"]
+
+
+def _decode_input(rng, B, ctx, page_size, ctx_pages, **kw):
+    return StepInput(
+        input_ids=rng.randint(0, CFG.vocab_size, (B, 1)).astype(np.int32),
+        positions=np.full((B, 1), ctx, np.int32),
+        page_table=np.arange(B * ctx_pages, dtype=np.int32).reshape(B, ctx_pages),
+        kv_lens=np.full((B,), ctx + 1, np.int32),
+        temperature=np.zeros(B, np.float32),  # greedy
+        top_k=np.zeros(B, np.int32),
+        top_p=np.ones(B, np.float32),
+        **kw,
+    )
+
+
+def test_step_multi_matches_sequential_greedy():
+    """k fused greedy steps == k sequential greedy steps, token for token."""
+    B, page_size, ctx_pages, k = 2, 8, 4, 4
+    ctx = 16
+    rng = np.random.RandomState(0)
+    first = rng.randint(0, CFG.vocab_size, (B, 1)).astype(np.int32)
+
+    r1 = ModelRunner(CFG, num_pages=B * ctx_pages, page_size=page_size, seed=0)
+    seq_tokens = []
+    inp = _decode_input(np.random.RandomState(0), B, ctx, page_size, ctx_pages)
+    inp.input_ids = first.copy()
+    for step in range(k):
+        ids, _ = r1.step(inp)
+        ids = np.asarray(ids)
+        seq_tokens.append(ids.copy())
+        inp.input_ids = ids[:, None].astype(np.int32)
+        inp.positions = inp.positions + 1
+        inp.kv_lens = inp.kv_lens + 1
+    seq_tokens = np.stack(seq_tokens, axis=1)  # [B, k]
+
+    r2 = ModelRunner(CFG, num_pages=B * ctx_pages, page_size=page_size, seed=0)
+    inp2 = _decode_input(np.random.RandomState(0), B, ctx, page_size, ctx_pages)
+    inp2.input_ids = first.copy()
+    burst = np.asarray(r2.step_multi(inp2, k))  # [B, k]
+
+    np.testing.assert_array_equal(seq_tokens, burst)
+
+
+def test_step_multi_respects_kv_limits():
+    """kv_limits masks rows device-side: a limited row's real tokens match the
+    unlimited run token-for-token, and other rows are unaffected by the
+    neighbor's masking."""
+    B, page_size, ctx_pages, k = 2, 8, 4, 6
+    ctx = 16
+    lim0 = 2  # row 0 allowed 2 real tokens: kv_limits = kv_lens + lim0 - 1
+
+    r_ref = ModelRunner(CFG, num_pages=B * ctx_pages, page_size=page_size, seed=0)
+    ref = np.asarray(
+        r_ref.step_multi(_decode_input(np.random.RandomState(1), B, ctx,
+                                       page_size, ctx_pages), k)
+    )
+
+    r_lim = ModelRunner(CFG, num_pages=B * ctx_pages, page_size=page_size, seed=0)
+    inp = _decode_input(np.random.RandomState(1), B, ctx, page_size, ctx_pages,
+                        kv_limits=np.array([ctx + 1 + lim0 - 1, ctx + k + 1],
+                                           np.int32))
+    toks = np.asarray(r_lim.step_multi(inp, k))
+
+    assert toks.shape == (B, k)
+    # row 0's real (pre-limit) tokens are identical to the unlimited run;
+    # tokens after the limit are computed from a masked state and discarded
+    # host-side, so their values are unspecified
+    np.testing.assert_array_equal(toks[0, :lim0], ref[0, :lim0])
+    # row 1 has budget for the full burst and must be unaffected
+    np.testing.assert_array_equal(toks[1], ref[1])
+
+
+def _cfg(**kw):
+    base = dict(
+        model="llama-debug", max_model_len=96, max_num_seqs=8,
+        num_pages=64, page_size=8, prefill_chunk=32,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _gen_text_and_count(engine, prompt, **params):
+    async def run():
+        text, n, reason = "", 0, None
+        async for out in engine.generate(
+            f"t-{np.random.randint(1 << 30)}", prompt=prompt,
+            params=SamplingParams(**params),
+        ):
+            text += out.text_delta
+            n += len(out.token_ids)
+            if out.finished:
+                reason = out.finish_reason
+        return text, n, reason
+
+    return asyncio.run(run())
+
+
+def test_engine_multistep_matches_single_step_greedy():
+    e1 = LLMEngine(_cfg(decode_steps=1))
+    e4 = LLMEngine(_cfg(decode_steps=4))
+    e1.start(), e4.start()
+    try:
+        t1, n1, _ = _gen_text_and_count(
+            e1, "hello burst", max_tokens=11, temperature=0.0, ignore_eos=True)
+        t4, n4, _ = _gen_text_and_count(
+            e4, "hello burst", max_tokens=11, temperature=0.0, ignore_eos=True)
+        assert n1 == n4 == 11   # max_tokens exact despite k=4 bursts
+        assert t1 == t4         # greedy text identical
+    finally:
+        e1.stop(), e4.stop()
+
+
+def test_engine_multistep_stop_string_trims_tokens():
+    """A stop string hit mid-burst trims the emitted token_ids and the
+    completion-token count to the truncated text, matching decode_steps=1."""
+    e1 = LLMEngine(_cfg(decode_steps=1))
+    e4 = LLMEngine(_cfg(decode_steps=4))
+    e1.start(), e4.start()
+    try:
+        full, n_full, _ = _gen_text_and_count(
+            e1, "stop here", max_tokens=16, temperature=0.0, ignore_eos=True)
+        assert len(full) > 6
+        stop = full[len(full) // 2:len(full) // 2 + 3]  # lands mid-generation
+        t1, n1, r1 = _gen_text_and_count(
+            e1, "stop here", max_tokens=16, temperature=0.0, ignore_eos=True,
+            stop=[stop])
+        t4, n4, r4 = _gen_text_and_count(
+            e4, "stop here", max_tokens=16, temperature=0.0, ignore_eos=True,
+            stop=[stop])
+        assert r1 == r4 == "stop"
+        assert t1 == t4          # identical truncated text
+        assert n1 == n4          # burst surplus tokens are discarded
+        assert n4 < n_full       # and fewer than the un-stopped run
+    finally:
+        e1.stop(), e4.stop()
+
+
+def test_engine_multistep_context_limit_exact():
+    """num_tokens never exceeds max_model_len even when the burst overshoots."""
+    eng = LLMEngine(_cfg(decode_steps=4, max_model_len=48))
+    eng.start()
+    try:
+        _, n, reason = _gen_text_and_count(
+            eng, "word " * 6, max_tokens=500, temperature=0.0, ignore_eos=True)
+        assert reason == "length"
+        # generated tokens stop exactly at the context cap
+        assert n <= 48
+    finally:
+        eng.stop()
+
+
+def test_engine_multistep_eos_respected():
+    """Tokens after EOS inside a burst are discarded."""
+    eng = LLMEngine(_cfg(decode_steps=4))
+    eng.start()
+    try:
+        eos = eng.tokenizer.eos_token_id
+        # greedy from a fixed prompt; run until EOS or max
+        _, n, reason = _gen_text_and_count(
+            eng, "q", max_tokens=64, temperature=0.0, ignore_eos=False)
+        assert reason in ("stop", "length")
+        assert n <= 64
+    finally:
+        eng.stop()
