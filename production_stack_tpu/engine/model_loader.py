@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from production_stack_tpu import models
-from production_stack_tpu.models import llama, opt
+from production_stack_tpu.models import gemma2, llama, opt
 
 
 def is_hf_dir(path: str) -> bool:
@@ -86,6 +86,8 @@ def load_from_hf(path: str):
     mod = models.module_for_arch(arch)
     if mod is opt:
         cfg, params = _load_opt(hf_cfg, path)
+    elif mod is gemma2:
+        cfg, params = _load_gemma2(hf_cfg, path)
     else:
         cfg, params = _load_llama_family(hf_cfg, path)
     return mod, cfg, params
@@ -188,6 +190,32 @@ def _load_opt(hf_cfg: dict, path: str) -> tuple[opt.OPTConfig, dict]:
         },
         "final_norm_w": jnp.asarray(get("decoder.final_layer_norm.weight"), dt),
         "final_norm_b": jnp.asarray(get("decoder.final_layer_norm.bias"), dt),
+    }
+    return cfg, params
+
+
+def _load_gemma2(hf_cfg: dict, path: str) -> tuple["gemma2.Gemma2Config", dict]:
+    cfg = gemma2.Gemma2Config.from_hf_config(hf_cfg)
+    t = _safetensor_shards(path)
+    dt = cfg.dtype
+    get, stack = _weight_helpers(t, cfg.num_layers, dt)
+    lf = "model.layers.{}."
+    params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dt),
+        "layers": {
+            "attn_norm": stack(lf + "input_layernorm.weight", transpose=False),
+            "post_attn_norm": stack(lf + "post_attention_layernorm.weight", transpose=False),
+            "mlp_norm": stack(lf + "pre_feedforward_layernorm.weight", transpose=False),
+            "post_mlp_norm": stack(lf + "post_feedforward_layernorm.weight", transpose=False),
+            "wq": stack(lf + "self_attn.q_proj.weight"),
+            "wk": stack(lf + "self_attn.k_proj.weight"),
+            "wv": stack(lf + "self_attn.v_proj.weight"),
+            "wo": stack(lf + "self_attn.o_proj.weight"),
+            "w_gate": stack(lf + "mlp.gate_proj.weight"),
+            "w_up": stack(lf + "mlp.up_proj.weight"),
+            "w_down": stack(lf + "mlp.down_proj.weight"),
+        },
+        "final_norm": jnp.asarray(get("model.norm.weight"), dt),
     }
     return cfg, params
 

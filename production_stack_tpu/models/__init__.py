@@ -16,10 +16,10 @@ need to reuse the leaf-name vocabulary or extend the spec tables.
 
 from __future__ import annotations
 
-from production_stack_tpu.models import llama, opt
+from production_stack_tpu.models import gemma2, llama, opt
 
 #: module search order for preset names and HF architectures
-MODULES = (llama, opt)
+MODULES = (llama, opt, gemma2)
 
 _ARCH_TO_MODULE = {
     "LlamaForCausalLM": llama,
@@ -27,6 +27,7 @@ _ARCH_TO_MODULE = {
     "Qwen2ForCausalLM": llama,
     "MixtralForCausalLM": llama,
     "OPTForCausalLM": opt,
+    "Gemma2ForCausalLM": gemma2,
 }
 
 
@@ -46,6 +47,8 @@ def module_for_config(cfg):
         return llama
     if isinstance(cfg, opt.OPTConfig):
         return opt
+    if isinstance(cfg, gemma2.Gemma2Config):
+        return gemma2
     raise ValueError(f"unknown model config type {type(cfg).__name__}")
 
 

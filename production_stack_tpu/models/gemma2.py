@@ -1,0 +1,228 @@
+"""Gemma-2 family (gemma-2-2b/9b/27b) as pure functional JAX.
+
+Same TPU-first structure as models/llama.py (layer-stacked weights under one
+``lax.scan``, paged KV pools, -1-position padding), with the Gemma-2
+architectural differences:
+
+- interleaved attention: even layers use a sliding window, odd layers are
+  global. The per-layer window rides the decoder scan as an ``xs`` array, so
+  one traced layer still serves both kinds (global layers get a window wider
+  than any context — the comparison folds into the existing mask math).
+- logit softcapping: ``cap * tanh(x / cap)`` on attention scores (50.0) and
+  final logits (30.0).
+- GeGLU MLP (tanh-approximate GELU on the gate path).
+- sandwich norms: RMSNorm before *and after* each attention/MLP block, with
+  Gemma's zero-centered ``(1 + w)`` weight parameterization.
+- embeddings scaled by sqrt(hidden); attention scaled by
+  ``query_pre_attn_scalar**-0.5`` instead of ``head_dim**-0.5``.
+
+Reference parity: the reference stack serves any vLLM-supported model through
+its engine contract (SURVEY.md §1 L4); Gemma-2 is a headline open-weights
+family a reference user would expect to deploy unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from production_stack_tpu.ops.attention import flash_attention, gather_kv_pages, write_kv_pages
+
+
+@dataclass(frozen=True)
+class Gemma2Config:
+    vocab_size: int = 256000
+    hidden_size: int = 3584
+    intermediate_size: int = 14336
+    num_layers: int = 42
+    num_heads: int = 16
+    num_kv_heads: int = 8
+    head_dim: int = 256
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    max_model_len: int = 8192
+    query_pre_attn_scalar: float = 256.0
+    attn_logit_softcap: Optional[float] = 50.0
+    final_logit_softcap: Optional[float] = 30.0
+    sliding_window: int = 4096        # even layers; odd layers are global
+    dtype: Any = jnp.bfloat16
+    # accepted for interface parity with LlamaConfig but not consulted:
+    # the pallas decode kernel supports neither softcapping nor windows, so
+    # this family always takes the XLA gather+flash path.
+    attn_impl: str = "auto"
+
+    @property
+    def tie_word_embeddings(self) -> bool:
+        return True  # Gemma always ties the LM head to the embedding
+
+    @staticmethod
+    def from_hf_config(cfg: dict) -> "Gemma2Config":
+        hidden = cfg["hidden_size"]
+        heads = cfg["num_attention_heads"]
+        return Gemma2Config(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=hidden,
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=cfg.get("num_key_value_heads", heads),
+            head_dim=cfg.get("head_dim") or hidden // heads,
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-6),
+            max_model_len=cfg.get("max_position_embeddings", 8192),
+            query_pre_attn_scalar=cfg.get("query_pre_attn_scalar", 256.0),
+            attn_logit_softcap=cfg.get("attn_logit_softcapping", 50.0),
+            final_logit_softcap=cfg.get("final_logit_softcapping", 30.0),
+            sliding_window=cfg.get("sliding_window", 4096),
+        )
+
+
+PRESETS: dict[str, Gemma2Config] = {
+    "gemma-2-9b": Gemma2Config(),
+    "gemma-2-2b": Gemma2Config(
+        hidden_size=2304,
+        intermediate_size=9216,
+        num_layers=26,
+        num_heads=8,
+        num_kv_heads=4,
+        query_pre_attn_scalar=256.0,
+    ),
+    "gemma2-debug": Gemma2Config(
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,            # layer 0 sliding, layer 1 global
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,
+        query_pre_attn_scalar=32.0,
+        sliding_window=8,
+        max_model_len=256,
+    ),
+}
+
+
+def init_params(cfg: Gemma2Config, key: jax.Array) -> dict:
+    """Random-normal parameter tree (layer-stacked). Norm weights start at
+    zero — Gemma's RMSNorm multiplies by (1 + w)."""
+    k_embed, k_layers = jax.random.split(key)
+    L, H, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    NH, KH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    scale = H**-0.5
+    layers = {
+        "attn_norm": jnp.zeros((L, H), cfg.dtype),
+        "post_attn_norm": jnp.zeros((L, H), cfg.dtype),
+        "mlp_norm": jnp.zeros((L, H), cfg.dtype),
+        "post_mlp_norm": jnp.zeros((L, H), cfg.dtype),
+        "wq": normal(ks[0], (L, H, NH * D), scale),
+        "wk": normal(ks[1], (L, H, KH * D), scale),
+        "wv": normal(ks[2], (L, H, KH * D), scale),
+        "wo": normal(ks[3], (L, NH * D, H), (NH * D) ** -0.5),
+        "w_gate": normal(ks[4], (L, H, I), scale),
+        "w_up": normal(ks[5], (L, H, I), scale),
+        "w_down": normal(ks[6], (L, I, H), I**-0.5),
+    }
+    return {
+        "embed": normal(k_embed, (cfg.vocab_size, H), scale),
+        "layers": layers,
+        "final_norm": jnp.zeros((H,), cfg.dtype),
+    }
+
+
+def init_kv_pages(
+    cfg: Gemma2Config, num_pages: int, page_size: int, dtype=None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Layer-stacked page pools: [L, num_pages, page_size, KH, D]."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _rms_norm_1p(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    """Gemma RMSNorm: zero-centered weight, stats and (1 + w) in fp32."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps) * (1.0 + w.astype(jnp.float32))).astype(dtype)
+
+
+def _layer_windows(cfg: Gemma2Config) -> jnp.ndarray:
+    """Per-layer window sizes for the decoder scan: even layers slide, odd
+    layers see everything (a window wider than any position is a no-op)."""
+    full = cfg.max_model_len + 1
+    return jnp.asarray(
+        [cfg.sliding_window if i % 2 == 0 else full for i in range(cfg.num_layers)],
+        jnp.int32,
+    )
+
+
+def forward(
+    params: dict,
+    cfg: Gemma2Config,
+    input_ids: jnp.ndarray,
+    positions: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    kv_lens: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One forward step (prefill chunk or decode) with paged KV.
+
+    Same contract as models/llama.py:forward; returns (logits[B, V] for each
+    sequence's last valid token, updated k_pages, v_pages).
+    """
+    from production_stack_tpu.ops.rope import apply_rope, rope_cos_sin
+
+    B, T = input_ids.shape
+    x = params["embed"][input_ids].astype(cfg.dtype)
+    x = x * jnp.asarray(cfg.hidden_size**0.5, cfg.dtype)  # Gemma embed scaling
+    cos, sin = rope_cos_sin(jnp.maximum(positions, 0), cfg.head_dim, cfg.rope_theta)
+    sm_scale = cfg.query_pre_attn_scalar**-0.5
+    eps = cfg.rms_norm_eps
+
+    def layer(x, layer_in):
+        lp, kp, vp, window = layer_in
+
+        h = _rms_norm_1p(x, lp["attn_norm"], eps)
+        q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        kp, vp = write_kv_pages(
+            kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions
+        )
+        kc, vc = gather_kv_pages(kp, vp, page_table)
+        attn = flash_attention(
+            q, kc, vc, q_positions=positions, kv_lens=kv_lens,
+            sm_scale=sm_scale, window=window,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+        attn = (attn.reshape(B, T, -1)) @ lp["wo"]
+        x = x + _rms_norm_1p(attn, lp["post_attn_norm"], eps)
+
+        h = _rms_norm_1p(x, lp["mlp_norm"], eps)
+        mlp = (jax.nn.gelu(h @ lp["w_gate"], approximate=True) * (h @ lp["w_up"])) @ lp["w_down"]
+        x = x + _rms_norm_1p(mlp, lp["post_mlp_norm"], eps)
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = lax.scan(
+        layer, x, (params["layers"], k_pages, v_pages, _layer_windows(cfg))
+    )
+
+    x = _rms_norm_1p(x, params["final_norm"], eps)
+    last_idx = jnp.maximum(jnp.sum(positions >= 0, axis=1) - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+    logits = (x_last @ params["embed"].T).astype(jnp.float32)
+    cap = cfg.final_logit_softcap
+    if cap is not None:  # HF checkpoints may null the cap to disable it
+        logits = cap * jnp.tanh(logits / cap)
+    return logits, k_pages, v_pages

@@ -96,6 +96,7 @@ def flash_attention(
     sm_scale: float | None = None,
     block_size: int = 512,
     window: int | None = None,
+    logit_softcap: float | None = None,
 ) -> jnp.ndarray:
     """Online-softmax attention scanned over KV blocks (GQA-aware).
 
@@ -137,6 +138,9 @@ def flash_attention(
         kblk, vblk = inputs
         kf = kblk.astype(jnp.float32)
         scores = jnp.einsum("btkgd,bskd->btkgs", qf, kf)  # [B,T,KH,G,bs]
+        if logit_softcap is not None:
+            # Gemma-2: soft-bound scores to (-cap, cap) before masking
+            scores = logit_softcap * jnp.tanh(scores / logit_softcap)
         idx = start + jnp.arange(bs)
         visible = (idx[None, None, :] <= q_positions[:, :, None]) & (
             idx[None, None, :] < kv_lens[:, None, None]

@@ -43,6 +43,8 @@ _LAYER_SPECS = {
     "mlp_norm": P(None),
     "mlp_norm_w": P(None),
     "mlp_norm_b": P(None),
+    "post_attn_norm": P(None),         # Gemma-2 sandwich norms
+    "post_mlp_norm": P(None),
     "w_gate": P(None, "tp"),
     "w_up": P(None, "tp"),
     "w_down": P("tp", None),
