@@ -29,8 +29,14 @@ class EngineConfig:
     prefill_batch: int = 4
     # fused decode burst: tokens produced per device program dispatch. >1
     # amortizes host<->device round trips (runner.step_multi); surplus tokens
-    # after EOS are discarded host-side.
+    # after EOS are discarded host-side. With speculative decoding on, this is
+    # the number of fused draft+verify rounds per dispatch instead.
     decode_steps: int = 8
+    # speculative decoding (prompt-lookup/n-gram, fused on device): draft
+    # length per round; 0 disables. The TPU-native analogue of vLLM's ngram
+    # speculator — decode becomes parallel verify instead of serial steps.
+    speculative_k: int = 0
+    speculative_ngram: int = 3
     enable_prefix_caching: bool = True
     enable_chunked_prefill: bool = True
     tensor_parallel_size: int = 1

@@ -110,6 +110,8 @@ class LLMEngine:
             prefill_batch=cfg.prefill_batch,
             enable_prefix_caching=cfg.enable_prefix_caching,
             decode_steps=cfg.decode_steps,
+            spec_k=cfg.speculative_k,
+            spec_ngram=cfg.speculative_ngram,
         )
         self._inbox: queue_mod.Queue = queue_mod.Queue()
         self._outputs: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
@@ -283,7 +285,14 @@ class LLMEngine:
                     batch.kv_lens, batch.temperature, batch.top_k, batch.top_p,
                     lora_ids=batch.lora_ids, kv_limits=batch.kv_limits,
                 )
-                if batch.kind == "decode" and self.scheduler.decode_steps > 1:
+                if batch.kind == "decode" and batch.history is not None:
+                    tokens = np.asarray(
+                        self.runner.step_spec(
+                            inp, batch.history, self.scheduler.decode_steps,
+                            self.scheduler.spec_k, self.scheduler.spec_ngram,
+                        )
+                    )  # [B, steps, 1+spec_k], -1 padded
+                elif batch.kind == "decode" and self.scheduler.decode_steps > 1:
                     tokens = np.asarray(
                         self.runner.step_multi(inp, self.scheduler.decode_steps)
                     )  # [B, k]
@@ -357,6 +366,13 @@ class LLMEngine:
         """Detokenize incrementally, check stop strings, emit the delta (with
         this step's new tokens — one or a whole decode burst)."""
         full = self.tokenizer.decode(seq.output_ids)
+        if not seq.finished and full.endswith("�"):
+            # hold back a trailing incomplete byte sequence (renders as
+            # replacement chars) until later tokens complete it — emitting it
+            # now would desync the incremental stream, and the emit boundaries
+            # (per-token, burst, or speculative round) must not change the
+            # streamed text. Held-back chars flush on the finishing emit.
+            full = full.rstrip("�")
         prev = self._texts.get(seq.seq_id, "")
         delta = full[len(prev):] if full.startswith(prev) else full
         for stop in seq.params.stop:

@@ -116,6 +116,7 @@ class ModelRunner:
         self._set_page_fn = None  # built lazily in set_page
         self._encode = None       # built lazily in encode (pooled embeddings)
         self._multi_steps: dict[int, Any] = {}  # k -> jitted k-step decode
+        self._spec_fns: dict[tuple, Any] = {}   # (steps, k, n) -> jitted spec decode
 
     def _stage(self, inp: StepInput, with_limits: bool = False) -> dict:
         """Host→device staging shared by step/step_multi: split the RNG and
@@ -203,6 +204,59 @@ class ModelRunner:
             self.params,
             self.k_pages,
             self.v_pages,
+            s["input_ids"],
+            s["positions"],
+            s["page_table"],
+            s["kv_lens"],
+            s["kv_limits"],
+            s["temperature"],
+            s["top_k"],
+            s["top_p"],
+            s["key"],
+            self.lora,
+            s["lora_ids"],
+        )
+        return toks
+
+    def step_spec(
+        self, inp: StepInput, history: Any, steps: int, spec_k: int, ngram: int
+    ) -> jnp.ndarray:
+        """Fused speculative decode: ``steps`` rounds of (n-gram draft →
+        parallel verify → rejection-sample accept) in ONE device program.
+
+        The draft model is prompt-lookup (vLLM's ngram speculator, TPU-native):
+        the trailing ``ngram`` tokens are matched against the sequence's own
+        token history *on device*, and the ``spec_k`` tokens that followed the
+        most recent match become the draft. One forward over 1+spec_k
+        positions scores them all; a sampled target token per position gives
+        exact rejection-sampling acceptance (for a deterministic draft,
+        "sample t ~ p, accept iff t == draft" IS the spec-sampling rule, and
+        the first mismatching t is the correction token). Each round emits
+        1..spec_k+1 tokens for one forward pass — decode becomes MXU-bound
+        verify work instead of latency-bound single-token steps.
+
+        Args:
+          inp: decode-shaped StepInput ([B, 1] inputs; kv_limits REQUIRED —
+               a row stays active while ``lens + spec_k <= kv_limits``).
+          history: [B, H] int32 token ids (prompt + output so far), 0-padded.
+        Returns tokens [B, steps, 1+spec_k] int32, -1 where nothing emitted.
+        """
+        sig = (steps, spec_k, ngram)
+        if sig not in self._spec_fns:
+            self._spec_fns[sig] = jax.jit(
+                functools.partial(
+                    _spec_fn, self.module.forward, self.cfg, steps, spec_k, ngram
+                ),
+                donate_argnums=(1, 2),
+            )
+        s = self._stage(inp, with_limits=True)
+        hist = jax.device_put(jnp.asarray(history, jnp.int32), self._row_sh) \
+            if self.mesh.devices.size > 1 else np.asarray(history, np.int32)
+        toks, self.k_pages, self.v_pages = self._spec_fns[sig](
+            self.params,
+            self.k_pages,
+            self.v_pages,
+            hist,
             s["input_ids"],
             s["positions"],
             s["page_table"],
@@ -344,6 +398,110 @@ def _multi_step_fn(forward, cfg, k, params, k_pages, v_pages, input_ids,
     k_pages = k_pages.at[:, safe].set(k_blk, mode="drop")
     v_pages = v_pages.at[:, safe].set(v_blk, mode="drop")
     return toks.T, k_pages, v_pages  # [B, k]
+
+
+def _ngram_draft(buf, pos, n, k):
+    """Prompt-lookup draft, vectorized: find the most recent earlier occurrence
+    of the trailing n-gram ``buf[pos-n+1..pos]`` and return the k tokens that
+    followed it. Falls back to repeating the current token (which verify will
+    almost surely reject — costing nothing extra, since the verify forward has
+    static width anyway).
+
+    buf: [B, H] int32 token history; pos: [B] position of the current token.
+    Returns [B, k] int32 draft tokens.
+    """
+    B, H = buf.shape
+    S = H - n + 1
+    tail_idx = jnp.clip(pos[:, None] + jnp.arange(-n + 1, 1), 0, H - 1)
+    tail = jnp.take_along_axis(buf, tail_idx, axis=1)                    # [B, n]
+    win_idx = jnp.arange(S)[:, None] + jnp.arange(n)[None, :]            # [S, n]
+    wins = buf[:, win_idx]                                               # [B, S, n]
+    match = jnp.all(wins == tail[:, None, :], axis=-1)                   # [B, S]
+    starts = jnp.arange(S, dtype=jnp.int32)[None, :]
+    # the match and its k following tokens must lie fully in known history
+    # (this also excludes the trailing n-gram matching itself)
+    ok = match & (starts + n + k - 1 <= pos[:, None])
+    best = jnp.max(jnp.where(ok, starts, -1), axis=1)                    # [B]
+    d_idx = jnp.clip(best[:, None] + n + jnp.arange(k), 0, H - 1)
+    draft = jnp.take_along_axis(buf, d_idx, axis=1)                      # [B, k]
+    cur = jnp.take_along_axis(buf, jnp.clip(pos, 0, H - 1)[:, None], axis=1)
+    return jnp.where((best >= 0)[:, None], draft, cur)
+
+
+def _spec_fn(forward, cfg, steps, k, n, params, k_pages, v_pages, history,
+             input_ids, positions, page_table, kv_lens, kv_limits, temperature,
+             top_k, top_p, key, lora=None, lora_ids=None):
+    """``steps`` fused speculative rounds; see ModelRunner.step_spec.
+
+    Like _multi_step_fn, the scan carries the batch's gathered KV block (plus
+    the token-history buffer), not the whole pool. Rejected draft tokens leave
+    stale KV beyond the accepted length; it is invisible (attention masks by
+    kv_lens) and overwritten by the next round's writes.
+    """
+    B, P = page_table.shape
+    pool_pages = k_pages.shape[1]
+    page_size = k_pages.shape[2]
+    H = history.shape[1]
+    T = 1 + k
+    flat = page_table.reshape(-1)
+    k_blk = jnp.take(k_pages, flat, axis=1)
+    v_blk = jnp.take(v_pages, flat, axis=1)
+    local_pt = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P)
+    kw = {} if lora is None else {"lora": lora, "lora_ids": lora_ids}
+    keys = jax.random.split(key, steps)
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    j = jnp.arange(T, dtype=jnp.int32)[None, :]
+    rep = lambda x: jnp.repeat(x, T, axis=0)  # [B] -> [B*T] row params
+
+    def body(carry, key_i):
+        buf, pos, lens, kp, vp = carry   # pos [B]: current token's position, -1 = done
+        active = (pos >= 0) & (lens + k <= kv_limits)
+        p0 = jnp.maximum(pos, 0)
+        cur = jnp.take_along_axis(buf, p0[:, None], axis=1)              # [B, 1]
+        draft = _ngram_draft(buf, p0, n, k)                              # [B, k]
+        seq_in = jnp.concatenate([cur, draft], axis=1)                   # [B, T]
+        pos_in = jnp.where(active[:, None], p0[:, None] + j, -1)
+        lens_in = jnp.where(active, lens + k, 0)
+        logits, kp, vp = forward(
+            params, cfg, seq_in, pos_in, kp, vp, local_pt, lens_in,
+            all_logits=True, **kw
+        )                                                                # [B, T, V]
+        t = sample(
+            logits.reshape(B * T, -1), key_i,
+            rep(temperature), rep(top_k), rep(top_p),
+        ).reshape(B, T)
+        # exact rejection sampling for a deterministic draft: accept the
+        # leading run of draft tokens the target also sampled; the first
+        # mismatch IS the corrected token (and position k's sample is the
+        # bonus token when everything was accepted)
+        match = (t[:, :k] == draft).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)                  # [B] 0..k
+        bonus = jnp.take_along_axis(t, m[:, None], axis=1)[:, 0]         # [B]
+        upd = jnp.where(j == m[:, None], bonus[:, None],
+                        jnp.concatenate([draft, cur], axis=1))           # [B, T]
+        emit = active[:, None] & (j <= m[:, None])
+        slots = jnp.where(emit, p0[:, None] + 1 + j, H)
+        buf = buf.at[rows, slots].set(upd, mode="drop")
+        toks = jnp.where(emit, upd, -1)                                  # [B, T]
+        emitted = (m + 1) * active.astype(jnp.int32)
+        pos = jnp.where(active, pos + emitted, -1)
+        lens = lens + emitted
+        return (buf, pos, lens, kp, vp), toks
+
+    (_, _, lens_f, k_blk, v_blk), toks = jax.lax.scan(
+        body, (history, positions[:, 0], kv_lens, k_blk, v_blk), keys
+    )
+    # scatter back the pages holding accepted tokens (stale tail beyond the
+    # accepted length never needs to persist); same uniqueness argument as
+    # _multi_step_fn: the written logical range covers only freshly-owned pages
+    p_idx = jnp.arange(P, dtype=jnp.int32)[None, :]
+    first = (kv_lens - 1) // page_size
+    last = (lens_f - 1) // page_size  # padded rows: lens_f=0 -> last=-1 -> no write
+    written = (p_idx >= first[:, None]) & (p_idx <= last[:, None])
+    safe = jnp.where(written, page_table, pool_pages).reshape(-1)
+    k_pages = k_pages.at[:, safe].set(k_blk, mode="drop")
+    v_pages = v_pages.at[:, safe].set(v_blk, mode="drop")
+    return jnp.transpose(toks, (1, 0, 2)), k_pages, v_pages  # [B, steps, T]
 
 
 def _step_fn(forward, cfg, params, k_pages, v_pages, input_ids, positions,

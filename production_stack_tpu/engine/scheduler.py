@@ -79,6 +79,7 @@ class ScheduledBatch:
     top_p: np.ndarray
     lora_ids: np.ndarray = None    # [B] int32 adapter slot per row
     kv_limits: np.ndarray = None   # [B] int32 KV capacity bound (multi-step)
+    history: np.ndarray = None     # [B, H] token ids (speculative drafting)
     # how many tokens of each seq this step computes (prefill chunking)
     chunk_sizes: list[int] = field(default_factory=list)
 
@@ -94,6 +95,7 @@ class Scheduler:
     DECODE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
     CHUNK_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
     PAGE_BUCKETS = (2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+    HISTORY_BUCKETS = CHUNK_BUCKETS + (2048, 4096, 8192, 16384, 32768)
 
     def __init__(
         self,
@@ -105,6 +107,8 @@ class Scheduler:
         prefill_batch: int = 4,
         enable_prefix_caching: bool = True,
         decode_steps: int = 1,
+        spec_k: int = 0,
+        spec_ngram: int = 3,
     ):
         self.kv = kv
         self.max_num_seqs = max_num_seqs
@@ -113,8 +117,12 @@ class Scheduler:
         self.prefill_batch = prefill_batch
         self.enable_prefix_caching = enable_prefix_caching
         # decode burst length: tokens produced per device program (fused
-        # multi-step decode, runner.step_multi); 1 = classic per-token steps
+        # multi-step decode, runner.step_multi); 1 = classic per-token steps.
+        # With spec_k > 0 it is the number of fused draft+verify ROUNDS instead
+        # (runner.step_spec), each emitting 1..spec_k+1 tokens.
         self.decode_steps = max(1, decode_steps)
+        self.spec_k = max(0, spec_k)
+        self.spec_ngram = max(1, spec_ngram)
         self.waiting: list[Sequence] = []
         self.running: list[Sequence] = []
 
@@ -178,12 +186,27 @@ class Scheduler:
         discarded)."""
         return max(1, min(self.decode_steps, seq.params.max_tokens - len(seq.output_ids)))
 
+    def _spec_limit(self, seq: Sequence) -> int:
+        """Max KV length a fused speculative dispatch may reach for ``seq``:
+        decode_steps rounds of up to spec_k+1 tokens, capped by the remaining
+        max_tokens budget. Verify writes spec_k draft tokens past the current
+        length every round, so the cap carries a +spec_k allowance past
+        max_model_len for (discarded) overshoot writes."""
+        per = self.spec_k + 1
+        remaining = max(1, seq.params.max_tokens - len(seq.output_ids))
+        iters = max(1, min(self.decode_steps, -(-remaining // per)))
+        return min(seq.num_tokens + iters * per, self.max_model_len + self.spec_k)
+
+    def _decode_target_len(self, seq: Sequence) -> int:
+        """KV capacity (in tokens) a decode dispatch needs for ``seq``."""
+        if self.spec_k:
+            return self._spec_limit(seq)
+        return min(seq.num_tokens + self._burst_budget(seq), self.max_model_len + 1)
+
     def _ensure_decode_page(self, seq: Sequence) -> bool:
         """Make sure the next decode burst has KV slots; grow the page list if
         needed (one burst of lookahead)."""
-        need = self._pages_needed(
-            min(seq.num_tokens + self._burst_budget(seq), self.max_model_len + 1)
-        ) - len(seq.pages)
+        need = self._pages_needed(self._decode_target_len(seq)) - len(seq.pages)
         if need <= 0:
             return True
         extra = self.kv.allocate(need)
@@ -276,9 +299,7 @@ class Scheduler:
             return None
         B = _bucket(len(ready), self.DECODE_BATCH_BUCKETS)
         max_pages = _bucket(
-            max(self._pages_needed(
-                min(s.num_tokens + self._burst_budget(s), self.max_model_len + 1)
-            ) for s in ready),
+            max(self._pages_needed(self._decode_target_len(s)) for s in ready),
             self.PAGE_BUCKETS,
         )
         input_ids = np.zeros((B, 1), np.int32)
@@ -290,9 +311,20 @@ class Scheduler:
         top_p = np.ones((B,), np.float32)
         lora_ids = np.zeros((B,), np.int32)
         kv_limits = np.zeros((B,), np.int32)
+        history = None
+        if self.spec_k:
+            need_hist = max(self._spec_limit(s) for s in ready)
+            if need_hist <= self.HISTORY_BUCKETS[-1]:
+                # Rebuilt per dispatch: O(B * num_tokens) host memcpy, bounded
+                # by the largest bucket (~128 KB/row). Contexts past the top
+                # bucket fall back to plain burst decode for this dispatch —
+                # the buffer is position-indexed on device, so a truncated
+                # head would misplace the current token.
+                history = np.zeros((B, _bucket(need_hist, self.HISTORY_BUCKETS)),
+                                   np.int32)
         for i, s in enumerate(ready):
-            last = (s.prompt_ids + s.output_ids)[-1]
-            input_ids[i, 0] = last
+            all_ids = s.prompt_ids + s.output_ids
+            input_ids[i, 0] = all_ids[-1]
             positions[i, 0] = s.num_tokens - 1
             pages = s.pages[:max_pages]
             page_table[i, : len(pages)] = pages
@@ -301,19 +333,30 @@ class Scheduler:
             top_k[i] = s.params.top_k
             top_p[i] = s.params.top_p
             lora_ids[i] = s.lora_slot
-            # device-side burst bound: never write KV past the pages this seq
-            # owns, past the model context, or past its max_tokens budget
-            # (host discards surplus tokens). With initial lens L0 = num_tokens
-            # the burst produces (kv_limits - L0 + 1) real tokens, so a budget
-            # of b tokens means kv_limits = num_tokens + b - 1.
-            kv_limits[i] = min(
-                len(s.pages) * self.kv.page_size,
-                self.max_model_len,
-                s.num_tokens + self._burst_budget(s) - 1,
-            )
+            if history is not None:
+                # speculative: a row stays active while lens + spec_k fits
+                # under kv_limits (verify writes spec_k drafts past lens)
+                kv_limits[i] = min(
+                    len(s.pages) * self.kv.page_size, self._spec_limit(s)
+                )
+                hn = min(len(all_ids), history.shape[1])
+                history[i, :hn] = all_ids[:hn]
+            else:
+                # device-side burst bound: never write KV past the pages this
+                # seq owns, past the model context, or past its max_tokens
+                # budget (host discards surplus tokens). With initial lens
+                # L0 = num_tokens the burst produces (kv_limits - L0 + 1) real
+                # tokens, so a budget of b tokens means kv_limits =
+                # num_tokens + b - 1.
+                kv_limits[i] = min(
+                    len(s.pages) * self.kv.page_size,
+                    self.max_model_len,
+                    s.num_tokens + self._burst_budget(s) - 1,
+                )
         return ScheduledBatch(
             "decode", ready, input_ids, positions, page_table, kv_lens,
             temperature, top_k, top_p, lora_ids=lora_ids, kv_limits=kv_limits,
+            history=history,
         )
 
     def _preempt(self, seq: Sequence) -> None:
@@ -331,11 +374,14 @@ class Scheduler:
     def apply_step(self, batch: ScheduledBatch, token_ids: np.ndarray, eos_token_id: int):
         """Apply sampled tokens; returns list of (seq, new_token).
 
-        ``token_ids`` is [B] (prefill / single-step decode) or [B, k] (fused
-        multi-step decode); surplus burst tokens after a sequence finishes
-        (EOS, max_tokens, context limit) are discarded.
+        ``token_ids`` is [B] (prefill / single-step decode), [B, k] (fused
+        multi-step decode), or [B, steps, 1+spec_k] with -1 padding
+        (speculative decode); surplus tokens after a sequence finishes
+        (EOS, max_tokens, context limit) and -1 padding are discarded.
         """
         tokens = np.asarray(token_ids)
+        if tokens.ndim == 3:
+            tokens = tokens.reshape(tokens.shape[0], -1)
         if tokens.ndim == 1:
             tokens = tokens[:, None]
         events = []
@@ -365,6 +411,7 @@ class Scheduler:
 
         for j in range(tokens.shape[1]):
             for i, s in enumerate(batch.seqs):
-                if not s.finished:
-                    consume(s, int(tokens[i, j]))
+                tok = int(tokens[i, j])
+                if tok >= 0 and not s.finished:
+                    consume(s, tok)
         return events

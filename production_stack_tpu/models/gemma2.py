@@ -174,11 +174,13 @@ def forward(
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,
     kv_lens: jnp.ndarray,
+    all_logits: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
     Same contract as models/llama.py:forward; returns (logits[B, V] for each
-    sequence's last valid token, updated k_pages, v_pages).
+    sequence's last valid token — [B, T, V] when ``all_logits``, used by
+    speculative verify — and updated k_pages, v_pages).
     """
     from production_stack_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -219,9 +221,11 @@ def forward(
     )
 
     x = _rms_norm_1p(x, params["final_norm"], eps)
-    last_idx = jnp.maximum(jnp.sum(positions >= 0, axis=1) - 1, 0)
-    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
-    logits = (x_last @ params["embed"].T).astype(jnp.float32)
+    if not all_logits:
+        # select each sequence's last valid token before the vocab projection
+        last_idx = jnp.maximum(jnp.sum(positions >= 0, axis=1) - 1, 0)
+        x = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+    logits = (x @ params["embed"].T).astype(jnp.float32)
     cap = cfg.final_logit_softcap
     if cap is not None:  # HF checkpoints may null the cap to disable it
         logits = cap * jnp.tanh(logits / cap)

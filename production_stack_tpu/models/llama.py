@@ -372,6 +372,7 @@ def forward(
     kv_lens: jnp.ndarray,
     lora: Optional[dict] = None,
     lora_ids: Optional[jnp.ndarray] = None,
+    all_logits: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
@@ -383,9 +384,11 @@ def forward(
       kv_lens:    [B] total valid KV length *including* this step's tokens.
       lora:       optional ``init_lora_buffers`` tree for batched multi-LoRA.
       lora_ids:   [B] int32 adapter slot per sequence (0 = base model).
+      all_logits: static; True returns logits for *every* position (used by
+                  speculative verify, which scores k draft tokens at once).
 
-    Returns (logits[B, V] for each sequence's last valid token,
-             k_pages, v_pages updated).
+    Returns (logits[B, V] for each sequence's last valid token — or [B, T, V]
+             when ``all_logits`` — and k_pages, v_pages updated).
     """
     B, T = input_ids.shape
     x = params["embed"][input_ids].astype(cfg.dtype)  # [B, T, H]
@@ -436,10 +439,13 @@ def forward(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    if all_logits:
+        # speculative verify: T is small (1 + draft length), so [B, T, V] fits
+        return (x @ head).astype(jnp.float32), k_pages, v_pages
     # Select each sequence's last valid token before the vocab projection so the
     # logits tensor is [B, V], not [B, T, V] (a 2 GB save at V=128k, T=1k).
     last_idx = jnp.maximum(jnp.sum(positions >= 0, axis=1) - 1, 0)  # [B]
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, H]
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     logits = (x_last @ head).astype(jnp.float32)
     return logits, k_pages, v_pages

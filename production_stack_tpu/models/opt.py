@@ -138,6 +138,7 @@ def forward(
     v_pages: jnp.ndarray,
     page_table: jnp.ndarray,
     kv_lens: jnp.ndarray,
+    all_logits: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
@@ -178,6 +179,8 @@ def forward(
     x, (k_pages, v_pages) = lax.scan(layer, x, (params["layers"], k_pages, v_pages))
 
     x = layer_norm(x, params["final_norm_w"], params["final_norm_b"], cfg.layer_norm_eps)
+    if all_logits:  # speculative verify scores every position
+        return (x @ params["embed"].T).astype(jnp.float32), k_pages, v_pages
     last_idx = jnp.maximum(jnp.sum(positions >= 0, axis=1) - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
     logits = (x_last @ params["embed"].T).astype(jnp.float32)
