@@ -39,6 +39,9 @@ class EngineConfig:
     speculative_ngram: int = 3
     enable_prefix_caching: bool = True
     enable_chunked_prefill: bool = True
+    # decode attention implementation, threaded into the model config:
+    # auto | xla | pallas | pallas_interpret (ModelRunner resolves "auto")
+    attn_impl: str = "auto"
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     enable_sleep_mode: bool = False

@@ -48,6 +48,8 @@ class LLMEngine:
         model_mod, model_cfg, params = load_model(
             cfg.model, seed=cfg.seed, max_model_len=cfg.max_model_len
         )
+        if cfg.attn_impl != "auto":
+            model_cfg = dataclasses.replace(model_cfg, attn_impl=cfg.attn_impl)
         self.model_cfg = model_cfg
         self.tokenizer = load_tokenizer(
             cfg.tokenizer or (cfg.model if "/" in cfg.model or cfg.model.startswith(".") else None)

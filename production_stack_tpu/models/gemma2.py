@@ -50,10 +50,7 @@ class Gemma2Config:
     final_logit_softcap: Optional[float] = 30.0
     sliding_window: int = 4096        # even layers; odd layers are global
     dtype: Any = jnp.bfloat16
-    # accepted for interface parity with LlamaConfig but not consulted:
-    # the pallas decode kernel supports neither softcapping nor windows, so
-    # this family always takes the XLA gather+flash path.
-    attn_impl: str = "auto"
+    attn_impl: str = "auto"           # same contract as LlamaConfig.attn_impl
 
     @property
     def tie_word_embeddings(self) -> bool:
@@ -202,12 +199,26 @@ def forward(
         kp, vp = write_kv_pages(
             kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions
         )
-        kc, vc = gather_kv_pages(kp, vp, page_table)
-        attn = flash_attention(
-            q, kc, vc, q_positions=positions, kv_lens=kv_lens,
-            sm_scale=sm_scale, window=window,
-            logit_softcap=cfg.attn_logit_softcap,
-        )
+        if T == 1 and cfg.attn_impl.startswith("pallas"):
+            # decode: page-streaming kernel; the per-layer window rides the
+            # scan as a traced scalar-prefetch operand
+            from production_stack_tpu.ops.pallas.paged_attention import (
+                ragged_paged_attention_decode,
+            )
+
+            attn = ragged_paged_attention_decode(
+                q[:, 0], kp, vp, page_table, kv_lens,
+                window=window, sm_scale=sm_scale,
+                logit_softcap=cfg.attn_logit_softcap,
+                interpret=cfg.attn_impl == "pallas_interpret",
+            )[:, None]
+        else:
+            kc, vc = gather_kv_pages(kp, vp, page_table)
+            attn = flash_attention(
+                q, kc, vc, q_positions=positions, kv_lens=kv_lens,
+                sm_scale=sm_scale, window=window,
+                logit_softcap=cfg.attn_logit_softcap,
+            )
         attn = (attn.reshape(B, T, -1)) @ lp["wo"]
         x = x + _rms_norm_1p(attn, lp["post_attn_norm"], eps)
 

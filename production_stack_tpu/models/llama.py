@@ -413,7 +413,7 @@ def forward(
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(h, lp, cfg, B, T, cos, sin, proj)
         kp, vp = write_kv_pages(kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions)
-        if T == 1 and cfg.attn_impl.startswith("pallas") and cfg.sliding_window is None:
+        if T == 1 and cfg.attn_impl.startswith("pallas"):
             # decode: stream pages HBM->VMEM, no gather materialization
             from production_stack_tpu.ops.pallas.paged_attention import (
                 ragged_paged_attention_decode,
@@ -421,6 +421,7 @@ def forward(
 
             attn = ragged_paged_attention_decode(
                 q[:, 0], kp, vp, page_table, kv_lens,
+                window=cfg.sliding_window,
                 interpret=cfg.attn_impl == "pallas_interpret",
             )[:, None]
         else:

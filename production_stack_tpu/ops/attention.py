@@ -172,6 +172,8 @@ def paged_attention_decode(
     seq_lens: jnp.ndarray,
     *,
     sm_scale: float | None = None,
+    window=None,
+    logit_softcap: float | None = None,
 ) -> jnp.ndarray:
     """Decode-step attention: one query token per sequence against its pages.
 
@@ -186,5 +188,7 @@ def paged_attention_decode(
         q_positions=(seq_lens - 1)[:, None],
         kv_lens=seq_lens,
         sm_scale=sm_scale,
+        window=window,
+        logit_softcap=logit_softcap,
     )
     return out[:, 0]

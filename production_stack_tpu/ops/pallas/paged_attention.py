@@ -14,6 +14,14 @@ so the (m, l, acc) VMEM scratch persists across that sequence's pages (same
 output block revisited) — the classic flash-decode accumulation. Query/kv
 heads stay packed [KH, G, D] so all heads of a page are one batched MXU call.
 
+Sliding-window attention (Mistral, Gemma-2's even layers) is handled by
+remapping the page axis: the index map starts fetching at the first page
+containing a visible KV slot (``(kv_len - window) // page_size``), so a
+4096-window sequence at 128k context streams ~window bytes, not ~context
+bytes. The window arrives as a scalar-prefetch operand, so per-layer window
+sizes (Gemma-2 interleaves local/global) ride the decoder's layer scan.
+Logit softcapping (Gemma-2) is a static transform on the scores.
+
 Equivalent role in the reference: vLLM's CUDA PagedAttention decode kernel
 (executed inside the engine image; configured by
 helm/templates/deployment-vllm-multi.yaml in /root/reference).
@@ -36,6 +44,7 @@ def _decode_kernel(
     # scalar prefetch
     pt_ref,      # [B, max_pages] int32 page table
     lens_ref,    # [B] int32 kv lengths
+    win_ref,     # [1] int32 window size (huge = full causal)
     # blocks
     q_ref,       # [1, NH, D]
     k_ref,       # [1, page_size, KH, D]
@@ -48,6 +57,7 @@ def _decode_kernel(
     *,
     sm_scale: float,
     kv_heads: int,
+    logit_softcap: float | None,
 ):
     b = pl.program_id(0)
     p = pl.program_id(1)
@@ -63,7 +73,8 @@ def _decode_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     kv_len = lens_ref[b]
-    start = p * page_size
+    lo = jnp.maximum(kv_len - win_ref[0], 0)   # first visible KV slot
+    start = (lo // page_size + p) * page_size  # this block's first slot
 
     @pl.when(start < kv_len)
     def _():
@@ -74,14 +85,17 @@ def _decode_kernel(
         scores = lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
         )
+        if logit_softcap is not None:
+            scores = logit_softcap * jnp.tanh(scores / logit_softcap)
         idx = start + lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
-        scores = jnp.where(idx < kv_len, scores, NEG_INF)
+        visible = (idx >= lo) & (idx < kv_len)
+        scores = jnp.where(visible, scores, NEG_INF)
 
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, scores.max(axis=-1))
         alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
         pij = jnp.exp(scores - m_new[..., None])
-        pij = jnp.where(idx < kv_len, pij, 0.0)
+        pij = jnp.where(visible, pij, 0.0)
         m_ref[...] = m_new
         l_ref[...] = l_prev * alpha + pij.sum(axis=-1)
         # [KH, G, page] x [KH, page, D] -> [KH, G, D]
@@ -96,15 +110,19 @@ def _decode_kernel(
         o_ref[0] = out.reshape(NH, D).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("sm_scale", "logit_softcap", "interpret")
+)
 def ragged_paged_attention_decode(
     q: jnp.ndarray,          # [B, NH, D]
     k_pages: jnp.ndarray,    # [P, page_size, KH, D]
     v_pages: jnp.ndarray,    # [P, page_size, KH, D]
     page_table: jnp.ndarray, # [B, max_pages] int32
     seq_lens: jnp.ndarray,   # [B] int32
+    window=None,             # scalar int (static or traced); None = full causal
     *,
     sm_scale: float | None = None,
+    logit_softcap: float | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Decode attention over paged KV, streaming pages HBM->VMEM.
@@ -117,27 +135,36 @@ def ragged_paged_attention_decode(
     max_pages = page_table.shape[1]
     G = NH // KH
     scale = sm_scale if sm_scale is not None else D**-0.5
+    win = (
+        jnp.full((1,), 2**30, jnp.int32)
+        if window is None
+        else jnp.asarray(window, jnp.int32).reshape(1)
+    )
+
+    def kv_index(b, p, pt, lens, w):
+        # start fetching at the first page with a visible slot so windowed
+        # layers stream ~window bytes regardless of context length
+        lo_page = jnp.maximum(lens[b] - w[0], 0) // page_size
+        return (pt[b, jnp.minimum(lo_page + p, max_pages - 1)], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, max_pages),
         in_specs=[
-            pl.BlockSpec((1, NH, D), lambda b, p, pt, lens: (b, 0, 0)),
-            pl.BlockSpec(
-                (1, page_size, KH, D), lambda b, p, pt, lens: (pt[b, p], 0, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, page_size, KH, D), lambda b, p, pt, lens: (pt[b, p], 0, 0, 0)
-            ),
+            pl.BlockSpec((1, NH, D), lambda b, p, pt, lens, w: (b, 0, 0)),
+            pl.BlockSpec((1, page_size, KH, D), kv_index),
+            pl.BlockSpec((1, page_size, KH, D), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, NH, D), lambda b, p, pt, lens: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, NH, D), lambda b, p, pt, lens, w: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((KH, G), jnp.float32),
             pltpu.VMEM((KH, G), jnp.float32),
             pltpu.VMEM((KH, G, D), jnp.float32),
         ],
     )
-    kernel = functools.partial(_decode_kernel, sm_scale=scale, kv_heads=KH)
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=scale, kv_heads=KH, logit_softcap=logit_softcap
+    )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -150,4 +177,4 @@ def ragged_paged_attention_decode(
             ),
             transcendentals=B * NH * max_pages * page_size,
         ),
-    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), q, k_pages, v_pages)
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), win, q, k_pages, v_pages)
