@@ -125,8 +125,8 @@ class EngineServer:
         emit("gpu_prefix_cache_queries_total", "counter", s["gpu_prefix_cache_queries_total"])
         emit("prompt_tokens_total", "counter", s["prompt_tokens_total"])
         emit("generation_tokens_total", "counter", s["generation_tokens_total"])
-        for k in sorted(s):  # kv offload / transfer metrics, present when wired
-            if k.startswith("kv_"):
+        for k in sorted(s):  # kv offload / transfer / spec metrics, when wired
+            if k.startswith(("kv_", "spec_decode_")):
                 kind = "counter" if k.endswith("_total") else "gauge"
                 emit(k, kind, s[k])
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")

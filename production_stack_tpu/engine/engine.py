@@ -127,6 +127,8 @@ class LLMEngine:
         # serving stats (scraped by /metrics)
         self.total_prompt_tokens = 0
         self.total_generation_tokens = 0
+        self.spec_draft_tokens = 0     # drafts proposed (rounds * spec_k)
+        self.spec_accepted_tokens = 0  # drafts the target accepted
         self.num_preemptions = 0
 
 
@@ -294,6 +296,11 @@ class LLMEngine:
                             self.scheduler.spec_k, self.scheduler.spec_ngram,
                         )
                     )  # [B, steps, 1+spec_k], -1 padded
+                    emitted = tokens >= 0
+                    rounds = int(emitted.any(axis=2).sum())
+                    self.spec_draft_tokens += rounds * self.scheduler.spec_k
+                    # each round emits its accepted drafts plus one bonus token
+                    self.spec_accepted_tokens += int(emitted.sum()) - rounds
                 elif batch.kind == "decode" and self.scheduler.decode_steps > 1:
                     tokens = np.asarray(
                         self.runner.step_multi(inp, self.scheduler.decode_steps)
@@ -626,6 +633,17 @@ class LLMEngine:
             "prompt_tokens_total": self.total_prompt_tokens,
             "generation_tokens_total": self.total_generation_tokens,
         }
+        if self.cfg.speculative_k:
+            # read accepted before drafts: the engine thread increments drafts
+            # first, so this order keeps any unsynchronized snapshot at
+            # accepted <= drafts (acceptance rate never exceeds 1.0)
+            accepted = self.spec_accepted_tokens
+            drafts = self.spec_draft_tokens
+            out["spec_decode_num_draft_tokens_total"] = drafts
+            out["spec_decode_num_accepted_tokens_total"] = accepted
+            out["spec_decode_draft_acceptance_rate"] = (
+                accepted / drafts if drafts else 0.0
+            )
         if self._kv_sender is not None:
             out["kv_transfer_sent_chunks_total"] = self._kv_sender.sent_chunks
             out["kv_transfer_sent_bytes_total"] = self._kv_sender.sent_bytes

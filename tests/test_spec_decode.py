@@ -141,6 +141,22 @@ def test_engine_spec_matches_plain_greedy():
         plain.stop(), spec.stop()
 
 
+def test_engine_spec_metrics():
+    """Acceptance counters surface through engine.stats() (and /metrics)."""
+    eng = LLMEngine(_cfg(speculative_k=3, speculative_ngram=2))
+    eng.start()
+    try:
+        _gen(eng, "ab ab ab ab ab", max_tokens=16, temperature=0.0,
+             ignore_eos=True)
+        s = eng.stats()
+        assert s["spec_decode_num_draft_tokens_total"] > 0
+        assert 0 <= s["spec_decode_num_accepted_tokens_total"] <= \
+            s["spec_decode_num_draft_tokens_total"]
+        assert 0.0 <= s["spec_decode_draft_acceptance_rate"] <= 1.0
+    finally:
+        eng.stop()
+
+
 def test_engine_spec_other_families():
     """Speculative decoding works for every family's all_logits verify path."""
     for model in ("opt-debug", "gemma2-debug"):
