@@ -1,0 +1,8 @@
+#!/bin/bash
+set -euo pipefail
+CLUSTER=${1:?usage: $0 CLUSTER_NAME [REGION]}
+REGION=${2:-us-west-2}
+if aws eks update-kubeconfig --name "$CLUSTER" --region "$REGION"; then
+  helm uninstall tpu-stack || true
+fi
+eksctl delete cluster --name "$CLUSTER" --region "$REGION"

@@ -1,0 +1,18 @@
+#!/bin/bash
+# EKS functional deployment (CPU engine backend).
+# Parity: /root/reference deployment_on_cloud/aws/entry_point.sh.
+set -euo pipefail
+CLUSTER=${1:?usage: $0 CLUSTER_NAME [REGION]}
+REGION=${2:-us-west-2}
+
+eksctl create cluster \
+  --name "$CLUSTER" \
+  --region "$REGION" \
+  --node-type m6i.2xlarge \
+  --nodes 2
+
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+helm install tpu-stack "$REPO_ROOT/helm" \
+  -f "$(dirname "$0")/production_stack_specification.yaml" \
+  --wait --timeout 10m
+kubectl get pods -o wide

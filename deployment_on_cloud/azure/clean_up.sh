@@ -1,0 +1,9 @@
+#!/bin/bash
+set -euo pipefail
+RG=${1:?usage: $0 RESOURCE_GROUP CLUSTER_NAME}
+CLUSTER=${2:?usage: $0 RESOURCE_GROUP CLUSTER_NAME}
+if az aks get-credentials --resource-group "$RG" --name "$CLUSTER" --overwrite-existing; then
+  helm uninstall tpu-stack || true
+fi
+az aks delete --resource-group "$RG" --name "$CLUSTER" --yes
+az group delete --name "$RG" --yes

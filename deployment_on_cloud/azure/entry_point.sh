@@ -1,0 +1,17 @@
+#!/bin/bash
+# AKS functional deployment (CPU engine backend).
+set -euo pipefail
+RG=${1:?usage: $0 RESOURCE_GROUP CLUSTER_NAME [LOCATION]}
+CLUSTER=${2:?usage: $0 RESOURCE_GROUP CLUSTER_NAME [LOCATION]}
+LOCATION=${3:-westus2}
+
+az group create --name "$RG" --location "$LOCATION"
+az aks create --resource-group "$RG" --name "$CLUSTER" \
+  --node-count 2 --node-vm-size Standard_D8s_v5 --generate-ssh-keys
+az aks get-credentials --resource-group "$RG" --name "$CLUSTER"
+
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+helm install tpu-stack "$REPO_ROOT/helm" \
+  -f "$(dirname "$0")/production_stack_specification.yaml" \
+  --wait --timeout 10m
+kubectl get pods -o wide

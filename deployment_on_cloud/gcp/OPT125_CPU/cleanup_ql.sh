@@ -1,0 +1,9 @@
+#!/bin/bash
+set -euo pipefail
+PROJECT_ID=${1:?usage: $0 PROJECT_ID ZONE}
+ZONE=${2:?usage: $0 PROJECT_ID ZONE}
+gcloud config set project "$PROJECT_ID"
+if gcloud container clusters get-credentials tpu-stack-cpu-lab --zone "$ZONE"; then
+  helm uninstall tpu-stack || true
+fi
+gcloud container clusters delete tpu-stack-cpu-lab --zone "$ZONE" --quiet

@@ -1,0 +1,18 @@
+#!/bin/bash
+# CPU-only quick-lab deployment: small GKE cluster, engine on the JAX CPU
+# backend serving an OPT-125M-class preset.
+set -euo pipefail
+PROJECT_ID=${1:?usage: $0 PROJECT_ID ZONE}
+ZONE=${2:?usage: $0 PROJECT_ID ZONE}
+CLUSTER=tpu-stack-cpu-lab
+
+gcloud config set project "$PROJECT_ID"
+gcloud container clusters create "$CLUSTER" \
+  --zone "$ZONE" --machine-type e2-standard-8 --num-nodes 2
+gcloud container clusters get-credentials "$CLUSTER" --zone "$ZONE"
+
+REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+helm install tpu-stack "$REPO_ROOT/helm" \
+  -f "$(dirname "$0")/production_stack_specification_ql.yaml" \
+  --wait --timeout 10m
+kubectl get pods

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Tear down everything entry_point_basic.sh created.
+set -euo pipefail
+PROJECT_ID=${1:?usage: $0 PROJECT_ID ZONE}
+ZONE=${2:?usage: $0 PROJECT_ID ZONE}
+CLUSTER=tpu-production-stack
+
+gcloud config set project "$PROJECT_ID"
+# point kubectl/helm at THIS cluster before uninstalling; if that fails
+# (cluster already gone), skip the uninstall rather than touching whatever
+# cluster the current kube-context points at
+if gcloud container clusters get-credentials "$CLUSTER" --zone "$ZONE"; then
+  helm uninstall tpu-stack || true
+fi
+gcloud container clusters delete "$CLUSTER" --zone "$ZONE" --quiet

@@ -1,0 +1,49 @@
+#!/bin/bash
+# One-click GKE+TPU deployment.
+# Usage: entry_point_basic.sh <PROJECT_ID> <ZONE> <SPEC_YAML>
+# Parity: /root/reference deployment_on_cloud/gcp/entry_point_basic.sh
+# (GPU GKE), re-targeted at TPU v5e nodepools.
+set -euo pipefail
+
+PROJECT_ID=${1:?usage: $0 PROJECT_ID ZONE SPEC_YAML}
+ZONE=${2:?usage: $0 PROJECT_ID ZONE SPEC_YAML}
+SPEC=${3:-"$(dirname "$0")/production_stack_specification_basic.yaml"}
+
+CLUSTER=tpu-production-stack
+TPU_POOL=tpu-v5e-pool
+
+gcloud config set project "$PROJECT_ID"
+
+echo ">>> creating GKE cluster $CLUSTER in $ZONE"
+gcloud container clusters create "$CLUSTER" \
+  --zone "$ZONE" \
+  --machine-type e2-standard-8 \
+  --num-nodes 1 \
+  --release-channel regular
+
+echo ">>> adding TPU v5e nodepool ($TPU_POOL, 2x4 topology = 8 chips)"
+gcloud container node-pools create "$TPU_POOL" \
+  --cluster "$CLUSTER" \
+  --zone "$ZONE" \
+  --machine-type ct5lp-hightpu-8t \
+  --tpu-topology 2x4 \
+  --num-nodes 1
+
+gcloud container clusters get-credentials "$CLUSTER" --zone "$ZONE"
+
+echo ">>> installing the production-stack-tpu helm chart"
+# meta-llama repos are gated: forward the caller's HF token or fail fast
+# instead of burning 15 min of TPU nodepool on a 401
+HF_TOKEN="${HF_TOKEN:-}"
+if [ -z "$HF_TOKEN" ]; then
+  echo "ERROR: export HF_TOKEN=<huggingface token with meta-llama access> first" >&2
+  exit 1
+fi
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+helm install tpu-stack "$REPO_ROOT/helm" -f "$SPEC" \
+  --set "servingEngineSpec.modelSpec[0].hf_token=$HF_TOKEN" \
+  --wait --timeout 15m
+
+kubectl get pods -o wide
+echo ">>> done. Port-forward the router:"
+echo "    kubectl port-forward svc/tpu-stack-router-service 30080:80"

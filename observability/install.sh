@@ -15,6 +15,11 @@ kubectl -n "$NS" create configmap tpu-stack-dashboard \
   --dry-run=client -o yaml | kubectl apply -f -
 kubectl -n "$NS" label configmap tpu-stack-dashboard grafana_dashboard=1 --overwrite
 
+# KV-offload tier dashboard (LMCache-dashboard equivalent); retarget the
+# manifest's namespace at $NS where the Grafana sidecar looks
+sed "s/^  namespace: monitoring$/  namespace: $NS/" \
+  "$(dirname "$0")/kvoffload-dashboard-cm.yaml" | kubectl apply -f -
+
 # custom-metrics adapter for HPA on queue depth
 helm upgrade --install prom-adapter prometheus-community/prometheus-adapter \
   --namespace "$NS" -f "$(dirname "$0")/prom-adapter.yaml"

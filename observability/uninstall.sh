@@ -4,3 +4,4 @@ NS="${1:-monitoring}"
 helm uninstall prom-adapter -n "$NS" || true
 helm uninstall kube-prom-stack -n "$NS" || true
 kubectl -n "$NS" delete configmap tpu-stack-dashboard || true
+kubectl -n "$NS" delete configmap grafana-kvoffload-dashboard || true

@@ -97,6 +97,23 @@ class TestObservability:
         stack = yaml.safe_load(_load("observability/kube-prom-stack.yaml"))
         assert "prometheus" in stack
 
+    def test_kvoffload_dashboard_cm(self):
+        """The KV-offload dashboard ConfigMap (LMCache-dashboard equivalent)
+        must be valid YAML wrapping valid dashboard JSON, and every engine
+        metric it charts must be one the engine actually exports."""
+        cm = yaml.safe_load(_load("observability/kvoffload-dashboard-cm.yaml"))
+        assert cm["metadata"]["labels"]["grafana_dashboard"] == "1"
+        dash = json.loads(cm["data"]["kvoffload-dashboard.json"])
+        assert dash["panels"]
+        engine = _load("production_stack_tpu/engine/engine.py")
+        app = _load("production_stack_tpu/router/app.py")
+        for p in dash["panels"]:
+            for t in p["targets"]:
+                for name in re.findall(r"vllm:([a-z_]+)", t["expr"]):
+                    assert name in engine, f"unexported engine metric {name}"
+                for name in re.findall(r"vllm_router:[a-z_]+", t["expr"]):
+                    assert name in app, f"unexported router metric {name}"
+
     def test_hpa_metric_matches_adapter(self):
         values = yaml.safe_load(_load("helm/values.yaml"))
         adapter = yaml.safe_load(_load("observability/prom-adapter.yaml"))
@@ -104,3 +121,50 @@ class TestObservability:
             values["autoscaling"]["targetMetric"]
             == adapter["rules"]["custom"][0]["name"]["as"]
         )
+
+
+class TestCloudDeployAssets:
+    """deployment_on_cloud/ + terraform specs must stay valid helm values
+    (schema-checked) and reference only chart-known value paths."""
+
+    SPECS = [
+        "deployment_on_cloud/gcp/production_stack_specification_basic.yaml",
+        "deployment_on_cloud/gcp/OPT125_CPU/production_stack_specification_ql.yaml",
+        "deployment_on_cloud/aws/production_stack_specification.yaml",
+        "deployment_on_cloud/azure/production_stack_specification.yaml",
+        "tutorials/terraform/gke/production_stack_specification.yaml",
+    ]
+
+    def test_specs_parse_and_validate(self):
+        jsonschema = pytest.importorskip("jsonschema")
+        schema = json.loads(_load("helm/values.schema.json"))
+        for spec in self.SPECS:
+            values = yaml.safe_load(_load(spec))
+            jsonschema.validate(values, schema)
+            assert values["servingEngineSpec"]["modelSpec"], spec
+
+    def test_scripts_are_wellformed(self):
+        for script in (
+            "deployment_on_cloud/gcp/entry_point_basic.sh",
+            "deployment_on_cloud/gcp/clean_up_basic.sh",
+            "deployment_on_cloud/gcp/OPT125_CPU/entrypoint_ql.sh",
+            "deployment_on_cloud/gcp/OPT125_CPU/cleanup_ql.sh",
+            "deployment_on_cloud/aws/entry_point.sh",
+            "deployment_on_cloud/aws/clean_up.sh",
+            "deployment_on_cloud/azure/entry_point.sh",
+            "deployment_on_cloud/azure/clean_up.sh",
+        ):
+            text = _load(script)
+            assert text.startswith("#!/bin/bash"), script
+            assert "set -euo pipefail" in text, script
+
+    def test_static_discovery_chart_surface(self):
+        """Tutorial 02's router-plane shape must be renderable: the chart
+        exposes staticBackends/staticModels and the router parser accepts
+        the flags the template renders."""
+        values = yaml.safe_load(_load("helm/values.yaml"))
+        assert "staticBackends" in values["routerSpec"]
+        tmpl = _load("helm/templates/deployment-router.yaml")
+        parser = _load("production_stack_tpu/router/parser.py")
+        for flag in ("--static-backends", "--static-models"):
+            assert flag in tmpl and flag in parser

@@ -1,0 +1,35 @@
+resource "google_container_cluster" "this" {
+  name                     = var.cluster_name
+  location                 = var.zone
+  initial_node_count       = 1
+  remove_default_node_pool = false
+
+  node_config {
+    machine_type = "e2-standard-8"
+  }
+}
+
+resource "google_container_node_pool" "tpu_v5e" {
+  name       = "tpu-v5e-pool"
+  cluster    = google_container_cluster.this.name
+  location   = var.zone
+  node_count = 1
+
+  node_config {
+    machine_type = "ct5lp-hightpu-8t"
+  }
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+}
+
+resource "local_file" "kubeconfig" {
+  filename = "${path.module}/kubeconfig"
+  content = templatefile("${path.module}/kubeconfig.tpl", {
+    endpoint = google_container_cluster.this.endpoint
+    ca_cert  = google_container_cluster.this.master_auth[0].cluster_ca_certificate
+    name     = google_container_cluster.this.name
+  })
+}

@@ -1,0 +1,7 @@
+output "cluster_name" {
+  value = google_container_cluster.this.name
+}
+
+output "kubeconfig_path" {
+  value = local_file.kubeconfig.filename
+}

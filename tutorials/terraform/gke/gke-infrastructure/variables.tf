@@ -1,0 +1,20 @@
+variable "project_id" {
+  type        = string
+  description = "GCP project with TPU quota"
+}
+
+variable "zone" {
+  type        = string
+  default     = "us-west4-a"
+  description = "Zone offering tpu-v5-lite-podslice"
+}
+
+variable "cluster_name" {
+  type    = string
+  default = "tpu-production-stack"
+}
+
+variable "tpu_topology" {
+  type    = string
+  default = "2x4" # 8 chips
+}

@@ -1,0 +1,23 @@
+terraform {
+  required_providers {
+    helm = {
+      source  = "hashicorp/helm"
+      version = ">= 2.12, < 3.0" # 3.x changed the kubernetes block to an attribute
+    }
+  }
+}
+
+provider "helm" {
+  kubernetes {
+    config_path = var.kubeconfig_path
+  }
+}
+
+resource "helm_release" "production_stack_tpu" {
+  name    = "tpu-stack"
+  chart   = "${path.module}/../../../../helm"
+  timeout = 900
+  wait    = true
+
+  values = [file(var.values_file)]
+}

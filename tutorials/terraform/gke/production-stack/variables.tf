@@ -1,0 +1,9 @@
+variable "kubeconfig_path" {
+  type        = string
+  description = "kubeconfig produced by the gke-infrastructure stage"
+}
+
+variable "values_file" {
+  type        = string
+  description = "helm values file for the stack"
+}
