@@ -158,6 +158,7 @@ def http_stack_metrics(on_tpu: bool) -> dict:
         cfg = EngineConfig(
             model=model, host="127.0.0.1", port=eport, max_model_len=2048,
             max_num_seqs=16, kv_cache_memory_gb=1.0, prefill_chunk=1024,
+            decode_pipeline=2,
             # CPU jit ignores buffer donation, so pool updates copy the whole
             # pool per step — keep it small there; TPU updates are in-place
             num_pages=None if on_tpu else 2048,

@@ -33,6 +33,14 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - {{ .model.pageSize | default 16 | quote }}
 - "--kv-cache-memory-gb"
 - {{ .model.kvCacheMemoryGB | default 4 | quote }}
+{{- if .model.decodeSteps }}
+- "--decode-steps"
+- {{ .model.decodeSteps | quote }}
+{{- end }}
+{{- if .model.decodePipeline }}
+- "--decode-pipeline"
+- {{ .model.decodePipeline | quote }}
+{{- end }}
 {{- if not (.model.enableChunkedPrefill | default true) }}
 - "--no-enable-chunked-prefill"
 {{- end }}

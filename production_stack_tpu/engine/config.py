@@ -32,6 +32,12 @@ class EngineConfig:
     # after EOS are discarded host-side. With speculative decoding on, this is
     # the number of fused draft+verify rounds per dispatch instead.
     decode_steps: int = 8
+    # chained decode bursts per dispatch when no requests are waiting: burst
+    # j+1's input token is fed from burst j's device-resident output, so a
+    # chain of m bursts pays one fetch round trip instead of m (matters on
+    # network-attached TPUs where a fetch costs ~compute-of-a-burst). Arrivals
+    # during a chain wait up to (pipeline-1) extra bursts before prefill.
+    decode_pipeline: int = 1
     # speculative decoding (prompt-lookup/n-gram, fused on device): draft
     # length per round; 0 disables. The TPU-native analogue of vLLM's ngram
     # speculator — decode becomes parallel verify instead of serial steps.

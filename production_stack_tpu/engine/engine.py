@@ -112,10 +112,14 @@ class LLMEngine:
             prefill_batch=cfg.prefill_batch,
             enable_prefix_caching=cfg.enable_prefix_caching,
             decode_steps=cfg.decode_steps,
+            decode_pipeline=cfg.decode_pipeline,
             spec_k=cfg.speculative_k,
             spec_ngram=cfg.speculative_ngram,
         )
         self._inbox: queue_mod.Queue = queue_mod.Queue()
+        # prefill dispatches whose results were never fetched (skip-fetch
+        # optimization); a deferred device error taints these sequences
+        self._unfetched: list = []
         self._outputs: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._texts: dict[str, str] = {}
         self._thread: Optional[threading.Thread] = None
@@ -283,6 +287,7 @@ class LLMEngine:
             batch = self.scheduler.schedule()
             if batch is None:
                 continue
+            fetched = True
             try:
                 inp = StepInput(
                     batch.input_ids, batch.positions, batch.page_table,
@@ -302,19 +307,58 @@ class LLMEngine:
                     # each round emits its accepted drafts plus one bonus token
                     self.spec_accepted_tokens += int(emitted.sum()) - rounds
                 elif batch.kind == "decode" and self.scheduler.decode_steps > 1:
-                    tokens = np.asarray(
-                        self.runner.step_multi(inp, self.scheduler.decode_steps)
-                    )  # [B, k]
+                    if batch.bursts > 1:
+                        # chained bursts: all dispatches go out before any
+                        # fetch, so the chain costs bursts*compute + 1 round
+                        # trip. Fetch EVERY burst before applying any — apply
+                        # may finish sequences and free their pages, which
+                        # must not happen while a later burst could still be
+                        # writing to them.
+                        devs = self.runner.step_multi_pipelined(
+                            inp, self.scheduler.decode_steps, batch.bursts
+                        )
+                        tokens = np.concatenate(
+                            [np.asarray(d) for d in devs], axis=1
+                        )  # [B, bursts*k]
+                    else:
+                        tokens = np.asarray(
+                            self.runner.step_multi(inp, self.scheduler.decode_steps)
+                        )  # [B, k]
+                elif batch.kind == "prefill" and not any(
+                    s.num_computed + c >= len(s.prompt_ids)
+                    for s, c in zip(batch.seqs, batch.chunk_sizes)
+                ):
+                    # every chunk in this step is intermediate — nobody's
+                    # prompt completes, so the sampled tokens are discarded
+                    # anyway. Dispatch async and skip the host fetch: on
+                    # network-attached TPUs each fetch is a full host<->device
+                    # round trip, so an N-chunk prefill costs N*compute + 1 RTT
+                    # instead of N*(compute + RTT). A deferred device error
+                    # surfaces at the next fetched step; _unfetched records
+                    # whose KV state is then suspect so the handler can abort
+                    # them too, not just the batch it surfaced on.
+                    self.runner.step(inp)
+                    self._unfetched.append(batch)
+                    fetched = False
+                    tokens = np.full((len(batch.seqs),), -1, np.int32)
                 else:
                     ids, _ = self.runner.step(inp)
                     tokens = np.asarray(ids)
             except Exception:
                 logger.exception("engine step failed; aborting batch")
-                for s in batch.seqs:
+                # deferred errors from skipped-fetch prefill dispatches
+                # surface here: those sequences' KV is suspect, abort them too
+                suspect = list(batch.seqs)
+                for b in self._unfetched:
+                    suspect.extend(b.seqs)
+                self._unfetched.clear()
+                for s in suspect:
                     if not s.finished:
                         self.scheduler._finish(s, "error")
                         self._emit(s, "", error=True)
                 continue
+            if fetched:
+                self._unfetched.clear()  # a real fetch retires prior dispatches
             events = self.scheduler.apply_step(
                 batch, tokens, self.tokenizer.eos_token_id
             )
@@ -384,20 +428,30 @@ class LLMEngine:
             full = full.rstrip("�")
         prev = self._texts.get(seq.seq_id, "")
         delta = full[len(prev):] if full.startswith(prev) else full
-        for stop in seq.params.stop:
-            idx = full.find(stop)
-            if idx >= 0:
-                delta = full[len(prev): idx]
-                # drop burst tokens past the stop: keep the smallest token
-                # prefix whose decode contains the stop string — exactly the
-                # token at which a decode_steps=1 engine detects it — so
-                # token_ids / completion_tokens match single-step accounting
-                base = len(seq.output_ids) - len(new_tokens)
-                keep = len(new_tokens)
-                for m in range(1, len(new_tokens) + 1):
-                    if stop in self.tokenizer.decode(seq.output_ids[: base + m]):
-                        keep = m
+        raw = self.tokenizer.decode(seq.output_ids)
+        if seq.params.stop and any(s in raw for s in seq.params.stop):
+            # Stop detection must not depend on emission boundaries (per-token
+            # vs burst vs chained bursts give the same stream): scan this
+            # step's token prefixes and stop at the FIRST prefix whose decode
+            # contains a stop string — exactly where a decode_steps=1 engine
+            # detects it. The prefix scan is O(burst * output length)
+            # detokenization, so it only runs once the full decode contains a
+            # stop (a stop visible at some prefix is made of complete chars
+            # and stays visible in the full text).
+            base = len(seq.output_ids) - len(new_tokens)
+            hit = None  # (keep, text_at_keep, stop_index)
+            for m in range(1, len(new_tokens) + 1):
+                txt = self.tokenizer.decode(seq.output_ids[: base + m])
+                for stop in seq.params.stop:
+                    idx = txt.find(stop)
+                    if idx >= 0:
+                        hit = (m, txt, idx)
                         break
+                if hit:
+                    break
+            if hit:
+                keep, txt, idx = hit
+                delta = txt[len(prev): idx] if txt.startswith(prev) else txt[:idx]
                 del seq.output_ids[base + keep:]
                 # the loop already counted the whole burst
                 self.total_generation_tokens -= len(new_tokens) - keep
@@ -408,7 +462,6 @@ class LLMEngine:
                     # the length cap landed in the same step the stop text
                     # appeared; the emitted text ends at the stop, so report it
                     seq.finish_reason = "stop"
-                break
         with self._lock:
             self._texts[seq.seq_id] = prev + delta
         self._emit(seq, delta, tokens=new_tokens)

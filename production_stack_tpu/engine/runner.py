@@ -125,8 +125,12 @@ class ModelRunner:
         if self.mesh.devices.size == 1:
             # single chip: hand numpy straight to the jitted call — one
             # transfer batch instead of a device_put round trip per array
-            # (matters on network-attached chips)
-            row = vec = lambda x, dt: np.asarray(x, np.dtype(dt))
+            # (matters on network-attached chips). Device arrays (burst
+            # chaining feeds the previous burst's tokens back without a
+            # host fetch) pass through untouched.
+            row = vec = lambda x, dt: (
+                x if isinstance(x, jax.Array) else np.asarray(x, np.dtype(dt))
+            )
         else:
             row = lambda x, dt: jax.device_put(jnp.asarray(x, dt), self._row_sh)
             vec = lambda x, dt: jax.device_put(jnp.asarray(x, dt), self._vec_sh)
@@ -217,6 +221,48 @@ class ModelRunner:
             s["lora_ids"],
         )
         return toks
+
+    def step_multi_pipelined(self, inp: StepInput, k: int, bursts: int) -> list:
+        """Dispatch ``bursts`` chained k-step decode bursts WITHOUT fetching
+        between them; returns the per-burst device token arrays ([B, k] each).
+
+        Why: on network-attached TPUs every host fetch costs a full round
+        trip (~100 ms), comparable to the burst's compute. Chaining feeds
+        burst j+1's input token straight from burst j's device-resident
+        output (toks[:, -1:]), so a chain of m bursts costs m*compute + 1 RTT
+        when the caller finally fetches, instead of m*(compute + RTT).
+
+        The host mirrors the device's per-row activity rule exactly
+        (_multi_step_fn body: emit; active = pos>=0 & lens<kv_limits;
+        pos = active ? pos+1 : -1; lens += active) to derive each burst's
+        positions/kv_lens, and passes pos=-1 for rows that went inactive so
+        the seam step's KV writes drop instead of corrupting the last real
+        token's page slot. Requires inp.kv_limits sized for the FULL
+        bursts*k budget (scheduler plans this).
+        """
+        if bursts <= 1:
+            return [self.step_multi(inp, k)]
+        pos = np.asarray(inp.positions, np.int64)[:, 0].copy()
+        lens = np.asarray(inp.kv_lens, np.int64).copy()
+        limits = np.asarray(inp.kv_limits, np.int64)
+        outs = []
+        cur = inp
+        for j in range(bursts):
+            toks = self.step_multi(cur, k)
+            outs.append(toks)
+            if j == bursts - 1:
+                break
+            for _ in range(k):  # exact mirror of the device scan
+                active = (pos >= 0) & (lens < limits)
+                pos = np.where(active, pos + 1, -1)
+                lens = lens + active
+            cur = dataclasses.replace(
+                inp,
+                input_ids=toks[:, -1:],
+                positions=pos[:, None].astype(np.int32),
+                kv_lens=lens.astype(np.int32),
+            )
+        return outs
 
     def step_spec(
         self, inp: StepInput, history: Any, steps: int, spec_k: int, ngram: int

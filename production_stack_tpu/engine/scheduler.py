@@ -82,6 +82,8 @@ class ScheduledBatch:
     history: np.ndarray = None     # [B, H] token ids (speculative drafting)
     # how many tokens of each seq this step computes (prefill chunking)
     chunk_sizes: list[int] = field(default_factory=list)
+    # chained decode bursts this dispatch covers (runner.step_multi_pipelined)
+    bursts: int = 1
 
 
 def _bucket(n: int, buckets: tuple[int, ...]) -> int:
@@ -107,6 +109,7 @@ class Scheduler:
         prefill_batch: int = 4,
         enable_prefix_caching: bool = True,
         decode_steps: int = 1,
+        decode_pipeline: int = 1,
         spec_k: int = 0,
         spec_ngram: int = 3,
     ):
@@ -121,6 +124,10 @@ class Scheduler:
         # With spec_k > 0 it is the number of fused draft+verify ROUNDS instead
         # (runner.step_spec), each emitting 1..spec_k+1 tokens.
         self.decode_steps = max(1, decode_steps)
+        # chained bursts per decode dispatch when the batch is quiescent (no
+        # waiting work): m bursts cost m*compute + 1 fetch round trip instead
+        # of m of each (runner.step_multi_pipelined)
+        self.decode_pipeline = max(1, decode_pipeline)
         self.spec_k = max(0, spec_k)
         self.spec_ngram = max(1, spec_ngram)
         self.waiting: list[Sequence] = []
@@ -179,12 +186,13 @@ class Scheduler:
             self.waiting.pop(0)
             self.running.append(seq)
 
-    def _burst_budget(self, seq: Sequence) -> int:
-        """Tokens this sequence can still usefully produce in one decode burst:
-        the configured burst length, capped by its remaining max_tokens budget
-        (so near-finished requests don't reserve KV for tokens that would be
-        discarded)."""
-        return max(1, min(self.decode_steps, seq.params.max_tokens - len(seq.output_ids)))
+    def _burst_budget(self, seq: Sequence, bursts: int = 1) -> int:
+        """Tokens this sequence can still usefully produce in one decode
+        dispatch (``bursts`` chained bursts of decode_steps each), capped by
+        its remaining max_tokens budget (so near-finished requests don't
+        reserve KV for tokens that would be discarded)."""
+        return max(1, min(bursts * self.decode_steps,
+                          seq.params.max_tokens - len(seq.output_ids)))
 
     def _spec_limit(self, seq: Sequence) -> int:
         """Max KV length a fused speculative dispatch may reach for ``seq``:
@@ -197,16 +205,17 @@ class Scheduler:
         iters = max(1, min(self.decode_steps, -(-remaining // per)))
         return min(seq.num_tokens + iters * per, self.max_model_len + self.spec_k)
 
-    def _decode_target_len(self, seq: Sequence) -> int:
+    def _decode_target_len(self, seq: Sequence, bursts: int = 1) -> int:
         """KV capacity (in tokens) a decode dispatch needs for ``seq``."""
         if self.spec_k:
             return self._spec_limit(seq)
-        return min(seq.num_tokens + self._burst_budget(seq), self.max_model_len + 1)
+        return min(seq.num_tokens + self._burst_budget(seq, bursts),
+                   self.max_model_len + 1)
 
-    def _ensure_decode_page(self, seq: Sequence) -> bool:
-        """Make sure the next decode burst has KV slots; grow the page list if
-        needed (one burst of lookahead)."""
-        need = self._pages_needed(self._decode_target_len(seq)) - len(seq.pages)
+    def _ensure_decode_page(self, seq: Sequence, bursts: int = 1) -> bool:
+        """Make sure the next decode dispatch has KV slots; grow the page list
+        if needed (one dispatch of lookahead)."""
+        need = self._pages_needed(self._decode_target_len(seq, bursts)) - len(seq.pages)
         if need <= 0:
             return True
         extra = self.kv.allocate(need)
@@ -238,7 +247,15 @@ class Scheduler:
             prefilling.sort(key=lambda s: len(s.prompt_ids) - s.num_computed)
             return self._plan_prefill(prefilling[: self.prefill_batch])
         if self.running:
-            return self._plan_decode(self.running)
+            # chain bursts only when nothing is waiting to join the batch:
+            # a chained dispatch delays the next scheduling decision by
+            # (bursts-1) * burst compute, which would hurt arrivals' TTFT
+            bursts = (
+                self.decode_pipeline
+                if (not self.waiting and not self.spec_k and self.decode_steps > 1)
+                else 1
+            )
+            return self._plan_decode(self.running, bursts)
         return None
 
     def _plan_prefill(self, seqs: list[Sequence]) -> ScheduledBatch:
@@ -275,12 +292,14 @@ class Scheduler:
             temperature, top_k, top_p, lora_ids=lora_ids, chunk_sizes=chunks,
         )
 
-    def _plan_decode(self, seqs: list[Sequence]) -> Optional[ScheduledBatch]:
+    def _plan_decode(
+        self, seqs: list[Sequence], bursts: int = 1
+    ) -> Optional[ScheduledBatch]:
         ready = []
         for s in list(seqs):
             if s not in self.running or s.finished:
                 continue  # preempted or finished earlier in this pass
-            ok = self._ensure_decode_page(s)
+            ok = self._ensure_decode_page(s, bursts)
             while not ok:
                 # out of KV pages: preempt the newest other running sequence;
                 # if there is none, preempt s itself
@@ -292,14 +311,14 @@ class Scheduler:
                 self._preempt(victim)
                 if victim in ready:
                     ready.remove(victim)
-                ok = self._ensure_decode_page(s)
+                ok = self._ensure_decode_page(s, bursts)
             if ok:
                 ready.append(s)
         if not ready:
             return None
         B = _bucket(len(ready), self.DECODE_BATCH_BUCKETS)
         max_pages = _bucket(
-            max(self._pages_needed(self._decode_target_len(s)) for s in ready),
+            max(self._pages_needed(self._decode_target_len(s, bursts)) for s in ready),
             self.PAGE_BUCKETS,
         )
         input_ids = np.zeros((B, 1), np.int32)
@@ -351,12 +370,12 @@ class Scheduler:
                 kv_limits[i] = min(
                     len(s.pages) * self.kv.page_size,
                     self.max_model_len,
-                    s.num_tokens + self._burst_budget(s) - 1,
+                    s.num_tokens + self._burst_budget(s, bursts) - 1,
                 )
         return ScheduledBatch(
             "decode", ready, input_ids, positions, page_table, kv_lens,
             temperature, top_k, top_p, lora_ids=lora_ids, kv_limits=kv_limits,
-            history=history,
+            history=history, bursts=bursts,
         )
 
     def _preempt(self, seq: Sequence) -> None:

@@ -188,3 +188,78 @@ def test_engine_multistep_eos_respected():
         assert n <= 64
     finally:
         eng.stop()
+
+
+def test_step_multi_pipelined_matches_sequential_greedy():
+    """Chained bursts (next input fed from the device-resident previous burst)
+    must equal separate step_multi calls with host-fetched feedback."""
+    B, page_size, ctx_pages, k, m = 2, 8, 8, 3, 3
+    ctx = 16
+    lim = np.full((B,), ctx + 1 + m * k, np.int32)
+
+    r1 = ModelRunner(CFG, num_pages=B * ctx_pages, page_size=page_size, seed=0)
+    inp1 = _decode_input(np.random.RandomState(2), B, ctx, page_size, ctx_pages,
+                         kv_limits=lim.copy())
+    ref, cur = [], inp1
+    import dataclasses
+    for _ in range(m):
+        t = np.asarray(r1.step_multi(cur, k))
+        ref.append(t)
+        cur = dataclasses.replace(
+            cur,
+            input_ids=t[:, -1:].astype(np.int32),
+            positions=cur.positions + k,
+            kv_lens=cur.kv_lens + k,
+        )
+    ref = np.concatenate(ref, axis=1)
+
+    r2 = ModelRunner(CFG, num_pages=B * ctx_pages, page_size=page_size, seed=0)
+    inp2 = _decode_input(np.random.RandomState(2), B, ctx, page_size, ctx_pages,
+                         kv_limits=lim.copy())
+    devs = r2.step_multi_pipelined(inp2, k, m)
+    got = np.concatenate([np.asarray(d) for d in devs], axis=1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_step_multi_pipelined_limit_mid_chain():
+    """A row whose kv_limit lands inside burst 2 of a 3-burst chain: its real
+    tokens match the unlimited run, the neighbor row is unaffected, and the
+    seam passes pos=-1 (no KV corruption — checked by the neighbor's later
+    tokens, which attend over its own pages)."""
+    B, page_size, ctx_pages, k, m = 2, 8, 8, 3, 3
+    ctx = 16
+    lim0 = k + 1  # row 0: one token into burst 2
+
+    r_ref = ModelRunner(CFG, num_pages=B * ctx_pages, page_size=page_size, seed=0)
+    full = np.full((B,), ctx + 1 + m * k, np.int32)
+    ref = np.concatenate([
+        np.asarray(d) for d in r_ref.step_multi_pipelined(
+            _decode_input(np.random.RandomState(3), B, ctx, page_size,
+                          ctx_pages, kv_limits=full.copy()), k, m)
+    ], axis=1)
+
+    r_lim = ModelRunner(CFG, num_pages=B * ctx_pages, page_size=page_size, seed=0)
+    lims = np.array([ctx + 1 + lim0 - 1, ctx + 1 + m * k], np.int32)
+    got = np.concatenate([
+        np.asarray(d) for d in r_lim.step_multi_pipelined(
+            _decode_input(np.random.RandomState(3), B, ctx, page_size,
+                          ctx_pages, kv_limits=lims), k, m)
+    ], axis=1)
+    np.testing.assert_array_equal(got[0, :lim0], ref[0, :lim0])
+    np.testing.assert_array_equal(got[1], ref[1])
+
+
+def test_engine_decode_pipeline_matches_unpipelined_greedy():
+    e1 = LLMEngine(_cfg(decode_steps=3, decode_pipeline=1))
+    e3 = LLMEngine(_cfg(decode_steps=3, decode_pipeline=3))
+    e1.start(), e3.start()
+    try:
+        t1, n1, r1 = _gen_text_and_count(
+            e1, "pipeline me", max_tokens=14, temperature=0.0, ignore_eos=True)
+        t3, n3, r3 = _gen_text_and_count(
+            e3, "pipeline me", max_tokens=14, temperature=0.0, ignore_eos=True)
+        assert n1 == n3 == 14
+        assert t1 == t3
+        assert r1 == r3 == "length"
+    finally:
+        e1.stop(), e3.stop()
