@@ -155,10 +155,14 @@ def http_stack_metrics(on_tpu: bool) -> dict:
         eport, rport = free_port(), free_port()
         loop = asyncio.new_event_loop()
         threading.Thread(target=loop.run_forever, daemon=True).start()
+        # decode_pipeline stays 1 here: chaining doubles the decode program
+        # variants ((batch bucket, pages bucket) x bursts), and on this
+        # network-attached chip each cold compile is 20-40s — fatal inside the
+        # short measured window. Steady-state serving (long-lived pods) is
+        # where chaining pays; see EngineConfig.decode_pipeline.
         cfg = EngineConfig(
             model=model, host="127.0.0.1", port=eport, max_model_len=2048,
             max_num_seqs=16, kv_cache_memory_gb=1.0, prefill_chunk=1024,
-            decode_pipeline=2,
             # CPU jit ignores buffer donation, so pool updates copy the whole
             # pool per step — keep it small there; TPU updates are in-place
             num_pages=None if on_tpu else 2048,

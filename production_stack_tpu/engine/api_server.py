@@ -29,6 +29,85 @@ from production_stack_tpu.utils.logging import init_logger
 logger = init_logger(__name__)
 
 
+async def _tag_stream(i, gen):
+    async for out in gen:
+        yield i, out
+
+
+async def _merge_streams(gens):
+    """Merge n RequestOutput streams into (choice_index, output) tuples,
+    preserving per-stream order."""
+    q: asyncio.Queue = asyncio.Queue()
+
+    async def pump(i, g):
+        try:
+            async for out in g:
+                await q.put((i, out))
+        except Exception as e:  # surface stream errors to the consumer
+            await q.put((i, e))
+        finally:
+            await q.put((i, None))
+
+    tasks = [asyncio.ensure_future(pump(i, g)) for i, g in enumerate(gens)]
+    try:
+        open_streams = len(gens)
+        while open_streams:
+            i, out = await q.get()
+            if out is None:
+                open_streams -= 1
+                continue
+            if isinstance(out, Exception):
+                raise out
+            yield i, out
+    finally:
+        for t in tasks:
+            t.cancel()
+
+
+def _chat_lp_content(tok, token_ids, entries):
+    """OpenAI chat logprobs format: choices[].logprobs.content[]."""
+    content = []
+    for tid, e in zip(token_ids, entries):
+        s = tok.decode([tid])
+        content.append({
+            "token": s,
+            "logprob": e["logprob"],
+            "bytes": list(s.encode("utf-8", errors="replace")),
+            "top_logprobs": [
+                {
+                    "token": tok.decode([i]),
+                    "logprob": lp,
+                    "bytes": list(tok.decode([i]).encode("utf-8", errors="replace")),
+                }
+                for i, lp in zip(e["top_ids"], e["top_logprobs"])
+            ],
+        })
+    return content
+
+
+def _completion_lp(tok, token_ids, entries, offset0):
+    """OpenAI completions logprobs format; returns (dict, next_offset)."""
+    toks, tlps, tops, offs = [], [], [], []
+    off = offset0
+    for tid, e in zip(token_ids, entries):
+        s = tok.decode([tid])
+        toks.append(s)
+        tlps.append(e["logprob"])
+        top: dict = {}
+        for i, lp in zip(e["top_ids"], e["top_logprobs"]):
+            # distinct ids can decode to the same string (byte fragments);
+            # entries arrive best-first, so keep the first (highest) lp
+            top.setdefault(tok.decode([i]), lp)
+        tops.append(top)
+        offs.append(off)
+        off += len(s)
+    return (
+        {"tokens": toks, "token_logprobs": tlps, "top_logprobs": tops,
+         "text_offset": offs},
+        off,
+    )
+
+
 def _sampling_params(body: dict, default_max: int = 256) -> SamplingParams:
     stop = body.get("stop") or []
     if isinstance(stop, str):
@@ -41,6 +120,9 @@ def _sampling_params(body: dict, default_max: int = 256) -> SamplingParams:
         stop=list(stop),
         ignore_eos=bool(body.get("ignore_eos", False)),
         seed=body.get("seed"),
+        presence_penalty=float(body.get("presence_penalty", 0.0)),
+        frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+        repetition_penalty=float(body.get("repetition_penalty", 1.0)),
     )
 
 
@@ -170,6 +252,41 @@ class EngineServer:
                 )
         req_id = request.headers.get("X-Request-Id") or f"req-{uuid.uuid4().hex[:16]}"
         params = _sampling_params(body)
+        if not (-2.0 <= params.presence_penalty <= 2.0
+                and -2.0 <= params.frequency_penalty <= 2.0
+                and params.repetition_penalty > 0):
+            return web.json_response(
+                {"error": {"message": "penalties out of range: presence/frequency in [-2, 2], repetition > 0"}},
+                status=400,
+            )
+        if params.wants_penalties and self.cfg.speculative_k:
+            return web.json_response(
+                {"error": {"message": "sampling penalties are not supported with speculative decoding"}},
+                status=400,
+            )
+        # logprobs: completions takes an int (top count), chat takes
+        # logprobs=true + top_logprobs=N; the chosen token's logprob is
+        # always included when enabled
+        lp_count = None
+        if chat:
+            if body.get("logprobs"):
+                lp_count = int(body.get("top_logprobs") or 0)
+        elif body.get("logprobs") is not None:
+            lp_count = int(body["logprobs"])
+        if lp_count is not None:
+            from production_stack_tpu.ops.sampling import TOP_LOGPROBS
+
+            if not 0 <= lp_count <= TOP_LOGPROBS:
+                return web.json_response(
+                    {"error": {"message": f"logprobs must be in [0, {TOP_LOGPROBS}]"}},
+                    status=400,
+                )
+            if self.cfg.speculative_k:
+                return web.json_response(
+                    {"error": {"message": "logprobs are not supported with speculative decoding"}},
+                    status=400,
+                )
+            params.logprobs = lp_count
         stream = bool(body.get("stream", False))
         created = int(time.time())
         kind = "chat.completion" if chat else "text_completion"
@@ -190,34 +307,105 @@ class EngineServer:
                 },
                 status=400,
             )
-        gen = self.engine.generate(
-            req_id, prompt_token_ids=prompt_ids, params=params, lora_name=lora_name
-        )
+        n = 1 if body.get("n") is None else int(body["n"])
+        best_of = n if body.get("best_of") is None else int(body["best_of"])
+        if not 1 <= n <= 64 or best_of != n:
+            return web.json_response(
+                {"error": {"message": f"n must be in [1, 64] and best_of == n, got n={n} best_of={best_of}"}},
+                status=400,
+            )
+        # n parallel samples: one engine sequence per choice. Sub-sequences
+        # get '#i'-suffixed ids (plain req_id when n == 1 so request tracing
+        # and the reference-format routing logs stay stable). Siblings launch
+        # AFTER choice 0's prefill completes: the scheduler registers the
+        # prompt's pages in the prefix cache at that point, so siblings share
+        # the prompt KV instead of re-prefilling it n times.
+        sub_ids = [req_id] if n == 1 else [f"{req_id}#{i}" for i in range(n)]
+
+        def _gen(sid):
+            return self.engine.generate(
+                sid, prompt_token_ids=prompt_ids, params=params, lora_name=lora_name
+            )
+
+        if n == 1:
+            gens = [_gen(sub_ids[0])]
+        else:
+            prefilled = asyncio.Event()
+
+            async def first(sid):
+                try:
+                    async for out in _gen(sid):
+                        prefilled.set()
+                        yield out
+                finally:
+                    prefilled.set()  # error/abort must not wedge siblings
+
+            async def sibling(sid):
+                await prefilled.wait()
+                async for out in _gen(sid):
+                    yield out
+
+            gens = [first(sub_ids[0])] + [sibling(sid) for sid in sub_ids[1:]]
+        gen = gens[0]
 
         if not stream:
-            text, finish_reason, last = [], None, None
-            async for out in gen:
-                text.append(out.text_delta)
-                last = out
-                if out.finished:
-                    finish_reason = out.finish_reason
-            full = "".join(text)
-            if chat:
-                choice = {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": full},
-                    "finish_reason": finish_reason,
-                }
-            else:
-                choice = {"index": 0, "text": full, "finish_reason": finish_reason}
+            async def collect(i, g):
+                text, finish_reason, last = [], None, None
+                tok_ids, lp_entries = [], []
+                async for out in g:
+                    text.append(out.text_delta)
+                    last = out
+                    if out.logprobs is not None:
+                        tok_ids.extend(out.token_ids)
+                        lp_entries.extend(out.logprobs)
+                    if out.finished:
+                        finish_reason = out.finish_reason
+                return i, "".join(text), finish_reason, last, tok_ids, lp_entries
+
+            try:
+                results = await asyncio.gather(
+                    *(collect(i, g) for i, g in enumerate(gens))
+                )
+            except asyncio.CancelledError:
+                for sid in sub_ids:
+                    self.engine.abort(sid)
+                raise
+            choices, lasts = [], []
+            for i, full, finish_reason, last, tok_ids, lp_entries in results:
+                lasts.append(last)
+                lp_obj = None
+                if lp_count is not None:
+                    if chat:
+                        lp_obj = {"content": _chat_lp_content(
+                            self.engine.tokenizer, tok_ids, lp_entries)}
+                    else:
+                        lp_obj, _ = _completion_lp(
+                            self.engine.tokenizer, tok_ids, lp_entries, 0)
+                if chat:
+                    choices.append({
+                        "index": i,
+                        "message": {"role": "assistant", "content": full},
+                        "logprobs": lp_obj,
+                        "finish_reason": finish_reason,
+                    })
+                else:
+                    choices.append({"index": i, "text": full, "logprobs": lp_obj,
+                                    "finish_reason": finish_reason})
+            usage = _usage(lasts[0]) if lasts[0] else {}
+            if usage and len(lasts) > 1:
+                # prompt counted once; completion tokens summed over choices
+                usage["completion_tokens"] = sum(
+                    (_usage(l) or {}).get("completion_tokens", 0) for l in lasts if l
+                )
+                usage["total_tokens"] = usage["prompt_tokens"] + usage["completion_tokens"]
             return web.json_response(
                 {
                     "id": oid,
                     "object": kind,
                     "created": created,
                     "model": model,
-                    "choices": [choice],
-                    "usage": _usage(last) if last else {},
+                    "choices": choices,
+                    "usage": usage,
                 },
                 headers={"X-Request-Id": req_id},
             )
@@ -236,22 +424,38 @@ class EngineServer:
             await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
 
         if chat:
-            await send(
-                {
-                    "id": oid, "object": "chat.completion.chunk", "created": created,
-                    "model": model,
-                    "choices": [{"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}],
-                }
-            )
-        last = None
+            for i in range(n):
+                await send(
+                    {
+                        "id": oid, "object": "chat.completion.chunk", "created": created,
+                        "model": model,
+                        "choices": [{"index": i, "delta": {"role": "assistant"}, "finish_reason": None}],
+                    }
+                )
+        lasts: list = [None] * n
         try:
-            async for out in gen:
-                last = out
+            if n == 1:
+                merged = _tag_stream(0, gen)
+            else:
+                merged = _merge_streams(gens)
+            lp_offsets = [0] * n
+            async for i, out in merged:
+                lasts[i] = out
                 if out.text_delta or out.finished:
+                    lp_obj = None
+                    if lp_count is not None and out.logprobs is not None:
+                        if chat:
+                            lp_obj = {"content": _chat_lp_content(
+                                self.engine.tokenizer, out.token_ids, out.logprobs)}
+                        else:
+                            lp_obj, lp_offsets[i] = _completion_lp(
+                                self.engine.tokenizer, out.token_ids,
+                                out.logprobs, lp_offsets[i])
                     if chat:
                         choice = {
-                            "index": 0,
+                            "index": i,
                             "delta": {"content": out.text_delta} if out.text_delta else {},
+                            "logprobs": lp_obj,
                             "finish_reason": out.finish_reason,
                         }
                         await send(
@@ -267,23 +471,31 @@ class EngineServer:
                                 "model": model,
                                 "choices": [
                                     {
-                                        "index": 0, "text": out.text_delta,
+                                        "index": i, "text": out.text_delta,
+                                        "logprobs": lp_obj,
                                         "finish_reason": out.finish_reason,
                                     }
                                 ],
                             }
                         )
-            if last is not None:
+            if lasts[0] is not None:
+                usage = _usage(lasts[0])
+                if n > 1:
+                    usage["completion_tokens"] = sum(
+                        (_usage(l) or {}).get("completion_tokens", 0) for l in lasts if l
+                    )
+                    usage["total_tokens"] = usage["prompt_tokens"] + usage["completion_tokens"]
                 await send(
                     {
                         "id": oid, "object": f"{kind}.chunk" if chat else kind,
                         "created": created, "model": model, "choices": [],
-                        "usage": _usage(last),
+                        "usage": usage,
                     }
                 )
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
-            self.engine.abort(req_id)
+            for sid in sub_ids:
+                self.engine.abort(sid)
             raise
         await resp.write_eof()
         return resp
@@ -522,7 +734,85 @@ class EngineServer:
         return app
 
 
+def _resolve_process_id(cfg: EngineConfig) -> int:
+    """Process id for multi-host serving: explicit flag, else JAX_PROCESS_ID,
+    else the StatefulSet hostname ordinal (``engine-llama3-2`` -> 2)."""
+    import os
+    import socket as socket_mod
+
+    if cfg.distributed_process_id is not None:
+        return int(cfg.distributed_process_id)
+    if os.environ.get("JAX_PROCESS_ID"):
+        return int(os.environ["JAX_PROCESS_ID"])
+    host = socket_mod.gethostname()
+    tail = host.rsplit("-", 1)[-1]
+    if not tail.isdigit():
+        raise ValueError(
+            f"cannot derive process id from hostname {host!r}; set "
+            "--distributed-process-id or JAX_PROCESS_ID"
+        )
+    return int(tail)
+
+
+def _init_multihost(cfg: EngineConfig) -> int:
+    """Rendezvous the JAX multi-controller runtime (the reference's Ray
+    cluster + EXPECTED_NODES barrier, ray-cluster.yaml:46-47 — replaced by
+    jax.distributed's coordination service). Returns this process's id."""
+    import jax
+
+    if not cfg.distributed_coordinator:
+        raise ValueError(
+            "--distributed-num-processes > 1 requires --distributed-coordinator"
+        )
+    if cfg.kv_offload_cpu_gb > 0 or cfg.kv_offload_dir or cfg.kv_remote_url:
+        raise ValueError("KV offload tiers are not supported in multi-host mode")
+    if cfg.enable_sleep_mode:
+        raise ValueError("sleep mode is not supported in multi-host mode")
+    if cfg.enable_lora:
+        raise ValueError("LoRA serving is not supported in multi-host mode yet")
+    if cfg.kv_role != "none":
+        raise ValueError("disaggregated prefill is not supported in multi-host mode")
+    pid = _resolve_process_id(cfg)
+    logger.info(
+        "multi-host init: process %d/%d, coordinator %s",
+        pid, cfg.distributed_num_processes, cfg.distributed_coordinator,
+    )
+    jax.distributed.initialize(
+        coordinator_address=cfg.distributed_coordinator,
+        num_processes=cfg.distributed_num_processes,
+        process_id=pid,
+    )
+    return pid
+
+
 async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
+    if cfg.distributed_num_processes > 1 and engine is None:
+        from production_stack_tpu.engine.distributed import (
+            BroadcastingRunner,
+            StepBroadcaster,
+            follower_loop,
+        )
+
+        pid = _init_multihost(cfg)
+        if pid != 0:
+            # follower: identical construction (same model, mesh, pools,
+            # seed), then replay the leader's device dispatches forever.
+            # This call BLOCKS until the leader shuts down.
+            engine = LLMEngine(cfg)
+            leader_host = cfg.distributed_coordinator.rsplit(":", 1)[0]
+            await asyncio.get_event_loop().run_in_executor(
+                None,
+                follower_loop,
+                engine.runner,
+                leader_host,
+                cfg.worker_sync_port,
+            )
+            raise SystemExit(0)
+        engine = LLMEngine(cfg)
+        bc = StepBroadcaster(
+            cfg.worker_sync_port, cfg.distributed_num_processes - 1
+        )
+        engine.runner = BroadcastingRunner(engine.runner, bc)
     server = EngineServer(cfg, engine)
     server.engine.start()
     app = server.build_app()

@@ -37,6 +37,9 @@ class EngineConfig:
     # chain of m bursts pays one fetch round trip instead of m (matters on
     # network-attached TPUs where a fetch costs ~compute-of-a-burst). Arrivals
     # during a chain wait up to (pipeline-1) extra bursts before prefill.
+    # Tradeoff: chaining doubles the decode program variants the engine
+    # compiles ((batch, pages) buckets x {chained, unchained}) — enable for
+    # long-lived serving pods, not for short benchmark windows.
     decode_pipeline: int = 1
     # speculative decoding (prompt-lookup/n-gram, fused on device): draft
     # length per round; 0 disables. The TPU-native analogue of vLLM's ngram
@@ -50,6 +53,14 @@ class EngineConfig:
     attn_impl: str = "auto"
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
+    # multi-host serving (StatefulSet choreography, tutorial 15): process 0
+    # serves HTTP and broadcasts device dispatches; others follow. The
+    # coordinator address doubles as the JAX rendezvous (replaces the
+    # reference's Ray cluster + EXPECTED_NODES barrier).
+    distributed_coordinator: Optional[str] = None   # host:port of process 0
+    distributed_num_processes: int = 1
+    distributed_process_id: Optional[int] = None    # default: hostname -N suffix
+    worker_sync_port: int = 8477
     enable_sleep_mode: bool = False
     seed: int = 0
     # multi-LoRA serving (reference: vLLM --enable-lora + load/unload endpoints,

@@ -1,0 +1,180 @@
+"""Multi-host serving: leader/follower device-dispatch replication.
+
+JAX's multi-controller runtime requires every process to dispatch the SAME
+jitted programs in the same order (each process drives its local chips; XLA
+collectives stitch them together over ICI/DCN). The reference gets multi-host
+execution from Ray (`ray-cluster.yaml` spins a cluster so vLLM can place
+pipeline stages; /root/reference helm/templates/ray-cluster.yaml:515-566).
+Here the JAX coordination service replaces Ray's GCS and a thin TCP fan-out
+replaces its task RPC:
+
+- Process 0 (leader) runs the real engine: HTTP API, scheduler, tokenizer,
+  prefix cache. Every device call (step/step_multi/...) is first broadcast —
+  method name + numpy args, length-prefixed pickle over TCP — to all
+  followers, then executed locally.
+- Processes 1..N-1 (followers) run ``follower_loop``: receive each descriptor
+  and invoke the identical method on their local ModelRunner. Same seed ⇒
+  same RNG splits ⇒ identical programs; XLA's collectives do the rest.
+- ``jax.distributed.initialize`` is the rendezvous barrier — the analogue of
+  the reference's ``EXPECTED_NODES`` wait loop (ray-cluster.yaml:46-47).
+
+Sampled tokens are replicated across processes (the step functions constrain
+their outputs to a fully-replicated sharding), so the leader's host fetch
+sees the whole batch without extra collectives.
+
+Failure model: K8s restarts the whole StatefulSet on any pod failure — a
+multi-controller JAX program cannot survive losing a process, which matches
+the reference's Ray-cluster behavior (head restart ⇒ full redeploy).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Optional
+
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+_LEN = struct.Struct("!Q")
+
+# runner methods replicated to followers. get_page is deliberately absent:
+# host fetches are leader-local (each process can only address its own
+# shards), so KV offload tiers are unsupported in multi-host mode.
+REPLICATED = (
+    "step",
+    "step_multi",
+    "step_multi_pipelined",
+    "step_spec",
+    "encode",
+    "set_lora_slot",
+    "clear_lora_slot",
+    "set_page",
+    "reset_kv",
+)
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[bytes]:
+    hdr = b""
+    while len(hdr) < _LEN.size:
+        chunk = sock.recv(_LEN.size - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = _LEN.unpack(hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class StepBroadcaster:
+    """Leader side: accepts follower connections, fans out call descriptors.
+
+    The constructor blocks until all ``num_followers`` have connected — by
+    then ``jax.distributed.initialize`` has already barriered, so followers
+    are guaranteed to be dialing.
+    """
+
+    def __init__(self, port: int, num_followers: int, *, timeout: float = 300.0):
+        self._lock = threading.Lock()
+        self._socks: list[socket.socket] = []
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("0.0.0.0", port))
+        srv.listen(num_followers)
+        srv.settimeout(timeout)
+        logger.info("leader waiting for %d follower(s) on :%d", num_followers, port)
+        for _ in range(num_followers):
+            conn, addr = srv.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(conn)
+            logger.info("follower connected from %s", addr)
+        srv.close()
+
+    def broadcast(self, method: str, args: tuple, kwargs: dict) -> None:
+        payload = pickle.dumps((method, args, kwargs), protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            for s in self._socks:
+                _send_msg(s, payload)
+
+    def close(self) -> None:
+        with self._lock:
+            for s in self._socks:
+                try:
+                    _send_msg(s, pickle.dumps(None))
+                    s.close()
+                except OSError:
+                    pass
+            self._socks.clear()
+
+
+class BroadcastingRunner:
+    """Wraps a ModelRunner: replicated methods broadcast before local dispatch.
+
+    Host-side return values come from the local call — outputs are
+    replicated-sharded by the step functions, so the leader's fetch sees the
+    global batch.
+    """
+
+    def __init__(self, runner, broadcaster: StepBroadcaster):
+        self._runner = runner
+        self._bc = broadcaster
+
+    def __getattr__(self, name):
+        attr = getattr(self._runner, name)
+        if name not in REPLICATED or not callable(attr):
+            return attr
+
+        def call(*args, **kwargs):
+            self._bc.broadcast(name, args, kwargs)
+            return attr(*args, **kwargs)
+
+        return call
+
+
+def follower_loop(runner, leader_host: str, port: int, *, timeout: float = 300.0) -> None:
+    """Follower side: dial the leader and replay every call descriptor on the
+    local runner until the leader closes the stream.
+
+    Connection attempts retry until ``timeout``: engine construction time
+    varies across pods (checkpoint load), so a follower may be ready to dial
+    before the leader has bound the sync port — a refused connect is
+    expected startup noise, not an error."""
+    import time as time_mod
+
+    deadline = time_mod.monotonic() + timeout
+    while True:
+        try:
+            sock = socket.create_connection((leader_host, port), timeout=timeout)
+            break
+        except (ConnectionRefusedError, OSError):
+            if time_mod.monotonic() >= deadline:
+                raise
+            time_mod.sleep(1.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+    logger.info("follower connected to leader %s:%d", leader_host, port)
+    while True:
+        payload = _recv_msg(sock)
+        if payload is None:
+            logger.info("leader stream closed; follower exiting")
+            return
+        msg = pickle.loads(payload)
+        if msg is None:
+            logger.info("leader shutdown; follower exiting")
+            return
+        method, args, kwargs = msg
+        if method not in REPLICATED:
+            raise RuntimeError(f"follower received non-replicated method {method!r}")
+        getattr(runner, method)(*args, **kwargs)

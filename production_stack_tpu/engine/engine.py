@@ -40,6 +40,9 @@ class RequestOutput:
     prompt_tokens: int = 0
     completion_tokens: int = 0
     cached_tokens: int = 0
+    # per-token logprob entries aligned with token_ids (when requested):
+    # {"logprob": float, "top_ids": [int], "top_logprobs": [float]}
+    logprobs: Optional[list] = None
 
 
 class LLMEngine:
@@ -111,6 +114,7 @@ class LLMEngine:
             prefill_chunk=cfg.prefill_chunk if cfg.enable_chunked_prefill else 10**9,
             prefill_batch=cfg.prefill_batch,
             enable_prefix_caching=cfg.enable_prefix_caching,
+            batch_multiple=cfg.data_parallel_size,
             decode_steps=cfg.decode_steps,
             decode_pipeline=cfg.decode_pipeline,
             spec_k=cfg.speculative_k,
@@ -288,13 +292,36 @@ class LLMEngine:
             if batch is None:
                 continue
             fetched = True
+            lp_data = None  # (chosen [B, cols], top_ids, top_lp [B, cols, K])
             try:
                 inp = StepInput(
                     batch.input_ids, batch.positions, batch.page_table,
                     batch.kv_lens, batch.temperature, batch.top_k, batch.top_p,
                     lora_ids=batch.lora_ids, kv_limits=batch.kv_limits,
                 )
-                if batch.kind == "decode" and batch.history is not None:
+                if batch.want_penalties:
+                    inp.history = batch.history
+                    inp.prompt_lens = batch.prompt_lens
+                    inp.presence = np.array(
+                        [s.params.presence_penalty for s in batch.seqs]
+                        + [0.0] * (len(batch.kv_lens) - len(batch.seqs)),
+                        np.float32,
+                    )
+                    inp.frequency = np.array(
+                        [s.params.frequency_penalty for s in batch.seqs]
+                        + [0.0] * (len(batch.kv_lens) - len(batch.seqs)),
+                        np.float32,
+                    )
+                    inp.repetition = np.array(
+                        [s.params.repetition_penalty for s in batch.seqs]
+                        + [1.0] * (len(batch.kv_lens) - len(batch.seqs)),
+                        np.float32,
+                    )
+                if (
+                    batch.kind == "decode"
+                    and self.scheduler.spec_k
+                    and batch.history is not None
+                ):
                     tokens = np.asarray(
                         self.runner.step_spec(
                             inp, batch.history, self.scheduler.decode_steps,
@@ -307,6 +334,7 @@ class LLMEngine:
                     # each round emits its accepted drafts plus one bonus token
                     self.spec_accepted_tokens += int(emitted.sum()) - rounds
                 elif batch.kind == "decode" and self.scheduler.decode_steps > 1:
+                    wlp = batch.want_logprobs
                     if batch.bursts > 1:
                         # chained bursts: all dispatches go out before any
                         # fetch, so the chain costs bursts*compute + 1 round
@@ -315,11 +343,28 @@ class LLMEngine:
                         # must not happen while a later burst could still be
                         # writing to them.
                         devs = self.runner.step_multi_pipelined(
-                            inp, self.scheduler.decode_steps, batch.bursts
+                            inp, self.scheduler.decode_steps, batch.bursts, wlp
                         )
-                        tokens = np.concatenate(
-                            [np.asarray(d) for d in devs], axis=1
-                        )  # [B, bursts*k]
+                        if wlp:
+                            tokens = np.concatenate(
+                                [np.asarray(d[0]) for d in devs], axis=1
+                            )
+                            lp_data = tuple(
+                                np.concatenate(
+                                    [np.asarray(d[1][x]) for d in devs], axis=1
+                                )
+                                for x in range(3)
+                            )
+                        else:
+                            tokens = np.concatenate(
+                                [np.asarray(d) for d in devs], axis=1
+                            )  # [B, bursts*k]
+                    elif wlp:
+                        toks, lps = self.runner.step_multi(
+                            inp, self.scheduler.decode_steps, True
+                        )
+                        tokens = np.asarray(toks)
+                        lp_data = tuple(np.asarray(x) for x in lps)
                     else:
                         tokens = np.asarray(
                             self.runner.step_multi(inp, self.scheduler.decode_steps)
@@ -341,6 +386,10 @@ class LLMEngine:
                     self._unfetched.append(batch)
                     fetched = False
                     tokens = np.full((len(batch.seqs),), -1, np.int32)
+                elif batch.want_logprobs:
+                    ids, _, lps = self.runner.step(inp, want_logprobs=True)
+                    tokens = np.asarray(ids)
+                    lp_data = tuple(np.asarray(x)[:, None] for x in lps)
                 else:
                     ids, _ = self.runner.step(inp)
                     tokens = np.asarray(ids)
@@ -369,19 +418,27 @@ class LLMEngine:
                 # ship KV before emitting the finish event: the prefill HTTP
                 # response must not return until the decode peer holds the KV
                 pushed = set()
-                for s, _ in events:
+                for s, _, _, _ in events:
                     if s.finished and s.seq_id not in pushed:
                         pushed.add(s.seq_id)
                         self._push_finished_kv(s)
             # group burst events per sequence: one RequestOutput per seq per
             # device step, carrying every new token (finished only on the
             # last, so consumers never drop trailing burst tokens)
-            grouped: dict[str, tuple[Sequence, list[int]]] = {}
-            for s, tok in events:
-                grouped.setdefault(s.seq_id, (s, []))[1].append(tok)
-            for s, toks in grouped.values():
+            grouped: dict[str, tuple[Sequence, list[int], list]] = {}
+            for s, tok, i, j in events:
+                g = grouped.setdefault(s.seq_id, (s, [], []))
+                g[1].append(tok)
+                if lp_data is not None and s.params.logprobs is not None:
+                    n = min(s.params.logprobs, lp_data[1].shape[2])
+                    g[2].append({
+                        "logprob": float(lp_data[0][i, j]),
+                        "top_ids": lp_data[1][i, j, :n].tolist(),
+                        "top_logprobs": lp_data[2][i, j, :n].tolist(),
+                    })
+            for s, toks, lps in grouped.values():
                 self.total_generation_tokens += len(toks)
-                self._process_token(s, toks)
+                self._process_token(s, toks, lps or None)
         logger.info("engine loop exited")
 
     def _push_finished_kv(self, seq: Sequence) -> None:
@@ -415,10 +472,13 @@ class LLMEngine:
 
         return get_serde(self.cfg.kv_serde)
 
-    def _process_token(self, seq: Sequence, new_tokens: list[int]) -> None:
+    def _process_token(
+        self, seq: Sequence, new_tokens: list[int], logprobs: Optional[list] = None
+    ) -> None:
         """Detokenize incrementally, check stop strings, emit the delta (with
-        this step's new tokens — one or a whole decode burst)."""
-        full = self.tokenizer.decode(seq.output_ids)
+        this step's new tokens — one or a whole decode burst; ``logprobs``
+        aligns 1:1 with ``new_tokens`` when requested)."""
+        raw = full = self.tokenizer.decode(seq.output_ids)
         if not seq.finished and full.endswith("�"):
             # hold back a trailing incomplete byte sequence (renders as
             # replacement chars) until later tokens complete it — emitting it
@@ -428,7 +488,6 @@ class LLMEngine:
             full = full.rstrip("�")
         prev = self._texts.get(seq.seq_id, "")
         delta = full[len(prev):] if full.startswith(prev) else full
-        raw = self.tokenizer.decode(seq.output_ids)
         if seq.params.stop and any(s in raw for s in seq.params.stop):
             # Stop detection must not depend on emission boundaries (per-token
             # vs burst vs chained bursts give the same stream): scan this
@@ -456,6 +515,8 @@ class LLMEngine:
                 # the loop already counted the whole burst
                 self.total_generation_tokens -= len(new_tokens) - keep
                 new_tokens = new_tokens[:keep]
+                if logprobs is not None:
+                    logprobs = logprobs[:keep]
                 if not seq.finished:
                     self.scheduler._finish(seq, "stop")
                 elif seq.finish_reason == "length":
@@ -464,7 +525,7 @@ class LLMEngine:
                     seq.finish_reason = "stop"
         with self._lock:
             self._texts[seq.seq_id] = prev + delta
-        self._emit(seq, delta, tokens=new_tokens)
+        self._emit(seq, delta, tokens=new_tokens, logprobs=logprobs)
 
     def _emit(
         self,
@@ -472,6 +533,7 @@ class LLMEngine:
         delta: str,
         tokens: Optional[list[int]] = None,
         error: bool = False,
+        logprobs: Optional[list] = None,
     ) -> None:
         with self._lock:
             entry = self._outputs.get(seq.seq_id)
@@ -491,6 +553,7 @@ class LLMEngine:
             prompt_tokens=len(seq.prompt_ids),
             completion_tokens=len(seq.output_ids),
             cached_tokens=seq.num_cached,
+            logprobs=logprobs,
         )
         loop.call_soon_threadsafe(out_q.put_nowait, out)
 

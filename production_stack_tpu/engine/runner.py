@@ -22,7 +22,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from production_stack_tpu import models
-from production_stack_tpu.ops.sampling import sample
+from production_stack_tpu.ops.sampling import (
+    apply_penalties,
+    sample,
+    sample_with_logprobs,
+)
 from production_stack_tpu.parallel import shardings
 from production_stack_tpu.parallel.mesh import make_mesh
 
@@ -40,6 +44,12 @@ class StepInput:
     top_p: Any          # [B] float32
     lora_ids: Any = None  # [B] int32 adapter slot (0 = base); None when LoRA off
     kv_limits: Any = None  # [B] int32 max kv_len (multi-step decode bound)
+    # sampling penalties (set together when any row has penalties):
+    history: Any = None      # [B, H] int32 prompt+output ids, position-indexed
+    prompt_lens: Any = None  # [B] int32
+    presence: Any = None     # [B] f32
+    frequency: Any = None    # [B] f32
+    repetition: Any = None   # [B] f32
 
 
 class ModelRunner:
@@ -109,13 +119,15 @@ class ModelRunner:
 
         self._row_sh = NamedSharding(self.mesh, shardings.BATCH_SPECS["input_ids"])
         self._vec_sh = NamedSharding(self.mesh, shardings.BATCH_SPECS["kv_lens"])
-        self._step = jax.jit(
-            functools.partial(_step_fn, self.module.forward, cfg),
-            donate_argnums=(1, 2),
-        )
+        # sampled tokens come back fully replicated so the leader process can
+        # fetch the whole batch in multi-host serving (each process can only
+        # address its own shards); logits/pools keep their compiler-chosen or
+        # donated layouts.
+        self._rep = NamedSharding(self.mesh, P())
+        self._steps: dict[bool, Any] = {}  # want_logprobs -> jitted step
         self._set_page_fn = None  # built lazily in set_page
         self._encode = None       # built lazily in encode (pooled embeddings)
-        self._multi_steps: dict[int, Any] = {}  # k -> jitted k-step decode
+        self._multi_steps: dict[tuple, Any] = {}  # (k, want_lp) -> jitted decode
         self._spec_fns: dict[tuple, Any] = {}   # (steps, k, n) -> jitted spec decode
 
     def _stage(self, inp: StepInput, with_limits: bool = False) -> dict:
@@ -161,31 +173,57 @@ class ModelRunner:
                 else np.full((B,), np.iinfo(np.int32).max // 2, np.int32)
             )
             staged["kv_limits"] = vec(limits, jnp.int32)
+        if inp.history is not None and inp.presence is not None:
+            staged["pen"] = (
+                row(inp.history, jnp.int32),
+                vec(inp.prompt_lens, jnp.int32),
+                vec(inp.presence, jnp.float32),
+                vec(inp.frequency, jnp.float32),
+                vec(inp.repetition, jnp.float32),
+            )
         return staged
 
-    def step(self, inp: StepInput) -> tuple[jnp.ndarray, jnp.ndarray]:
-        """Run one forward+sample step. Returns (token_ids [B], logits [B, V])."""
+    def _get_step(self, want_lp: bool, want_pen: bool):
+        sig = (want_lp, want_pen)
+        if sig not in self._steps:
+            rep, n = self._rep, None
+            outs = (rep, n, rep, rep, rep, n, n) if want_lp else (rep, n, n, n)
+            self._steps[sig] = jax.jit(
+                functools.partial(
+                    _step_fn, self.module.forward, self.cfg, want_lp, want_pen
+                ),
+                donate_argnums=(1, 2),
+                out_shardings=outs,
+            )
+        return self._steps[sig]
+
+    def step(self, inp: StepInput, want_logprobs: bool = False):
+        """Run one forward+sample step. Returns (token_ids [B], logits [B, V])
+        or, with ``want_logprobs``, (ids, logits, (chosen_lp [B],
+        top_ids [B, K], top_lp [B, K]))."""
         s = self._stage(inp)
-        ids, logits, self.k_pages, self.v_pages = self._step(
-            self.params,
-            self.k_pages,
-            self.v_pages,
-            s["input_ids"],
-            s["positions"],
-            s["page_table"],
-            s["kv_lens"],
-            s["temperature"],
-            s["top_k"],
-            s["top_p"],
-            s["key"],
-            self.lora,
-            s["lora_ids"],
+        want_pen = "pen" in s
+        args = (
+            self.params, self.k_pages, self.v_pages,
+            s["input_ids"], s["positions"], s["page_table"], s["kv_lens"],
+            s["temperature"], s["top_k"], s["top_p"], s["key"],
+            self.lora, s["lora_ids"], s.get("pen"),
+        )
+        if want_logprobs:
+            ids, logits, lp, tids, tlp, self.k_pages, self.v_pages = (
+                self._get_step(True, want_pen)(*args)
+            )
+            return ids, logits, (lp, tids, tlp)
+        ids, logits, self.k_pages, self.v_pages = (
+            self._get_step(False, want_pen)(*args)
         )
         return ids, logits
 
-    def step_multi(self, inp: StepInput, k: int) -> jnp.ndarray:
+    def step_multi(self, inp: StepInput, k: int, want_logprobs: bool = False):
         """Run k fused decode steps in ONE device program (lax.scan feeding
-        each sampled token back as the next input). Returns tokens [B, k].
+        each sampled token back as the next input). Returns tokens [B, k] —
+        or (tokens, (chosen_lp [B, k], top_ids [B, k, K], top_lp [B, k, K]))
+        with ``want_logprobs``.
 
         Why: on serving hosts every dispatch pays host<->device latency (and
         per-call device_puts); at decode, compute per step is a few ms, so the
@@ -196,33 +234,49 @@ class ModelRunner:
         drop and attention masks, and the host discards their surplus tokens.
         """
         if k == 1:
+            if want_logprobs:
+                ids, _, lps = self.step(inp, want_logprobs=True)
+                lp, tids, tlp = lps
+                return jnp.asarray(ids)[:, None], (
+                    jnp.asarray(lp)[:, None],
+                    jnp.asarray(tids)[:, None],
+                    jnp.asarray(tlp)[:, None],
+                )
             ids, _ = self.step(inp)
             return jnp.asarray(ids)[:, None]
-        if k not in self._multi_steps:
-            self._multi_steps[k] = jax.jit(
-                functools.partial(_multi_step_fn, self.module.forward, self.cfg, k),
-                donate_argnums=(1, 2),
-            )
         s = self._stage(inp, with_limits=True)
-        toks, self.k_pages, self.v_pages = self._multi_steps[k](
-            self.params,
-            self.k_pages,
-            self.v_pages,
-            s["input_ids"],
-            s["positions"],
-            s["page_table"],
-            s["kv_lens"],
-            s["kv_limits"],
-            s["temperature"],
-            s["top_k"],
-            s["top_p"],
-            s["key"],
-            self.lora,
-            s["lora_ids"],
+        want_pen = "pen" in s
+        sig = (k, want_logprobs, want_pen)
+        if sig not in self._multi_steps:
+            rep, n = self._rep, None
+            outs = (
+                (rep, rep, rep, rep, n, n) if want_logprobs else (rep, n, n)
+            )
+            self._multi_steps[sig] = jax.jit(
+                functools.partial(
+                    _multi_step_fn, self.module.forward, self.cfg, k,
+                    want_logprobs, want_pen,
+                ),
+                donate_argnums=(1, 2),
+                out_shardings=outs,
+            )
+        args = (
+            self.params, self.k_pages, self.v_pages,
+            s["input_ids"], s["positions"], s["page_table"], s["kv_lens"],
+            s["kv_limits"], s["temperature"], s["top_k"], s["top_p"], s["key"],
+            self.lora, s["lora_ids"], s.get("pen"),
         )
+        if want_logprobs:
+            toks, lp, tids, tlp, self.k_pages, self.v_pages = (
+                self._multi_steps[sig](*args)
+            )
+            return toks, (lp, tids, tlp)
+        toks, self.k_pages, self.v_pages = self._multi_steps[sig](*args)
         return toks
 
-    def step_multi_pipelined(self, inp: StepInput, k: int, bursts: int) -> list:
+    def step_multi_pipelined(
+        self, inp: StepInput, k: int, bursts: int, want_logprobs: bool = False
+    ) -> list:
         """Dispatch ``bursts`` chained k-step decode bursts WITHOUT fetching
         between them; returns the per-burst device token arrays ([B, k] each).
 
@@ -241,15 +295,16 @@ class ModelRunner:
         bursts*k budget (scheduler plans this).
         """
         if bursts <= 1:
-            return [self.step_multi(inp, k)]
+            return [self.step_multi(inp, k, want_logprobs)]
         pos = np.asarray(inp.positions, np.int64)[:, 0].copy()
         lens = np.asarray(inp.kv_lens, np.int64).copy()
         limits = np.asarray(inp.kv_limits, np.int64)
         outs = []
         cur = inp
         for j in range(bursts):
-            toks = self.step_multi(cur, k)
-            outs.append(toks)
+            res = self.step_multi(cur, k, want_logprobs)
+            toks = res[0] if want_logprobs else res
+            outs.append(res)
             if j == bursts - 1:
                 break
             for _ in range(k):  # exact mirror of the device scan
@@ -294,6 +349,7 @@ class ModelRunner:
                     _spec_fn, self.module.forward, self.cfg, steps, spec_k, ngram
                 ),
                 donate_argnums=(1, 2),
+                out_shardings=(self._rep, None, None),
             )
         s = self._stage(inp, with_limits=True)
         hist = jax.device_put(jnp.asarray(history, jnp.int32), self._row_sh) \
@@ -327,7 +383,8 @@ class ModelRunner:
                     f"{self.module.__name__.rsplit('.', 1)[-1]!r}"
                 )
             self._encode = jax.jit(
-                functools.partial(self.module.encode, cfg=self.cfg)
+                functools.partial(self.module.encode, cfg=self.cfg),
+                out_shardings=self._rep,
             )
         row = lambda x: jax.device_put(jnp.asarray(x, jnp.int32), self._row_sh)
         return self._encode(
@@ -394,9 +451,10 @@ class ModelRunner:
         self.v_pages = jax.device_put(vp, kv_sh)
 
 
-def _multi_step_fn(forward, cfg, k, params, k_pages, v_pages, input_ids,
-                   positions, page_table, kv_lens, kv_limits, temperature,
-                   top_k, top_p, key, lora=None, lora_ids=None):
+def _multi_step_fn(forward, cfg, k, want_lp, want_pen, params, k_pages,
+                   v_pages, input_ids, positions, page_table, kv_lens,
+                   kv_limits, temperature, top_k, top_p, key, lora=None,
+                   lora_ids=None, pen=None):
     """k fused decode steps; see ModelRunner.step_multi. input_ids/positions
     are [B, 1] (decode shape).
 
@@ -414,23 +472,48 @@ def _multi_step_fn(forward, cfg, k, params, k_pages, v_pages, input_ids,
     local_pt = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P)
     kw = {} if lora is None else {"lora": lora, "lora_ids": lora_ids}
     keys = jax.random.split(key, k)
+    if want_pen:
+        hist0, plens, pres, freq, rep = pen
+        H = hist0.shape[1]
+        rows = jnp.arange(hist0.shape[0], dtype=jnp.int32)
+    else:
+        hist0 = jnp.zeros((input_ids.shape[0], 1), jnp.int32)  # inert carry
 
     def body(carry, key_i):
-        ids, pos, lens, kp, vp = carry
+        ids, pos, lens, kp, vp, hist = carry
         logits, kp, vp = forward(
             params, cfg, ids, pos, kp, vp, local_pt, lens, **kw
         )
-        nxt = sample(logits, key_i, temperature, top_k, top_p)  # [B]
+        sample_from = logits
+        if want_pen:
+            sample_from = apply_penalties(
+                logits.astype(jnp.float32), hist, lens, plens, pres, freq, rep
+            )
+        if want_lp:
+            nxt, lp, tids, tlp = sample_with_logprobs(
+                logits, key_i, temperature, top_k, top_p,
+                sample_from=sample_from,
+            )
+            emit = (nxt, lp, tids, tlp)
+        else:
+            nxt = sample(sample_from, key_i, temperature, top_k, top_p)  # [B]
+            emit = nxt
+        if want_pen:
+            # record this step's token at its absolute position so later
+            # steps in the burst count it
+            slot = jnp.where(pos[:, 0] >= 0, lens, H)
+            hist = hist.at[rows, slot].set(nxt, mode="drop")
         # a row continues while it was active this step and has budget left
         active = (pos[:, 0] >= 0) & (lens < kv_limits)
         pos = jnp.where(active, pos[:, 0] + 1, -1)[:, None]
         lens = lens + active.astype(lens.dtype)
         ids = jnp.where(active, nxt, 0)[:, None]
-        return (ids, pos, lens, kp, vp), nxt
+        return (ids, pos, lens, kp, vp, hist), emit
 
-    (_, _, lens_f, k_blk, v_blk), toks = jax.lax.scan(
-        body, (input_ids, positions, kv_lens, k_blk, v_blk), keys
+    (_, _, lens_f, k_blk, v_blk, _), emitted = jax.lax.scan(
+        body, (input_ids, positions, kv_lens, k_blk, v_blk, hist0), keys
     )
+    toks = emitted[0] if want_lp else emitted
     # scatter back only the logical pages the burst wrote
     # ([(lens0-1)//page, (lens_f-1)//page] per row): those are uniquely owned
     # by each row, so no duplicate indices; everything else in the block is an
@@ -443,6 +526,10 @@ def _multi_step_fn(forward, cfg, k, params, k_pages, v_pages, input_ids,
     safe = jnp.where(written, page_table, pool_pages).reshape(-1)
     k_pages = k_pages.at[:, safe].set(k_blk, mode="drop")
     v_pages = v_pages.at[:, safe].set(v_blk, mode="drop")
+    if want_lp:
+        _, lp, tids, tlp = emitted  # [k, B], [k, B, K]
+        return (toks.T, lp.T, jnp.swapaxes(tids, 0, 1),
+                jnp.swapaxes(tlp, 0, 1), k_pages, v_pages)
     return toks.T, k_pages, v_pages  # [B, k]
 
 
@@ -550,13 +637,25 @@ def _spec_fn(forward, cfg, steps, k, n, params, k_pages, v_pages, history,
     return jnp.transpose(toks, (1, 0, 2)), k_pages, v_pages  # [B, steps, T]
 
 
-def _step_fn(forward, cfg, params, k_pages, v_pages, input_ids, positions,
-             page_table, kv_lens, temperature, top_k, top_p, key,
-             lora=None, lora_ids=None):
+def _step_fn(forward, cfg, want_lp, want_pen, params, k_pages, v_pages,
+             input_ids, positions, page_table, kv_lens, temperature, top_k,
+             top_p, key, lora=None, lora_ids=None, pen=None):
     kw = {} if lora is None else {"lora": lora, "lora_ids": lora_ids}
     logits, k_pages, v_pages = forward(
         params, cfg, input_ids, positions, k_pages, v_pages, page_table, kv_lens,
         **kw,
     )
-    ids = sample(logits, key, temperature, top_k, top_p)
+    sample_from = logits
+    if want_pen:
+        hist, plens, pres, freq, rep = pen
+        sample_from = apply_penalties(
+            logits.astype(jnp.float32), hist, kv_lens, plens, pres, freq, rep
+        )
+    if want_lp:
+        # logprobs report the RAW distribution; penalties shape the draw only
+        ids, lp, tids, tlp = sample_with_logprobs(
+            logits, key, temperature, top_k, top_p, sample_from=sample_from
+        )
+        return ids, logits, lp, tids, tlp, k_pages, v_pages
+    ids = sample(sample_from, key, temperature, top_k, top_p)
     return ids, logits, k_pages, v_pages

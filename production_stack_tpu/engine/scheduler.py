@@ -38,6 +38,22 @@ class SamplingParams:
     stop: list[str] = field(default_factory=list)
     ignore_eos: bool = False
     seed: Optional[int] = None
+    # top-logprob count to report per token (None = off; device computes a
+    # fixed TOP_LOGPROBS wide set, the host slices to this many)
+    logprobs: Optional[int] = None
+    # OpenAI penalties (0 = off) over generated tokens; vLLM repetition
+    # penalty (1 = off) over prompt + generated tokens
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+
+    @property
+    def wants_penalties(self) -> bool:
+        return (
+            self.presence_penalty != 0.0
+            or self.frequency_penalty != 0.0
+            or self.repetition_penalty != 1.0
+        )
 
 
 @dataclass
@@ -84,6 +100,12 @@ class ScheduledBatch:
     chunk_sizes: list[int] = field(default_factory=list)
     # chained decode bursts this dispatch covers (runner.step_multi_pipelined)
     bursts: int = 1
+    # any sequence in the batch wants per-token logprobs
+    want_logprobs: bool = False
+    # any sequence in the batch has sampling penalties; history/prompt_lens
+    # are set when true
+    want_penalties: bool = False
+    prompt_lens: np.ndarray = None  # [B] int32 (penalty batches)
 
 
 def _bucket(n: int, buckets: tuple[int, ...]) -> int:
@@ -108,6 +130,7 @@ class Scheduler:
         prefill_chunk: int = 512,
         prefill_batch: int = 4,
         enable_prefix_caching: bool = True,
+        batch_multiple: int = 1,
         decode_steps: int = 1,
         decode_pipeline: int = 1,
         spec_k: int = 0,
@@ -119,6 +142,10 @@ class Scheduler:
         self.prefill_chunk = prefill_chunk
         self.prefill_batch = prefill_batch
         self.enable_prefix_caching = enable_prefix_caching
+        # device batch dims must divide evenly over the dp mesh axis: round
+        # every batch bucket up to a multiple of this (padded rows are inert —
+        # positions -1, zero budgets)
+        self.batch_multiple = max(1, batch_multiple)
         # decode burst length: tokens produced per device program (fused
         # multi-step decode, runner.step_multi); 1 = classic per-token steps.
         # With spec_k > 0 it is the number of fused draft+verify ROUNDS instead
@@ -154,6 +181,11 @@ class Scheduler:
         return len(self.running)
 
     # -- internals ----------------------------------------------------------
+
+    def _batch_bucket(self, n: int) -> int:
+        b = _bucket(n, self.DECODE_BATCH_BUCKETS)
+        m = self.batch_multiple
+        return -(-b // m) * m
 
     def _pages_needed(self, tokens: int) -> int:
         return -(-tokens // self.kv.page_size)
@@ -252,7 +284,14 @@ class Scheduler:
             # (bursts-1) * burst compute, which would hurt arrivals' TTFT
             bursts = (
                 self.decode_pipeline
-                if (not self.waiting and not self.spec_k and self.decode_steps > 1)
+                if (
+                    not self.waiting
+                    and not self.spec_k
+                    and self.decode_steps > 1
+                    # chained bursts stage history once up front, so penalty
+                    # counts would go stale across the seam — no chaining
+                    and not any(s.params.wants_penalties for s in self.running)
+                )
                 else 1
             )
             return self._plan_decode(self.running, bursts)
@@ -263,7 +302,7 @@ class Scheduler:
             min(len(s.prompt_ids) - s.num_computed, self.prefill_chunk) for s in seqs
         ]
         T = _bucket(max(chunks), self.CHUNK_BUCKETS)
-        B = _bucket(len(seqs), self.DECODE_BATCH_BUCKETS)
+        B = self._batch_bucket(len(seqs))
         max_pages = _bucket(
             max(self._pages_needed(s.num_computed + c) for s, c in zip(seqs, chunks)),
             self.PAGE_BUCKETS,
@@ -276,6 +315,17 @@ class Scheduler:
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
         lora_ids = np.zeros((B,), np.int32)
+        want_pen = any(s.params.wants_penalties for s in seqs)
+        history = prompt_lens = None
+        if want_pen:
+            need = max(len(s.prompt_ids) for s in seqs) + 1
+            if need <= self.HISTORY_BUCKETS[-1]:
+                history = np.zeros(
+                    (B, _bucket(need, self.HISTORY_BUCKETS)), np.int32
+                )
+                prompt_lens = np.zeros((B,), np.int32)
+            else:
+                want_pen = False  # context beyond the top bucket: skip penalties
         for i, (s, c) in enumerate(zip(seqs, chunks)):
             lo = s.num_computed
             input_ids[i, :c] = s.prompt_ids[lo : lo + c]
@@ -287,9 +337,15 @@ class Scheduler:
             top_k[i] = s.params.top_k
             top_p[i] = s.params.top_p
             lora_ids[i] = s.lora_slot
+            if history is not None:
+                hn = min(len(s.prompt_ids), history.shape[1])
+                history[i, :hn] = s.prompt_ids[:hn]
+                prompt_lens[i] = len(s.prompt_ids)
         return ScheduledBatch(
             "prefill", list(seqs), input_ids, positions, page_table, kv_lens,
             temperature, top_k, top_p, lora_ids=lora_ids, chunk_sizes=chunks,
+            want_logprobs=any(s.params.logprobs is not None for s in seqs),
+            want_penalties=want_pen, history=history, prompt_lens=prompt_lens,
         )
 
     def _plan_decode(
@@ -316,7 +372,7 @@ class Scheduler:
                 ready.append(s)
         if not ready:
             return None
-        B = _bucket(len(ready), self.DECODE_BATCH_BUCKETS)
+        B = self._batch_bucket(len(ready))
         max_pages = _bucket(
             max(self._pages_needed(self._decode_target_len(s, bursts)) for s in ready),
             self.PAGE_BUCKETS,
@@ -330,9 +386,17 @@ class Scheduler:
         top_p = np.ones((B,), np.float32)
         lora_ids = np.zeros((B,), np.int32)
         kv_limits = np.zeros((B,), np.int32)
-        history = None
+        history = prompt_lens = None
+        want_pen = any(s.params.wants_penalties for s in ready)
+        need_hist = 0
         if self.spec_k:
             need_hist = max(self._spec_limit(s) for s in ready)
+        elif want_pen:
+            # the burst appends sampled tokens at absolute positions
+            need_hist = max(
+                self._decode_target_len(s, bursts) for s in ready
+            )
+        if need_hist:
             if need_hist <= self.HISTORY_BUCKETS[-1]:
                 # Rebuilt per dispatch: O(B * num_tokens) host memcpy, bounded
                 # by the largest bucket (~128 KB/row). Contexts past the top
@@ -341,6 +405,9 @@ class Scheduler:
                 # head would misplace the current token.
                 history = np.zeros((B, _bucket(need_hist, self.HISTORY_BUCKETS)),
                                    np.int32)
+                prompt_lens = np.zeros((B,), np.int32)
+            else:
+                want_pen = False  # context beyond the top bucket
         for i, s in enumerate(ready):
             all_ids = s.prompt_ids + s.output_ids
             input_ids[i, 0] = all_ids[-1]
@@ -353,13 +420,21 @@ class Scheduler:
             top_p[i] = s.params.top_p
             lora_ids[i] = s.lora_slot
             if history is not None:
-                # speculative: a row stays active while lens + spec_k fits
-                # under kv_limits (verify writes spec_k drafts past lens)
-                kv_limits[i] = min(
-                    len(s.pages) * self.kv.page_size, self._spec_limit(s)
-                )
+                if self.spec_k:
+                    # speculative: a row stays active while lens + spec_k fits
+                    # under kv_limits (verify writes spec_k drafts past lens)
+                    kv_limits[i] = min(
+                        len(s.pages) * self.kv.page_size, self._spec_limit(s)
+                    )
+                else:
+                    kv_limits[i] = min(
+                        len(s.pages) * self.kv.page_size,
+                        self.max_model_len,
+                        s.num_tokens + self._burst_budget(s, bursts) - 1,
+                    )
                 hn = min(len(all_ids), history.shape[1])
                 history[i, :hn] = all_ids[:hn]
+                prompt_lens[i] = min(len(s.prompt_ids), history.shape[1])
             else:
                 # device-side burst bound: never write KV past the pages this
                 # seq owns, past the model context, or past its max_tokens
@@ -376,6 +451,8 @@ class Scheduler:
             "decode", ready, input_ids, positions, page_table, kv_lens,
             temperature, top_k, top_p, lora_ids=lora_ids, kv_limits=kv_limits,
             history=history, bursts=bursts,
+            want_logprobs=any(s.params.logprobs is not None for s in ready),
+            want_penalties=want_pen, prompt_lens=prompt_lens,
         )
 
     def _preempt(self, seq: Sequence) -> None:
@@ -391,7 +468,9 @@ class Scheduler:
     # -- result application -------------------------------------------------
 
     def apply_step(self, batch: ScheduledBatch, token_ids: np.ndarray, eos_token_id: int):
-        """Apply sampled tokens; returns list of (seq, new_token).
+        """Apply sampled tokens; returns list of (seq, new_token, row, col) —
+        row/col index into ``token_ids`` so callers can align per-token
+        side data (logprobs).
 
         ``token_ids`` is [B] (prefill / single-step decode), [B, k] (fused
         multi-step decode), or [B, steps, 1+spec_k] with -1 padding
@@ -405,9 +484,9 @@ class Scheduler:
             tokens = tokens[:, None]
         events = []
 
-        def consume(s, tok) -> None:
+        def consume(s, tok, i, j) -> None:
             s.output_ids.append(tok)
-            events.append((s, tok))
+            events.append((s, tok, i, j))
             if (not s.params.ignore_eos) and tok == eos_token_id:
                 self._finish(s, "stop")
             elif len(s.output_ids) >= s.params.max_tokens:
@@ -423,14 +502,23 @@ class Scheduler:
                 s.num_computed += c
                 if s.in_prefill:
                     continue  # more prompt chunks to go
+                if self.enable_prefix_caching:
+                    # register the prompt's full pages NOW (not at finish):
+                    # concurrent requests sharing the prompt — parallel
+                    # sampling siblings, common system prompts — hit the
+                    # cache immediately instead of re-prefilling. Idempotent;
+                    # finish re-registers with the output included.
+                    self.kv.register_filled(
+                        s.prompt_ids, s.pages, s.cache_salt
+                    )
                 if s.first_token_time is None:
                     s.first_token_time = time.monotonic()
-                consume(s, int(tokens[i, 0]))
+                consume(s, int(tokens[i, 0]), i, 0)
             return events
 
         for j in range(tokens.shape[1]):
             for i, s in enumerate(batch.seqs):
                 tok = int(tokens[i, j])
                 if tok >= 0 and not s.finished:
-                    consume(s, tok)
+                    consume(s, tok, i, j)
         return events

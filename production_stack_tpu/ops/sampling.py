@@ -63,3 +63,75 @@ def sample(
 
     use_greedy = temperature <= 0.0
     return jnp.where(use_greedy, greedy_ids, sampled_ids.astype(jnp.int32))
+
+
+# OpenAI caps top_logprobs at 20; vLLM allows 20 too. Static so shapes stay
+# fixed regardless of each request's requested count (host slices).
+TOP_LOGPROBS = 20
+
+
+def sample_with_logprobs(
+    logits: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    sample_from: jnp.ndarray | None = None,
+):
+    """``sample`` plus logprob reporting (OpenAI/vLLM semantics: logprobs of
+    the RAW distribution — log-softmax of unscaled ``logits`` — independent of
+    temperature/top-k/top-p truncation and of penalties). ``sample_from``
+    optionally substitutes a penalty-adjusted distribution for the draw.
+
+    Returns (ids [B] int32, chosen_logprob [B] f32,
+             top_ids [B, TOP_LOGPROBS] int32, top_logprobs [B, TOP_LOGPROBS] f32).
+    """
+    ids = sample(
+        logits if sample_from is None else sample_from,
+        key, temperature, top_k, top_p,
+    )
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1, keepdims=True)  # [B, 1]
+    logprobs = lf - lse
+    chosen = jnp.take_along_axis(logprobs, ids[:, None].astype(jnp.int32), axis=1)[:, 0]
+    top_lp, top_ids = lax.top_k(logprobs, min(TOP_LOGPROBS, logits.shape[1]))
+    return ids, chosen, top_ids.astype(jnp.int32), top_lp
+
+
+def apply_penalties(
+    logits: jnp.ndarray,      # [B, V] f32
+    history: jnp.ndarray,     # [B, H] int32 token ids (prompt + output), 0-padded
+    hist_len: jnp.ndarray,    # [B] int32 valid prefix of history
+    prompt_len: jnp.ndarray,  # [B] int32 prompt portion (output starts here)
+    presence: jnp.ndarray,    # [B] f32 (0 = off)
+    frequency: jnp.ndarray,   # [B] f32 (0 = off)
+    repetition: jnp.ndarray,  # [B] f32 (1 = off)
+) -> jnp.ndarray:
+    """OpenAI presence/frequency penalties (over generated tokens) and vLLM
+    repetition penalty (over prompt + generated), vectorized per row.
+
+    presence/frequency: logits -= presence * 1[count>0] + frequency * count,
+    counting OUTPUT tokens only (vLLM semantics). repetition: seen tokens'
+    positive logits divide by r, negative multiply by r, counting prompt AND
+    output. All counts come from the position-indexed history buffer, so the
+    same code path serves single steps and fused bursts.
+    """
+    B, V = logits.shape
+    H = history.shape[1]
+    idx = jnp.arange(H, dtype=jnp.int32)[None, :]
+    valid = idx < hist_len[:, None]
+    out_part = valid & (idx >= prompt_len[:, None])
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    # OOB sentinel V drops masked slots (mode="drop")
+    all_ids = jnp.where(valid, history, V)
+    out_ids = jnp.where(out_part, history, V)
+    zeros = jnp.zeros((B, V), jnp.float32)
+    all_counts = zeros.at[rows, all_ids].add(1.0, mode="drop")
+    out_counts = zeros.at[rows, out_ids].add(1.0, mode="drop")
+
+    logits = logits - frequency[:, None] * out_counts
+    logits = logits - presence[:, None] * (out_counts > 0)
+    seen = all_counts > 0
+    rep = jnp.maximum(repetition, 1e-6)[:, None]
+    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
+    return jnp.where(seen, penalized, logits)

@@ -192,6 +192,148 @@ class TestHTTPServer:
         assert 'vllm:num_requests_running{model_name="llama-debug"}' in text
         assert "vllm:generation_tokens_total" in text
 
+    def test_n_parallel_sampling_nonstream(self, server):
+        r = requests.post(
+            f"{server}/v1/completions",
+            json={"prompt": "choices", "max_tokens": 4, "temperature": 0.9,
+                  "n": 3, "ignore_eos": True},
+        )
+        assert r.status_code == 200
+        body = r.json()
+        assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+        assert all(c["finish_reason"] == "length" for c in body["choices"])
+        assert body["usage"]["completion_tokens"] == 12  # summed over choices
+        assert body["usage"]["total_tokens"] == body["usage"]["prompt_tokens"] + 12
+
+    def test_n_parallel_sampling_stream(self, server):
+        r = requests.post(
+            f"{server}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 3, "temperature": 0.8, "n": 2,
+                  "ignore_eos": True, "stream": True},
+            stream=True,
+        )
+        assert r.status_code == 200
+        seen = {0: 0, 1: 0}
+        finish = {}
+        import json as json_mod
+        for line in r.iter_lines():
+            if not line.startswith(b"data:") or b"[DONE]" in line:
+                continue
+            chunk = json_mod.loads(line[5:])
+            for c in chunk.get("choices", []):
+                i = c["index"]
+                if c.get("delta", {}).get("content"):
+                    seen[i] += 1
+                if c.get("finish_reason"):
+                    finish[i] = c["finish_reason"]
+        assert finish == {0: "length", 1: "length"}
+        assert seen[0] > 0 and seen[1] > 0
+
+    def test_n_rejects_bad_values(self, server):
+        r = requests.post(
+            f"{server}/v1/completions",
+            json={"prompt": "x", "max_tokens": 2, "n": 0},
+        )
+        assert r.status_code == 400
+        r = requests.post(
+            f"{server}/v1/completions",
+            json={"prompt": "x", "max_tokens": 2, "n": 2, "best_of": 3},
+        )
+        assert r.status_code == 400
+
+    def test_completion_logprobs(self, server):
+        r = requests.post(
+            f"{server}/v1/completions",
+            json={"prompt": "lp test", "max_tokens": 4, "temperature": 0,
+                  "logprobs": 3, "ignore_eos": True},
+        )
+        assert r.status_code == 200
+        c = r.json()["choices"][0]
+        lp = c["logprobs"]
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == 4
+        assert all(isinstance(x, float) and x <= 0 for x in lp["token_logprobs"])
+        assert all(len(d) <= 3 for d in lp["top_logprobs"])
+        # greedy: the chosen token is the argmax, so its logprob equals the
+        # best top-logprob
+        for chosen, top in zip(lp["token_logprobs"], lp["top_logprobs"]):
+            assert abs(chosen - max(top.values())) < 1e-5
+        assert lp["text_offset"][0] == 0
+        assert lp["text_offset"] == sorted(lp["text_offset"])
+
+    def test_chat_logprobs_stream(self, server):
+        import json as json_mod
+        r = requests.post(
+            f"{server}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 3, "temperature": 0.5, "logprobs": True,
+                  "top_logprobs": 2, "ignore_eos": True, "stream": True},
+            stream=True,
+        )
+        assert r.status_code == 200
+        entries = []
+        for line in r.iter_lines():
+            if not line.startswith(b"data:") or b"[DONE]" in line:
+                continue
+            chunk = json_mod.loads(line[5:])
+            for c in chunk.get("choices", []):
+                if c.get("logprobs"):
+                    entries.extend(c["logprobs"]["content"])
+        assert len(entries) == 3
+        for e in entries:
+            assert e["logprob"] <= 0
+            assert len(e["top_logprobs"]) == 2
+            assert isinstance(e["bytes"], list)
+
+    def test_logprobs_rejected_out_of_range(self, server):
+        r = requests.post(
+            f"{server}/v1/completions",
+            json={"prompt": "x", "max_tokens": 2, "logprobs": 50},
+        )
+        assert r.status_code == 400
+
+    def test_n_siblings_share_prompt_kv(self, server):
+        """Parallel-sampling siblings launch after choice 0's prefill and
+        hit the prefix cache on the shared prompt (registered at prefill
+        completion, not at finish)."""
+        before = requests.get(f"{server}/metrics").text
+        def hits(text):
+            for line in text.splitlines():
+                if line.startswith("vllm:gpu_prefix_cache_hits_total"):
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+        prompt = "share this prompt kv " * 4  # >> one page (8 tokens)
+        r = requests.post(
+            f"{server}/v1/completions",
+            json={"prompt": prompt, "max_tokens": 3, "temperature": 0.8,
+                  "n": 3, "ignore_eos": True},
+        )
+        assert r.status_code == 200
+        after = requests.get(f"{server}/metrics").text
+        assert hits(after) > hits(before)
+
+    def test_penalties_accepted_and_plumbed(self, server):
+        r = requests.post(
+            f"{server}/v1/completions",
+            json={"prompt": "penalty run", "max_tokens": 6, "temperature": 0,
+                  "frequency_penalty": 2.0, "presence_penalty": 1.0,
+                  "repetition_penalty": 1.3, "ignore_eos": True},
+        )
+        assert r.status_code == 200
+        assert r.json()["usage"]["completion_tokens"] == 6
+
+    def test_penalties_rejected_out_of_range(self, server):
+        r = requests.post(
+            f"{server}/v1/completions",
+            json={"prompt": "x", "max_tokens": 2, "presence_penalty": 3.0},
+        )
+        assert r.status_code == 400
+        r = requests.post(
+            f"{server}/v1/completions",
+            json={"prompt": "x", "max_tokens": 2, "repetition_penalty": 0},
+        )
+        assert r.status_code == 400
+
     def test_sleep_wake(self, server):
         assert requests.get(f"{server}/is_sleeping").json()["is_sleeping"] is False
         assert requests.post(f"{server}/sleep?level=1").status_code == 200

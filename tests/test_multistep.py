@@ -263,3 +263,26 @@ def test_engine_decode_pipeline_matches_unpipelined_greedy():
         assert r1 == r3 == "length"
     finally:
         e1.stop(), e3.stop()
+
+
+def test_step_multi_frequency_penalty_no_repeats():
+    """A huge frequency penalty bans every sampled token from reappearing
+    within the burst (history carry counts tokens as they are produced)."""
+    B, page_size, ctx_pages, k = 2, 8, 8, 6
+    ctx = 16
+    r = ModelRunner(CFG, num_pages=B * ctx_pages, page_size=page_size, seed=0)
+    rng = np.random.RandomState(5)
+    hist = np.zeros((B, 64), np.int32)
+    prompt = rng.randint(1, CFG.vocab_size, (B, ctx + 1))
+    hist[:, : ctx + 1] = prompt
+    inp = _decode_input(rng, B, ctx, page_size, ctx_pages,
+                        kv_limits=np.full((B,), ctx + 1 + k, np.int32),
+                        history=hist,
+                        prompt_lens=np.full((B,), ctx + 1, np.int32),
+                        presence=np.zeros(B, np.float32),
+                        frequency=np.full(B, 1000.0, np.float32),
+                        repetition=np.ones(B, np.float32))
+    inp.input_ids = prompt[:, -1:].copy()
+    toks = np.asarray(r.step_multi(inp, k))
+    for b in range(B):
+        assert len(set(toks[b].tolist())) == k, toks[b]

@@ -157,3 +157,49 @@ def test_sampling_distribution():
     # p(tok0)+p(tok1) = 2e/(2e+6) ~ 0.475 => expect ~95/200 draws
     assert 60 < counts[0] + counts[1] < 135
     assert counts[:2].min() > 10
+
+
+class TestPenalties:
+    def test_apply_penalties_numerics(self):
+        import jax.numpy as jnp
+        from production_stack_tpu.ops.sampling import apply_penalties
+
+        V = 8
+        logits = jnp.array([[1.0, -1.0, 2.0, 0.5, 0.0, 0.0, 0.0, 0.0]])
+        # history: prompt [2, 2], output [0] (token 0 generated once)
+        hist = jnp.array([[2, 2, 0, 0]], jnp.int32)
+        out = apply_penalties(
+            logits,
+            hist,
+            hist_len=jnp.array([3], jnp.int32),
+            prompt_len=jnp.array([2], jnp.int32),
+            presence=jnp.array([0.5], jnp.float32),
+            frequency=jnp.array([0.25], jnp.float32),
+            repetition=jnp.array([2.0], jnp.float32),
+        )
+        out = np.asarray(out)[0]
+        # token 0: generated once -> -0.5 presence -0.25 freq, then seen ->
+        # positive (1-0.75=0.25) / 2
+        assert abs(out[0] - (1.0 - 0.5 - 0.25) / 2.0) < 1e-6
+        # token 2: prompt-only (count 2 in prompt): no presence/frequency,
+        # repetition divides the positive logit
+        assert abs(out[2] - 2.0 / 2.0) < 1e-6
+        # token 1: never seen -> untouched
+        assert abs(out[1] - (-1.0)) < 1e-6
+        # token 3: unseen -> untouched
+        assert abs(out[3] - 0.5) < 1e-6
+
+    def test_apply_penalties_negative_seen_logit(self):
+        import jax.numpy as jnp
+        from production_stack_tpu.ops.sampling import apply_penalties
+
+        logits = jnp.array([[-1.0, 0.0]])
+        hist = jnp.array([[0]], jnp.int32)
+        out = np.asarray(apply_penalties(
+            logits, hist,
+            hist_len=jnp.array([1], jnp.int32),
+            prompt_len=jnp.array([1], jnp.int32),  # prompt token: rep only
+            presence=jnp.zeros(1), frequency=jnp.zeros(1),
+            repetition=jnp.array([2.0], jnp.float32),
+        ))[0]
+        assert abs(out[0] - (-2.0)) < 1e-6  # negative seen logit multiplies
