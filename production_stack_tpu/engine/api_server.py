@@ -441,7 +441,10 @@ class EngineServer:
             lp_offsets = [0] * n
             async for i, out in merged:
                 lasts[i] = out
-                if out.text_delta or out.finished:
+                # emit when there is text, a finish, OR logprob entries — a
+                # token can decode to empty/held-back text but its logprobs
+                # must still reach the stream
+                if out.text_delta or out.finished or out.logprobs:
                     lp_obj = None
                     if lp_count is not None and out.logprobs is not None:
                         if chat:

@@ -51,6 +51,11 @@ class EngineConfig:
     # decode attention implementation, threaded into the model config:
     # auto | xla | pallas | pallas_interpret (ModelRunner resolves "auto")
     attn_impl: str = "auto"
+    # KV write placement (threaded into the model config): "pre" writes each
+    # layer's K/V into the pool before attending; "post" attends over the
+    # stale pool + in-register chunk K/V and commits all layers with one
+    # batched scatter after the layer scan (avoids per-layer pool copies)
+    kv_write_mode: str = "post"
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     # multi-host serving (StatefulSet choreography, tutorial 15): process 0

@@ -53,6 +53,18 @@ class LLMEngine:
         )
         if cfg.attn_impl != "auto":
             model_cfg = dataclasses.replace(model_cfg, attn_impl=cfg.attn_impl)
+        if getattr(model_cfg, "kv_write_mode", "pre") != cfg.kv_write_mode:
+            if any(
+                f.name == "kv_write_mode" for f in dataclasses.fields(model_cfg)
+            ):
+                model_cfg = dataclasses.replace(
+                    model_cfg, kv_write_mode=cfg.kv_write_mode
+                )
+            else:
+                logger.warning(
+                    "kv_write_mode=%s unsupported for this model family; "
+                    "keeping 'pre'", cfg.kv_write_mode,
+                )
         self.model_cfg = model_cfg
         self.tokenizer = load_tokenizer(
             cfg.tokenizer or (cfg.model if "/" in cfg.model or cfg.model.startswith(".") else None)

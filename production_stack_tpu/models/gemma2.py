@@ -30,7 +30,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from production_stack_tpu.ops.attention import flash_attention, gather_kv_pages, write_kv_pages
+from production_stack_tpu.ops.attention import (
+    flash_attention,
+    gather_kv_pages,
+    stale_kv_positions,
+    write_kv_pages,
+    write_kv_pages_all_layers,
+)
 
 
 @dataclass(frozen=True)
@@ -51,6 +57,7 @@ class Gemma2Config:
     sliding_window: int = 4096        # even layers; odd layers are global
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"           # same contract as LlamaConfig.attn_impl
+    kv_write_mode: str = "post"       # same contract as LlamaConfig.kv_write_mode
 
     @property
     def tie_word_embeddings(self) -> bool:
@@ -188,6 +195,12 @@ def forward(
     sm_scale = cfg.query_pre_attn_scalar**-0.5
     eps = cfg.rms_norm_eps
 
+    post_write = cfg.kv_write_mode == "post"
+    if post_write:
+        # write-after-attend (see models/llama.py): stale pool + in-register
+        # chunk K/V, one batched all-layer scatter after the scan
+        kv_pos = stale_kv_positions(page_table, positions, k_pages.shape[2])
+
     def layer(x, layer_in):
         lp, kp, vp, window = layer_in
 
@@ -196,9 +209,10 @@ def forward(
         k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-        kp, vp = write_kv_pages(
-            kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions
-        )
+        if not post_write:
+            kp, vp = write_kv_pages(
+                kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions
+            )
         if T == 1 and cfg.attn_impl.startswith("pallas"):
             # decode: page-streaming kernel; the per-layer window rides the
             # scan as a traced scalar-prefetch operand
@@ -211,7 +225,18 @@ def forward(
                 window=window, sm_scale=sm_scale,
                 logit_softcap=cfg.attn_logit_softcap,
                 interpret=cfg.attn_impl == "pallas_interpret",
+                k_cur=k[:, 0].astype(kp.dtype) if post_write else None,
+                v_cur=v[:, 0].astype(vp.dtype) if post_write else None,
             )[:, None]
+        elif post_write:
+            kc, vc = gather_kv_pages(kp, vp, page_table)
+            kc = jnp.concatenate([kc, k.astype(kc.dtype)], axis=1)
+            vc = jnp.concatenate([vc, v.astype(vc.dtype)], axis=1)
+            attn = flash_attention(
+                q, kc, vc, q_positions=positions, kv_lens=kv_lens,
+                sm_scale=sm_scale, window=window,
+                logit_softcap=cfg.attn_logit_softcap, kv_positions=kv_pos,
+            )
         else:
             kc, vc = gather_kv_pages(kp, vp, page_table)
             attn = flash_attention(
@@ -225,11 +250,22 @@ def forward(
         h = _rms_norm_1p(x, lp["mlp_norm"], eps)
         mlp = (jax.nn.gelu(h @ lp["w_gate"], approximate=True) * (h @ lp["w_up"])) @ lp["w_down"]
         x = x + _rms_norm_1p(mlp, lp["post_mlp_norm"], eps)
-        return x, (kp, vp)
+        out_kv = (
+            (k.astype(kp.dtype), v.astype(vp.dtype)) if post_write else (kp, vp)
+        )
+        return x, out_kv
 
-    x, (k_pages, v_pages) = lax.scan(
-        layer, x, (params["layers"], k_pages, v_pages, _layer_windows(cfg))
-    )
+    if post_write:
+        x, (k_new, v_new) = lax.scan(
+            layer, x, (params["layers"], k_pages, v_pages, _layer_windows(cfg))
+        )
+        k_pages, v_pages = write_kv_pages_all_layers(
+            k_pages, v_pages, k_new, v_new, page_table, positions
+        )
+    else:
+        x, (k_pages, v_pages) = lax.scan(
+            layer, x, (params["layers"], k_pages, v_pages, _layer_windows(cfg))
+        )
 
     x = _rms_norm_1p(x, params["final_norm"], eps)
     if not all_logits:

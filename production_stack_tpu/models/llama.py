@@ -33,7 +33,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from production_stack_tpu.ops.attention import flash_attention, gather_kv_pages, write_kv_pages
+from production_stack_tpu.ops.attention import (
+    flash_attention,
+    gather_kv_pages,
+    stale_kv_positions,
+    write_kv_pages,
+    write_kv_pages_all_layers,
+)
 from production_stack_tpu.ops.norms import rms_norm
 from production_stack_tpu.ops.rope import RopeScaling, apply_rope, rope_cos_sin
 
@@ -62,6 +68,14 @@ class LlamaConfig:
     # kernel, single-shard meshes), "pallas_interpret" (tests on CPU).
     # "auto" outside a runner falls back to the XLA path.
     attn_impl: str = "auto"
+    # KV write placement. "pre": write each layer's K/V into its pool slice
+    # before attending (pool updates ride the layer scan — simple, but XLA
+    # materializes pool-sized copies per layer). "post" (default): attend
+    # over the stale pool + in-register current-chunk K/V, stack per-layer
+    # K/V as scan outputs, and write ALL layers with one batched scatter
+    # after the scan (donated pools update in place — no per-layer copies;
+    # measured -26% per decode burst on v5e).
+    kv_write_mode: str = "post"
 
     @staticmethod
     def from_hf_config(cfg: dict) -> "LlamaConfig":
@@ -397,6 +411,15 @@ def forward(
     )
     lora_scale = None if lora is None else lora["scale"][lora_ids].astype(cfg.dtype)
 
+    post_write = cfg.kv_write_mode == "post"
+    if post_write:
+        # write-after-attend: the pool is stale for this chunk, so attention
+        # runs over [gathered pages at positions < chunk start] ++ [current
+        # chunk K/V in-register]; per-layer K/V stack as scan outputs and one
+        # batched scatter commits them after the scan (no per-layer pool
+        # copies).
+        kv_pos = stale_kv_positions(page_table, positions, k_pages.shape[2])
+
     def layer(x, layer_in):
         lp, kp, vp, ll = layer_in  # per-layer params, page pools, LoRA slices
 
@@ -412,9 +435,14 @@ def forward(
 
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(h, lp, cfg, B, T, cos, sin, proj)
-        kp, vp = write_kv_pages(kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions)
+        if not post_write:
+            kp, vp = write_kv_pages(
+                kp, vp, k.astype(kp.dtype), v.astype(vp.dtype),
+                page_table, positions,
+            )
         if T == 1 and cfg.attn_impl.startswith("pallas"):
-            # decode: stream pages HBM->VMEM, no gather materialization
+            # decode: stream pages HBM->VMEM, no gather materialization; in
+            # post mode the current token's K/V fold in from registers
             from production_stack_tpu.ops.pallas.paged_attention import (
                 ragged_paged_attention_decode,
             )
@@ -423,21 +451,42 @@ def forward(
                 q[:, 0], kp, vp, page_table, kv_lens,
                 window=cfg.sliding_window,
                 interpret=cfg.attn_impl == "pallas_interpret",
+                k_cur=k[:, 0].astype(kp.dtype) if post_write else None,
+                v_cur=v[:, 0].astype(vp.dtype) if post_write else None,
             )[:, None]
         else:
             kc, vc = gather_kv_pages(kp, vp, page_table)
+            if post_write:
+                kc = jnp.concatenate([kc, k.astype(kc.dtype)], axis=1)
+                vc = jnp.concatenate([vc, v.astype(vc.dtype)], axis=1)
             attn = flash_attention(
                 q, kc, vc, q_positions=positions, kv_lens=kv_lens,
                 window=cfg.sliding_window,
+                kv_positions=kv_pos if post_write else None,
             )
+        out_kv = (
+            (k.astype(kp.dtype), v.astype(vp.dtype)) if post_write else (kp, vp)
+        )
         x = x + proj(attn.reshape(B, T, -1), "wo")
-        return _mlp_residual(x, lp, cfg, proj), (kp, vp)
+        return _mlp_residual(x, lp, cfg, proj), out_kv
 
-    x, (k_pages, v_pages) = lax.scan(
-        layer,
-        x,
-        (params["layers"], k_pages, v_pages, None if lora is None else lora["layers"]),
-    )
+    if post_write:
+        x, (k_new, v_new) = lax.scan(
+            layer,
+            x,
+            (params["layers"], k_pages, v_pages,
+             None if lora is None else lora["layers"]),
+        )
+        k_pages, v_pages = write_kv_pages_all_layers(
+            k_pages, v_pages, k_new, v_new, page_table, positions
+        )
+    else:
+        x, (k_pages, v_pages) = lax.scan(
+            layer,
+            x,
+            (params["layers"], k_pages, v_pages,
+             None if lora is None else lora["layers"]),
+        )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]

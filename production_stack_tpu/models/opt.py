@@ -20,7 +20,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from production_stack_tpu.ops.attention import flash_attention, gather_kv_pages, write_kv_pages
+from production_stack_tpu.ops.attention import (
+    flash_attention,
+    gather_kv_pages,
+    stale_kv_positions,
+    write_kv_pages,
+    write_kv_pages_all_layers,
+)
 from production_stack_tpu.ops.norms import layer_norm
 
 # HF OPT reserves the first 2 position-embedding rows (legacy padding offset).
@@ -38,6 +44,7 @@ class OPTConfig:
     max_model_len: int = 2048
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"  # same contract as LlamaConfig.attn_impl
+    kv_write_mode: str = "post"  # same contract as LlamaConfig.kv_write_mode
 
     # uniform accessors used by the runner/engine (OPT has no GQA)
     @property
@@ -150,15 +157,22 @@ def forward(
     pos_ids = jnp.maximum(positions, 0) + POS_OFFSET
     x = (params["embed"][input_ids] + params["pos_embed"][pos_ids]).astype(cfg.dtype)
 
+    post_write = cfg.kv_write_mode == "post"
+    if post_write:
+        # write-after-attend (see models/llama.py): stale pool + in-register
+        # chunk K/V, one batched all-layer scatter after the scan
+        kv_pos = stale_kv_positions(page_table, positions, k_pages.shape[2])
+
     def layer(x, layer_in):
         lp, kp, vp = layer_in
         h = layer_norm(x, lp["attn_norm_w"], lp["attn_norm_b"], cfg.layer_norm_eps)
         q = (h @ lp["wq"] + lp["bq"]).reshape(B, T, NH, D)
         k = (h @ lp["wk"] + lp["bk"]).reshape(B, T, NH, D)
         v = (h @ lp["wv"] + lp["bv"]).reshape(B, T, NH, D)
-        kp, vp = write_kv_pages(
-            kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions
-        )
+        if not post_write:
+            kp, vp = write_kv_pages(
+                kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions
+            )
         if T == 1 and cfg.attn_impl.startswith("pallas"):
             from production_stack_tpu.ops.pallas.paged_attention import (
                 ragged_paged_attention_decode,
@@ -167,16 +181,35 @@ def forward(
             attn = ragged_paged_attention_decode(
                 q[:, 0], kp, vp, page_table, kv_lens,
                 interpret=cfg.attn_impl == "pallas_interpret",
+                k_cur=k[:, 0].astype(kp.dtype) if post_write else None,
+                v_cur=v[:, 0].astype(vp.dtype) if post_write else None,
             )[:, None]
+        elif post_write:
+            kc, vc = gather_kv_pages(kp, vp, page_table)
+            kc = jnp.concatenate([kc, k.astype(kc.dtype)], axis=1)
+            vc = jnp.concatenate([vc, v.astype(vc.dtype)], axis=1)
+            attn = flash_attention(
+                q, kc, vc, q_positions=positions, kv_lens=kv_lens,
+                kv_positions=kv_pos,
+            )
         else:
             kc, vc = gather_kv_pages(kp, vp, page_table)
             attn = flash_attention(q, kc, vc, q_positions=positions, kv_lens=kv_lens)
         x = x + attn.reshape(B, T, -1) @ lp["wo"] + lp["bo"]
         h = layer_norm(x, lp["mlp_norm_w"], lp["mlp_norm_b"], cfg.layer_norm_eps)
         x = x + jax.nn.relu(h @ lp["fc1"] + lp["fc1_b"]) @ lp["fc2"] + lp["fc2_b"]
-        return x, (kp, vp)
+        out_kv = (
+            (k.astype(kp.dtype), v.astype(vp.dtype)) if post_write else (kp, vp)
+        )
+        return x, out_kv
 
-    x, (k_pages, v_pages) = lax.scan(layer, x, (params["layers"], k_pages, v_pages))
+    if post_write:
+        x, (k_new, v_new) = lax.scan(layer, x, (params["layers"], k_pages, v_pages))
+        k_pages, v_pages = write_kv_pages_all_layers(
+            k_pages, v_pages, k_new, v_new, page_table, positions
+        )
+    else:
+        x, (k_pages, v_pages) = lax.scan(layer, x, (params["layers"], k_pages, v_pages))
 
     x = layer_norm(x, params["final_norm_w"], params["final_norm_b"], cfg.layer_norm_eps)
     if all_logits:  # speculative verify scores every position

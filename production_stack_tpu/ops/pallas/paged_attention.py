@@ -49,16 +49,18 @@ def _decode_kernel(
     q_ref,       # [1, NH, D]
     k_ref,       # [1, page_size, KH, D]
     v_ref,       # [1, page_size, KH, D]
-    o_ref,       # [1, NH, D]
-    # scratch (persist across the page axis of one sequence)
-    m_ref,       # [KH, G] f32
-    l_ref,       # [KH, G] f32
-    acc_ref,     # [KH, G, D] f32
-    *,
+    *refs,       # [k_cur_ref, v_cur_ref,] o_ref, m_ref, l_ref, acc_ref
     sm_scale: float,
     kv_heads: int,
     logit_softcap: float | None,
+    has_cur: bool,
 ):
+    if has_cur:
+        # write-after-attend mode: the current token's pool slot is stale;
+        # its K/V arrive in-register and fold in on the last grid step
+        k_cur_ref, v_cur_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        o_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
     p = pl.program_id(1)
     page_size = k_ref.shape[1]
@@ -73,10 +75,13 @@ def _decode_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     kv_len = lens_ref[b]
+    # paged slots hold positions < paged_end; in has_cur mode the final slot
+    # (the current token, position kv_len - 1) is stale in the pool
+    paged_end = kv_len - 1 if has_cur else kv_len
     lo = jnp.maximum(kv_len - win_ref[0], 0)   # first visible KV slot
     start = (lo // page_size + p) * page_size  # this block's first slot
 
-    @pl.when(start < kv_len)
+    @pl.when(start < paged_end)
     def _():
         q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
         k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [KH, page, D]
@@ -88,7 +93,7 @@ def _decode_kernel(
         if logit_softcap is not None:
             scores = logit_softcap * jnp.tanh(scores / logit_softcap)
         idx = start + lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
-        visible = (idx >= lo) & (idx < kv_len)
+        visible = (idx >= lo) & (idx < paged_end)
         scores = jnp.where(visible, scores, NEG_INF)
 
         m_prev, l_prev = m_ref[...], l_ref[...]
@@ -106,7 +111,22 @@ def _decode_kernel(
 
     @pl.when(p == pl.num_programs(1) - 1)
     def _():
-        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)[..., None]
+        m_prev, l_prev, acc = m_ref[...], l_ref[...], acc_ref[...]
+        if has_cur:
+            # one extra online-softmax update for the current token (always
+            # visible: its position kv_len-1 satisfies causality and window)
+            q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
+            kc = k_cur_ref[0].astype(jnp.float32)  # [KH, D]
+            vc = v_cur_ref[0].astype(jnp.float32)
+            s_cur = jnp.einsum("kgd,kd->kg", q, kc)  # [KH, G]
+            if logit_softcap is not None:
+                s_cur = logit_softcap * jnp.tanh(s_cur / logit_softcap)
+            m_new = jnp.maximum(m_prev, s_cur)
+            alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+            p_cur = jnp.exp(s_cur - m_new)
+            l_prev = l_prev * alpha + p_cur
+            acc = acc * alpha[..., None] + p_cur[..., None] * vc[:, None, :]
+        out = acc / jnp.maximum(l_prev, 1e-30)[..., None]
         o_ref[0] = out.reshape(NH, D).astype(o_ref.dtype)
 
 
@@ -124,17 +144,23 @@ def ragged_paged_attention_decode(
     sm_scale: float | None = None,
     logit_softcap: float | None = None,
     interpret: bool = False,
+    k_cur: jnp.ndarray | None = None,  # [B, KH, D] current token's K (post-write)
+    v_cur: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Decode attention over paged KV, streaming pages HBM->VMEM.
 
-    Returns [B, NH, D] in q.dtype. Matches ops/attention.paged_attention_decode
-    (the XLA oracle) — tests assert equivalence.
+    With ``k_cur/v_cur`` (write-after-attend mode), the pool slot at
+    ``seq_lens - 1`` is treated as stale and the current token's K/V fold in
+    from registers instead. Returns [B, NH, D] in q.dtype. Matches
+    ops/attention.paged_attention_decode (the XLA oracle) — tests assert
+    equivalence.
     """
     B, NH, D = q.shape
     _, page_size, KH, _ = k_pages.shape
     max_pages = page_table.shape[1]
     G = NH // KH
     scale = sm_scale if sm_scale is not None else D**-0.5
+    has_cur = k_cur is not None
     win = (
         jnp.full((1,), 2**30, jnp.int32)
         if window is None
@@ -147,15 +173,25 @@ def ragged_paged_attention_decode(
         lo_page = jnp.maximum(lens[b] - w[0], 0) // page_size
         return (pt[b, jnp.minimum(lo_page + p, max_pages - 1)], 0, 0, 0)
 
+    row = lambda b, p, pt, lens, w: (b, 0, 0)
+    in_specs = [
+        pl.BlockSpec((1, NH, D), row),
+        pl.BlockSpec((1, page_size, KH, D), kv_index),
+        pl.BlockSpec((1, page_size, KH, D), kv_index),
+    ]
+    operands = [q, k_pages, v_pages]
+    if has_cur:
+        in_specs += [
+            pl.BlockSpec((1, KH, D), row),
+            pl.BlockSpec((1, KH, D), row),
+        ]
+        operands += [k_cur, v_cur]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, NH, D), lambda b, p, pt, lens, w: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, KH, D), kv_index),
-            pl.BlockSpec((1, page_size, KH, D), kv_index),
-        ],
-        out_specs=pl.BlockSpec((1, NH, D), lambda b, p, pt, lens, w: (b, 0, 0)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, NH, D), row),
         scratch_shapes=[
             pltpu.VMEM((KH, G), jnp.float32),
             pltpu.VMEM((KH, G), jnp.float32),
@@ -163,7 +199,8 @@ def ragged_paged_attention_decode(
         ],
     )
     kernel = functools.partial(
-        _decode_kernel, sm_scale=scale, kv_heads=KH, logit_softcap=logit_softcap
+        _decode_kernel, sm_scale=scale, kv_heads=KH,
+        logit_softcap=logit_softcap, has_cur=has_cur,
     )
     return pl.pallas_call(
         kernel,
@@ -177,4 +214,4 @@ def ragged_paged_attention_decode(
             ),
             transcendentals=B * NH * max_pages * page_size,
         ),
-    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), win, q, k_pages, v_pages)
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), win, *operands)
