@@ -52,11 +52,14 @@ def main() -> None:
         top_k=np.zeros(1, int),
         top_p=np.ones(1),
     )
-    # two warmups: the first compiles; the second absorbs the one-time
-    # relayout after the donated KV pool is first returned by the program
-    for _ in range(2):
+    # Three warmups: the first compiles; the next absorb the one-time relayout
+    # after the donated KV pool is first returned by the program. Fetch to host
+    # (np.asarray) rather than block_until_ready: on the network-attached axon
+    # platform block_until_ready returns immediately, so without a fetch the
+    # compile would leak into the first timed iteration and blow up p99.
+    for _ in range(3):
         ids, _ = runner.step(ttft_inp)
-        jax.block_until_ready(ids)
+        np.asarray(ids)
     ttfts = []
     for _ in range(20):
         t0 = time.perf_counter()
@@ -87,12 +90,12 @@ def main() -> None:
     # serves
     for _ in range(2):  # compile, then post-donation relayout (see above)
         toks = runner.step_multi(dec, k)
-        jax.block_until_ready(toks)
+        np.asarray(toks)  # real fetch — block_until_ready is a no-op on axon
     bursts = 16
     t0 = time.perf_counter()
     for _ in range(bursts):
         toks = runner.step_multi(dec, k)
-    jax.block_until_ready(toks)
+    np.asarray(toks)
     dt = time.perf_counter() - t0
     decode_tps = B * k * bursts / dt
 
@@ -121,7 +124,8 @@ def main() -> None:
                 "vs_baseline": round(200.0 / p50_ttft, 3),
                 "extras": extras,
             }
-        )
+        ),
+        flush=True,
     )
 
 
@@ -136,7 +140,10 @@ def http_stack_metrics(on_tpu: bool) -> dict:
     import threading
 
     engine_server = None
+    engine_runner = None
+    router_runner = None
     loop = None
+    loop_thread = None
     try:
         import concurrent.futures as cf
 
@@ -154,7 +161,8 @@ def http_stack_metrics(on_tpu: bool) -> dict:
         plen, n_reqs, conc, gen = (1000, 10, 8, 64) if on_tpu else (64, 3, 2, 8)
         eport, rport = free_port(), free_port()
         loop = asyncio.new_event_loop()
-        threading.Thread(target=loop.run_forever, daemon=True).start()
+        loop_thread = threading.Thread(target=loop.run_forever, daemon=True)
+        loop_thread.start()
         # decode_pipeline stays 1 here: chaining doubles the decode program
         # variants ((batch bucket, pages bucket) x bursts), and on this
         # network-attached chip each cold compile is 20-40s — fatal inside the
@@ -167,7 +175,7 @@ def http_stack_metrics(on_tpu: bool) -> dict:
             # pool per step — keep it small there; TPU updates are in-place
             num_pages=None if on_tpu else 2048,
         )
-        engine_server, _ = asyncio.run_coroutine_threadsafe(
+        engine_server, engine_runner = asyncio.run_coroutine_threadsafe(
             engine_api.serve(cfg), loop
         ).result(300)
         rargs = parse_args([
@@ -177,7 +185,9 @@ def http_stack_metrics(on_tpu: bool) -> dict:
             "--static-models", model,
             "--routing-logic", "roundrobin",
         ])
-        asyncio.run_coroutine_threadsafe(router_app.serve(rargs), loop).result(60)
+        _, router_runner = asyncio.run_coroutine_threadsafe(
+            router_app.serve(rargs), loop
+        ).result(60)
 
         url = f"http://127.0.0.1:{rport}/v1/completions"
         rng = np.random.RandomState(7)
@@ -224,10 +234,38 @@ def http_stack_metrics(on_tpu: bool) -> dict:
     except Exception as e:  # noqa: BLE001 - fail-soft by design
         return {"http_stack_error": f"{type(e).__name__}: {e}"}
     finally:
+        # Graceful teardown so no "Task was destroyed but it is pending!"
+        # noise lands near the final metric line: cleanup() both aiohttp
+        # runners (closes sites, runs on_cleanup hooks, drains handlers),
+        # stop the engine, then stop and join the loop thread.
+        if loop is not None:
+
+            async def _shutdown():
+                # bound each cleanup: AppRunner's default shutdown_timeout (60s
+                # draining in-flight handlers) must not outlive our wait below,
+                # or loop.close() would destroy the still-pending task
+                for r in (router_runner, engine_runner):
+                    if r is not None:
+                        try:
+                            await asyncio.wait_for(r.cleanup(), 10)
+                        except Exception:  # noqa: BLE001
+                            pass
+
+            try:
+                asyncio.run_coroutine_threadsafe(_shutdown(), loop).result(30)
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
         if engine_server is not None:
-            engine_server.engine.stop()
+            try:
+                engine_server.engine.stop()
+            except Exception:  # noqa: BLE001
+                pass
         if loop is not None:
             loop.call_soon_threadsafe(loop.stop)
+            if loop_thread is not None:
+                loop_thread.join(timeout=10)
+            if not loop.is_running():
+                loop.close()
 
 
 if __name__ == "__main__":

@@ -429,8 +429,27 @@ class RouterApp:
         return app
 
     async def _cleanup(self, app) -> None:
+        from production_stack_tpu.router.utils import cancel_task
+
         for t in self._bg:
-            t.cancel()
+            await cancel_task(t)
+        # close every service that may have started a background task, so the
+        # loop never shuts down with pending tasks ("Task was destroyed" noise)
+        from production_stack_tpu.router import batch_service
+        from production_stack_tpu.router.service_discovery import get_service_discovery
+
+        for closable in (
+            lambda: get_service_discovery(),
+            lambda: get_engine_stats_scraper(),
+            lambda: DynamicConfigWatcher.get(),
+            lambda: batch_service.get_batch_processor(),
+        ):
+            try:
+                svc = closable()
+                if svc is not None:
+                    await svc.close()
+            except Exception:  # noqa: BLE001 - service may never have started
+                pass
         await close_client_session()
 
 

@@ -19,6 +19,7 @@ from typing import Optional
 import aiohttp
 
 from production_stack_tpu.router.files_service import FileStorage
+from production_stack_tpu.router.utils import cancel_task
 from production_stack_tpu.utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -98,7 +99,8 @@ class LocalBatchProcessor:
 
     async def close(self) -> None:
         if self._task:
-            self._task.cancel()
+            await cancel_task(self._task)
+            self._task = None
 
     async def create_batch(
         self, input_file_id: str, endpoint: str, completion_window: str,

@@ -16,7 +16,7 @@ from typing import Optional
 
 from production_stack_tpu.router import routing_logic as rl
 from production_stack_tpu.router import service_discovery as sd
-from production_stack_tpu.router.utils import parse_comma_separated
+from production_stack_tpu.router.utils import cancel_task, parse_comma_separated
 from production_stack_tpu.utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -60,7 +60,8 @@ class DynamicConfigWatcher:
 
     async def close(self) -> None:
         if self._task:
-            self._task.cancel()
+            await cancel_task(self._task)
+            self._task = None
 
     async def _watch(self) -> None:
         while True:

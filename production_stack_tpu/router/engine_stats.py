@@ -77,8 +77,10 @@ class EngineStatsScraper(metaclass=SingletonMeta):
             self._task = asyncio.create_task(self._loop())
 
     async def close(self) -> None:
+        from production_stack_tpu.router.utils import cancel_task
+
         if self._task:
-            self._task.cancel()
+            await cancel_task(self._task)
             self._task = None
 
     async def _loop(self) -> None:

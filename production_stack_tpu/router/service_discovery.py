@@ -23,7 +23,7 @@ from typing import Optional
 
 import aiohttp
 
-from production_stack_tpu.router.utils import is_model_healthy
+from production_stack_tpu.router.utils import cancel_task, is_model_healthy
 from production_stack_tpu.utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -138,7 +138,8 @@ class StaticServiceDiscovery(ServiceDiscovery):
 
     async def close(self) -> None:
         if self._task:
-            self._task.cancel()
+            await cancel_task(self._task)
+            self._task = None
 
     async def _health_loop(self) -> None:
         while True:
@@ -243,7 +244,8 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
 
     async def close(self) -> None:
         if self._task:
-            self._task.cancel()
+            await cancel_task(self._task)
+            self._task = None
 
     def get_health(self) -> bool:
         return self._healthy

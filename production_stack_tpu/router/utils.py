@@ -6,6 +6,7 @@ ModelType health payloads :48-81, is_model_healthy :160-175).
 
 from __future__ import annotations
 
+import asyncio
 import enum
 import resource
 from typing import Optional
@@ -15,6 +16,18 @@ import aiohttp
 from production_stack_tpu.utils.logging import init_logger
 
 logger = init_logger(__name__)
+
+
+async def cancel_task(task: Optional["asyncio.Task"]) -> None:
+    """Cancel a background task and wait for it to actually finish, so loop
+    shutdown never destroys a still-pending task."""
+    if task is None:
+        return
+    task.cancel()
+    try:
+        await task
+    except (asyncio.CancelledError, Exception):  # noqa: BLE001
+        pass
 
 
 class SingletonMeta(type):
