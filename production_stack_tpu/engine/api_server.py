@@ -423,15 +423,10 @@ class EngineServer:
         async def send(obj: dict):
             await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
 
-        if chat:
-            for i in range(n):
-                await send(
-                    {
-                        "id": oid, "object": "chat.completion.chunk", "created": created,
-                        "model": model,
-                        "choices": [{"index": i, "delta": {"role": "assistant"}, "finish_reason": None}],
-                    }
-                )
+        # chat role chunks are sent lazily with each choice's FIRST engine
+        # output (not at request accept): the first streamed bytes must not
+        # precede prefill completion, or client-measured TTFT would be ~0
+        role_sent = [not chat] * n
         lasts: list = [None] * n
         try:
             if n == 1:
@@ -441,46 +436,57 @@ class EngineServer:
             lp_offsets = [0] * n
             async for i, out in merged:
                 lasts[i] = out
-                # emit when there is text, a finish, OR logprob entries — a
-                # token can decode to empty/held-back text but its logprobs
-                # must still reach the stream
-                if out.text_delta or out.finished or out.logprobs:
-                    lp_obj = None
-                    if lp_count is not None and out.logprobs is not None:
-                        if chat:
-                            lp_obj = {"content": _chat_lp_content(
-                                self.engine.tokenizer, out.token_ids, out.logprobs)}
-                        else:
-                            lp_obj, lp_offsets[i] = _completion_lp(
-                                self.engine.tokenizer, out.token_ids,
-                                out.logprobs, lp_offsets[i])
-                    if chat:
-                        choice = {
-                            "index": i,
-                            "delta": {"content": out.text_delta} if out.text_delta else {},
-                            "logprobs": lp_obj,
-                            "finish_reason": out.finish_reason,
+                if not role_sent[i]:
+                    role_sent[i] = True
+                    await send(
+                        {
+                            "id": oid, "object": "chat.completion.chunk",
+                            "created": created, "model": model,
+                            "choices": [{"index": i, "delta": {"role": "assistant"},
+                                         "finish_reason": None}],
                         }
-                        await send(
-                            {
-                                "id": oid, "object": "chat.completion.chunk",
-                                "created": created, "model": model, "choices": [choice],
-                            }
-                        )
+                    )
+                # emit EVERY engine output (vLLM streams a chunk per step even
+                # when the incremental detokenizer held text back as an
+                # incomplete UTF-8 sequence): the first chunk is what clients
+                # measure TTFT against, and it must track prefill completion,
+                # not the first printable character
+                lp_obj = None
+                if lp_count is not None and out.logprobs is not None:
+                    if chat:
+                        lp_obj = {"content": _chat_lp_content(
+                            self.engine.tokenizer, out.token_ids, out.logprobs)}
                     else:
-                        await send(
-                            {
-                                "id": oid, "object": "text_completion", "created": created,
-                                "model": model,
-                                "choices": [
-                                    {
-                                        "index": i, "text": out.text_delta,
-                                        "logprobs": lp_obj,
-                                        "finish_reason": out.finish_reason,
-                                    }
-                                ],
-                            }
-                        )
+                        lp_obj, lp_offsets[i] = _completion_lp(
+                            self.engine.tokenizer, out.token_ids,
+                            out.logprobs, lp_offsets[i])
+                if chat:
+                    choice = {
+                        "index": i,
+                        "delta": {"content": out.text_delta} if out.text_delta else {},
+                        "logprobs": lp_obj,
+                        "finish_reason": out.finish_reason,
+                    }
+                    await send(
+                        {
+                            "id": oid, "object": "chat.completion.chunk",
+                            "created": created, "model": model, "choices": [choice],
+                        }
+                    )
+                else:
+                    await send(
+                        {
+                            "id": oid, "object": "text_completion", "created": created,
+                            "model": model,
+                            "choices": [
+                                {
+                                    "index": i, "text": out.text_delta,
+                                    "logprobs": lp_obj,
+                                    "finish_reason": out.finish_reason,
+                                }
+                            ],
+                        }
+                    )
             if lasts[0] is not None:
                 usage = _usage(lasts[0])
                 if n > 1:
