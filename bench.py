@@ -190,15 +190,16 @@ def http_stack_metrics(on_tpu: bool) -> dict:
         ).result(60)
 
         url = f"http://127.0.0.1:{rport}/v1/completions"
+        engine_url = f"http://127.0.0.1:{eport}/v1/completions"
         rng = np.random.RandomState(7)
 
-        def one_request(max_tokens: int) -> tuple[float, float]:
+        def one_request(max_tokens: int, target: str = None) -> tuple[float, float]:
             # unique prompt every call so the prefix cache can't shortcut TTFT
             prompt = "".join(chr(rng.randint(97, 123)) for _ in range(plen))
             t0 = time.perf_counter()
             ttft = None
             with requests.post(
-                url,
+                target or url,
                 json={"model": model, "prompt": prompt, "max_tokens": max_tokens,
                       "stream": True, "temperature": 0.0, "ignore_eos": True},
                 stream=True, timeout=600,
@@ -214,6 +215,8 @@ def http_stack_metrics(on_tpu: bool) -> dict:
         for _ in range(2):
             one_request(16)  # compile prefill chunk + decode burst shapes
         ttfts = [one_request(16)[0] * 1000 for _ in range(n_reqs)]
+        # same request direct to the engine server: isolates the router hop
+        eng_ttfts = [one_request(16, engine_url)[0] * 1000 for _ in range(n_reqs)]
 
         # concurrent batch shapes (decode batch bucket, multi-seq prefill)
         # compile on first use — warm them up outside the measured window
@@ -227,6 +230,9 @@ def http_stack_metrics(on_tpu: bool) -> dict:
         return {
             "http_p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 2),
             "http_p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
+            # hop breakdown: engine-server-direct TTFT; router overhead is
+            # http_p50_ttft_ms minus this
+            "http_engine_direct_p50_ttft_ms": round(float(np.percentile(eng_ttfts, 50)), 2),
             "http_stack_tokens_per_sec": round(stack_tps, 1),
             "http_concurrency": conc,
             "http_prefill_tokens": plen,

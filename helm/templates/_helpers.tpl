@@ -25,6 +25,18 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - {{ .containerPort | quote }}
 - "--tensor-parallel-size"
 - {{ .model.tensorParallelSize | default 1 | quote }}
+{{- if .model.pipelineParallelSize }}
+- "--pipeline-parallel-size"
+- {{ .model.pipelineParallelSize | quote }}
+{{- end }}
+{{- if .model.sequenceParallelSize }}
+- "--sequence-parallel-size"
+- {{ .model.sequenceParallelSize | quote }}
+{{- end }}
+{{- if .model.expertParallelSize }}
+- "--expert-parallel-size"
+- {{ .model.expertParallelSize | quote }}
+{{- end }}
 - "--max-model-len"
 - {{ .model.maxModelLen | default 4096 | quote }}
 - "--max-num-seqs"

@@ -92,6 +92,18 @@ inline json::Array engine_args(const json::Value& spec) {
   add(std::to_string(eng["port"].as_int(8100)));
   add("--tensor-parallel-size");
   add(std::to_string(eng["tensorParallelSize"].as_int(1)));
+  if (eng.has("pipelineParallelSize")) {
+    add("--pipeline-parallel-size");
+    add(std::to_string(eng["pipelineParallelSize"].as_int(1)));
+  }
+  if (eng.has("sequenceParallelSize")) {
+    add("--sequence-parallel-size");
+    add(std::to_string(eng["sequenceParallelSize"].as_int(1)));
+  }
+  if (eng.has("expertParallelSize")) {
+    add("--expert-parallel-size");
+    add(std::to_string(eng["expertParallelSize"].as_int(1)));
+  }
   add("--max-model-len");
   add(std::to_string(eng["maxModelLen"].as_int(4096)));
   add("--max-num-seqs");

@@ -58,6 +58,20 @@ class EngineConfig:
     kv_write_mode: str = "post"
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
+    # sequence/context parallelism: long prefill chunks run ring attention
+    # over the sp mesh axis (parallel/ring_attention.py) and activations
+    # shard their token dim; decode is unaffected. Absent in the reference
+    # (SURVEY.md §2.3) — first-class here.
+    sequence_parallel_size: int = 1
+    # expert parallelism: MoE expert weights shard over the ep mesh axis
+    # (parallel/shardings.py moe_* specs); dense models ignore it.
+    expert_parallel_size: int = 1
+    # pipeline parallelism: the layer stack splits into contiguous stages
+    # over the pp mesh axis; microbatches relay stage-to-stage inside the
+    # jitted step (parallel/pipeline.py serving_layer_pipeline). The
+    # reference reaches this via Ray + vLLM --pipeline-parallel-size
+    # (ray-cluster.yaml:560-566); here it is one SPMD program, no Ray.
+    pipeline_parallel_size: int = 1
     # multi-host serving (StatefulSet choreography, tutorial 15): process 0
     # serves HTTP and broadcasts device dispatches; others follow. The
     # coordinator address doubles as the JAX rendezvous (replaces the

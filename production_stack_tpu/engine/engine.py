@@ -77,7 +77,26 @@ class LLMEngine:
         from production_stack_tpu.parallel.mesh import make_mesh
 
         if mesh is None:
-            mesh = make_mesh(tp=cfg.tensor_parallel_size, dp=cfg.data_parallel_size)
+            mesh = make_mesh(
+                tp=cfg.tensor_parallel_size,
+                dp=cfg.data_parallel_size,
+                sp=cfg.sequence_parallel_size,
+                ep=cfg.expert_parallel_size,
+                pp=cfg.pipeline_parallel_size,
+            )
+        # validate against the ACTUAL mesh so callers passing their own mesh
+        # hit the same guards as config-built ones
+        mesh_pp = dict(mesh.shape).get("pp", 1)
+        mesh_dp = dict(mesh.shape).get("dp", 1)
+        if mesh_pp > 1 and cfg.kv_write_mode != "post":
+            raise ValueError(
+                "--pipeline-parallel-size > 1 requires --kv-write-mode post"
+            )
+        if mesh_pp > 1 and mesh_dp > 1:
+            raise ValueError(
+                "pipeline parallelism does not compose with in-engine data "
+                "parallelism yet; use router-level replicas for DP"
+            )
         lora_targets = ()
         if cfg.enable_lora:
             from production_stack_tpu.engine.lora import _HF_TO_LEAF

@@ -75,6 +75,25 @@ class ModelRunner:
         self.page_size = page_size
         self.num_pages = num_pages
         self.mesh = mesh if mesh is not None else make_mesh()
+        mesh_shape = dict(self.mesh.shape)
+        self._sp = mesh_shape.get("sp", 1)
+        self._pp = mesh_shape.get("pp", 1)
+        if self._sp > 1 or self._pp > 1:
+            import inspect
+
+            if "mesh" not in inspect.signature(self.module.forward).parameters:
+                raise ValueError(
+                    f"model family {self.module.__name__.rsplit('.', 1)[-1]!r} "
+                    "does not support sequence/pipeline parallelism"
+                )
+            if self._pp > 1 and cfg.num_layers % self._pp:
+                raise ValueError(
+                    f"pipeline_parallel_size={self._pp} must divide "
+                    f"num_layers={cfg.num_layers}"
+                )
+            self._forward = functools.partial(self.module.forward, mesh=self.mesh)
+        else:
+            self._forward = self.module.forward
         if cfg.attn_impl == "auto":
             # pallas decode kernel: single-shard meshes on real TPU only (the
             # XLA gather path partitions under GSPMD; the kernel does not yet)
@@ -88,10 +107,13 @@ class ModelRunner:
 
         if params is None:
             params = self.module.init_params(cfg, jax.random.key(seed))
-        pspecs = shardings.param_specs_for(params)
+        pspecs = shardings.param_specs_for(params, pp=self._pp > 1)
         self.params = shardings.shard_tree(params, pspecs, self.mesh)
         kp, vp = self.module.init_kv_pages(cfg, num_pages, page_size)
-        kv_sh = NamedSharding(self.mesh, shardings.KV_PAGES_SPEC)
+        kv_sh = NamedSharding(
+            self.mesh,
+            shardings.KV_PAGES_SPEC_PP if self._pp > 1 else shardings.KV_PAGES_SPEC,
+        )
         self.k_pages = jax.device_put(kp, kv_sh)
         self.v_pages = jax.device_put(vp, kv_sh)
         self._rng = jax.random.key(seed)
@@ -190,7 +212,7 @@ class ModelRunner:
             outs = (rep, n, rep, rep, rep, n, n) if want_lp else (rep, n, n, n)
             self._steps[sig] = jax.jit(
                 functools.partial(
-                    _step_fn, self.module.forward, self.cfg, want_lp, want_pen
+                    _step_fn, self._forward, self.cfg, want_lp, want_pen
                 ),
                 donate_argnums=(1, 2),
                 out_shardings=outs,
@@ -254,7 +276,7 @@ class ModelRunner:
             )
             self._multi_steps[sig] = jax.jit(
                 functools.partial(
-                    _multi_step_fn, self.module.forward, self.cfg, k,
+                    _multi_step_fn, self._forward, self.cfg, k,
                     want_logprobs, want_pen,
                 ),
                 donate_argnums=(1, 2),
@@ -346,7 +368,7 @@ class ModelRunner:
         if sig not in self._spec_fns:
             self._spec_fns[sig] = jax.jit(
                 functools.partial(
-                    _spec_fn, self.module.forward, self.cfg, steps, spec_k, ngram
+                    _spec_fn, self._forward, self.cfg, steps, spec_k, ngram
                 ),
                 donate_argnums=(1, 2),
                 out_shardings=(self._rep, None, None),

@@ -387,6 +387,7 @@ def forward(
     lora: Optional[dict] = None,
     lora_ids: Optional[jnp.ndarray] = None,
     all_logits: bool = False,
+    mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
@@ -400,12 +401,25 @@ def forward(
       lora_ids:   [B] int32 adapter slot per sequence (0 = base model).
       all_logits: static; True returns logits for *every* position (used by
                   speculative verify, which scores k draft tokens at once).
+      mesh:       serving mesh, passed by ModelRunner when it has sp>1 (ring-
+                  attention prefill over the sequence axis) or pp>1 (layer
+                  stack pipelined over stages); None = plain GSPMD tp/dp.
 
     Returns (logits[B, V] for each sequence's last valid token — or [B, T, V]
              when ``all_logits`` — and k_pages, v_pages updated).
     """
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
     B, T = input_ids.shape
     x = params["embed"][input_ids].astype(cfg.dtype)  # [B, T, H]
+    if sp > 1 and T > 1:
+        # sequence parallelism: spread the chunk's token dim over sp so the
+        # norm/QKV/MLP FLOPs parallelize too, not just attention
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec("dp", "sp", None))
+        )
     cos, sin = rope_cos_sin(
         jnp.maximum(positions, 0), cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
@@ -420,27 +434,40 @@ def forward(
         # copies).
         kv_pos = stale_kv_positions(page_table, positions, k_pages.shape[2])
 
-    def layer(x, layer_in):
+    # per-sequence aux threaded explicitly (not closed over) so the pp path
+    # can slice it per microbatch; the plain path passes it whole
+    aux = {
+        "cos": cos, "sin": sin, "positions": positions,
+        "page_table": page_table, "kv_lens": kv_lens,
+        "kv_pos": kv_pos if post_write else None,
+        "lora_ids": lora_ids, "lora_scale": lora_scale,
+    }
+
+    def layer(x_aux, layer_in):
+        x, aux = x_aux
         lp, kp, vp, ll = layer_in  # per-layer params, page pools, LoRA slices
+        Bm, Tm = x.shape[:2]
 
         def proj(h, name):
             """h @ W with the batched per-sequence LoRA delta folded in."""
             y = h @ lp[name]
             if ll is not None and ("a_" + name) in ll:
-                a = ll["a_" + name][lora_ids]  # [B, in, R]
-                b = ll["b_" + name][lora_ids]  # [B, R, out]
+                a = ll["a_" + name][aux["lora_ids"]]  # [B, in, R]
+                b = ll["b_" + name][aux["lora_ids"]]  # [B, R, out]
                 delta = jnp.einsum("bti,bir->btr", h, a)
-                y = y + jnp.einsum("btr,bro->bto", delta, b) * lora_scale[:, None, None]
+                y = y + jnp.einsum("btr,bro->bto", delta, b) * (
+                    aux["lora_scale"][:, None, None]
+                )
             return y
 
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(h, lp, cfg, B, T, cos, sin, proj)
+        q, k, v = _qkv(h, lp, cfg, Bm, Tm, aux["cos"], aux["sin"], proj)
         if not post_write:
             kp, vp = write_kv_pages(
                 kp, vp, k.astype(kp.dtype), v.astype(vp.dtype),
-                page_table, positions,
+                aux["page_table"], aux["positions"],
             )
-        if T == 1 and cfg.attn_impl.startswith("pallas"):
+        if Tm == 1 and cfg.attn_impl.startswith("pallas"):
             # decode: stream pages HBM->VMEM, no gather materialization; in
             # post mode the current token's K/V fold in from registers
             from production_stack_tpu.ops.pallas.paged_attention import (
@@ -448,45 +475,68 @@ def forward(
             )
 
             attn = ragged_paged_attention_decode(
-                q[:, 0], kp, vp, page_table, kv_lens,
+                q[:, 0], kp, vp, aux["page_table"], aux["kv_lens"],
                 window=cfg.sliding_window,
                 interpret=cfg.attn_impl == "pallas_interpret",
                 k_cur=k[:, 0].astype(kp.dtype) if post_write else None,
                 v_cur=v[:, 0].astype(vp.dtype) if post_write else None,
             )[:, None]
         else:
-            kc, vc = gather_kv_pages(kp, vp, page_table)
+            kc, vc = gather_kv_pages(kp, vp, aux["page_table"])
             if post_write:
                 kc = jnp.concatenate([kc, k.astype(kc.dtype)], axis=1)
                 vc = jnp.concatenate([vc, v.astype(vc.dtype)], axis=1)
-            attn = flash_attention(
-                q, kc, vc, q_positions=positions, kv_lens=kv_lens,
-                window=cfg.sliding_window,
-                kv_positions=kv_pos if post_write else None,
-            )
+            if sp > 1 and Tm > 1 and cfg.sliding_window is None:
+                # sequence-parallel prefill: ring attention over the sp axis
+                # (KV blocks rotate via ppermute while queries stay local)
+                from production_stack_tpu.parallel.ring_attention import (
+                    ring_attention_serving,
+                )
+
+                if post_write:
+                    # stale_kv_positions already covers pool slots + chunk
+                    kvp = aux["kv_pos"]
+                else:
+                    S = kc.shape[1]
+                    kvp = jnp.broadcast_to(
+                        jnp.arange(S, dtype=jnp.int32), (Bm, S)
+                    )
+                attn = ring_attention_serving(
+                    mesh, q, kc, vc, aux["positions"], kvp
+                )
+            else:
+                attn = flash_attention(
+                    q, kc, vc, q_positions=aux["positions"],
+                    kv_lens=aux["kv_lens"],
+                    window=cfg.sliding_window,
+                    kv_positions=aux["kv_pos"] if post_write else None,
+                )
         out_kv = (
             (k.astype(kp.dtype), v.astype(vp.dtype)) if post_write else (kp, vp)
         )
-        x = x + proj(attn.reshape(B, T, -1), "wo")
-        return _mlp_residual(x, lp, cfg, proj), out_kv
+        x = x + proj(attn.reshape(Bm, Tm, -1), "wo")
+        return (_mlp_residual(x, lp, cfg, proj), aux), out_kv
 
-    if post_write:
-        x, (k_new, v_new) = lax.scan(
-            layer,
-            x,
-            (params["layers"], k_pages, v_pages,
-             None if lora is None else lora["layers"]),
+    scan_xs = (
+        params["layers"], k_pages, v_pages,
+        None if lora is None else lora["layers"],
+    )
+    if pp > 1:
+        if not post_write:
+            raise ValueError("pipeline parallelism requires kv_write_mode='post'")
+        from production_stack_tpu.parallel.pipeline import serving_layer_pipeline
+
+        x, (k_new, v_new) = serving_layer_pipeline(mesh, layer, x, aux, scan_xs)
+        k_pages, v_pages = write_kv_pages_all_layers(
+            k_pages, v_pages, k_new, v_new, page_table, positions
         )
+    elif post_write:
+        (x, _), (k_new, v_new) = lax.scan(layer, (x, aux), scan_xs)
         k_pages, v_pages = write_kv_pages_all_layers(
             k_pages, v_pages, k_new, v_new, page_table, positions
         )
     else:
-        x, (k_pages, v_pages) = lax.scan(
-            layer,
-            x,
-            (params["layers"], k_pages, v_pages,
-             None if lora is None else lora["layers"]),
-        )
+        (x, _), (k_pages, v_pages) = lax.scan(layer, (x, aux), scan_xs)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]

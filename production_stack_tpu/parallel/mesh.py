@@ -11,8 +11,13 @@ Axis convention (fixed names, used by every sharding rule in the stack):
   reference (SURVEY.md §2.3), first-class here.
 - ``ep``: expert parallelism for MoE models.
 
-Pipeline parallelism spans *stages* across hosts and is handled by
-``parallel.pipeline`` (stage meshes over DCN), not as a mesh axis here.
+- ``pp``: pipeline parallelism — the layer stack shards into contiguous
+  stages over this axis and microbatched activations relay stage-to-stage via
+  ``lax.ppermute`` (models/llama.py pp path). Outermost so stages can span
+  hosts over DCN (the reference's Ray-orchestrated
+  ``--pipeline-parallel-size``, ray-cluster.yaml:560-566 — here one SPMD
+  program, no Ray). The standalone ``parallel.pipeline`` module holds the
+  generic schedule used by the serving path.
 """
 
 from __future__ import annotations
@@ -23,7 +28,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "ep", "tp")
+AXES = ("pp", "dp", "sp", "ep", "tp")
 
 
 def make_mesh(
@@ -31,19 +36,22 @@ def make_mesh(
     dp: int = 1,
     sp: int = 1,
     ep: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a mesh with axes (dp, sp, ep, tp).
+    """Build a mesh with axes (pp, dp, sp, ep, tp).
 
     ``tp`` is the innermost (fastest-varying) axis so tensor-parallel
-    collectives ride neighbouring ICI links; ``dp`` is outermost so replicas
-    can span hosts over DCN.
+    collectives ride neighbouring ICI links; ``dp``/``pp`` are outermost so
+    replicas and pipeline stages can span hosts over DCN.
     """
     devices = list(devices if devices is not None else jax.devices())
-    need = dp * sp * ep * tp
+    need = pp * dp * sp * ep * tp
     if need > len(devices):
-        raise ValueError(f"mesh {dp}x{sp}x{ep}x{tp} needs {need} devices, have {len(devices)}")
-    arr = np.array(devices[:need]).reshape(dp, sp, ep, tp)
+        raise ValueError(
+            f"mesh {pp}x{dp}x{sp}x{ep}x{tp} needs {need} devices, have {len(devices)}"
+        )
+    arr = np.array(devices[:need]).reshape(pp, dp, sp, ep, tp)
     return Mesh(arr, AXES)
 
 

@@ -96,3 +96,117 @@ def pipeline_forward(
         check_vma=False,
     )
     return shard_fn(params, microbatches)
+
+
+def serving_layer_pipeline(
+    mesh: Mesh,
+    layer: Callable,
+    x: jnp.ndarray,        # [B, T, H] embedded activations
+    aux,                   # pytree of [B, ...] per-sequence tensors
+    scan_xs,               # (layers, k_pages, v_pages, lora_layers) - [L, ...]
+    *,
+    axis_name: str = "pp",
+):
+    """GPipe schedule for the serving forward: the layer stack (and each
+    layer's KV pool pages) shards into contiguous stages over ``axis_name``;
+    microbatches over the batch dim relay stage-to-stage via ``ppermute``.
+
+    Partial-manual shard_map: only ``axis_name`` is mapped, so the dp/sp/ep/tp
+    GSPMD shardings of activations/params keep flowing automatically inside
+    the body — PP composes with TP without explicit specs (the reference
+    reaches the same pairing via Ray + vLLM, ray-cluster.yaml:560-566).
+
+    ``layer`` is the model's scan body: ``layer((x, aux), (lp, kp, vp, ll)) ->
+    ((x', aux), (k_new, v_new))`` (write-after-attend mode — pools read-only
+    inside, per-layer chunk K/V out). Returns (x_final [B, T, H], (k_new,
+    v_new) [L, B, T, KH, D] with L sharded over ``axis_name``).
+    """
+    pp = mesh.shape[axis_name]
+    B, T, H = x.shape
+    # microbatch count: enough to keep stages busy (bubble (S-1)/(M+S-1)),
+    # bounded by the batch; B and pp are powers of two in serving buckets
+    M = min(B, 2 * pp)
+    while B % M:
+        M -= 1
+    mb = B // M
+    layers, k_pages, v_pages, ll = scan_xs
+
+    def body(x, aux, layers, kp, vp, ll):
+        S = lax.axis_size(axis_name)
+        s = lax.axis_index(axis_name)
+        perm = [(i, i + 1) for i in range(S - 1)]
+        KH, D = kp.shape[3], kp.shape[4]
+        Ll = jax.tree.leaves(layers)[0].shape[0]
+        xs = x.reshape(M, mb, T, H)
+        aux_mb = jax.tree.map(lambda a: a.reshape(M, mb, *a.shape[1:]), aux)
+        Tt = M + S - 1
+
+        buf = jnp.zeros((mb, T, H), x.dtype)
+        outs = jnp.zeros((M, mb, T, H), x.dtype)
+        k_out = jnp.zeros((M, Ll, mb, T, KH, D), kp.dtype)
+        v_out = jnp.zeros((M, Ll, mb, T, KH, D), vp.dtype)
+
+        def tick(carry, t):
+            buf, k_out, v_out, outs = carry
+            mb_i = jnp.clip(t - s, 0, M - 1)
+            active = (t >= s) & (t - s < M)
+            x_in = jnp.where(s == 0, xs[jnp.clip(t, 0, M - 1)], buf)
+            a = jax.tree.map(
+                lambda z: lax.dynamic_index_in_dim(z, mb_i, 0, keepdims=False),
+                aux_mb,
+            )
+            (y, _), (k_new, v_new) = lax.scan(layer, (x_in, a), (layers, kp, vp, ll))
+            y = jnp.where(active, y, jnp.zeros_like(y))
+            k_out = jnp.where(
+                active,
+                lax.dynamic_update_index_in_dim(k_out, k_new, mb_i, 0),
+                k_out,
+            )
+            v_out = jnp.where(
+                active,
+                lax.dynamic_update_index_in_dim(v_out, v_new, mb_i, 0),
+                v_out,
+            )
+            outs = jnp.where(
+                active & (s == S - 1),
+                lax.dynamic_update_index_in_dim(outs, y, mb_i, 0),
+                outs,
+            )
+            # relay activations to the next stage (overlaps with next tick).
+            # The relay runs in f32: XLA:CPU miscompiles bf16 collectives
+            # under partially-manual shard_map (upcast is lossless, and on
+            # TPU the extra convert fuses away).
+            buf = lax.ppermute(
+                y.astype(jnp.float32), axis_name, perm
+            ).astype(y.dtype)
+            return (buf, k_out, v_out, outs), None
+
+        (_, k_out, v_out, outs), _ = lax.scan(
+            tick, (buf, k_out, v_out, outs), jnp.arange(Tt)
+        )
+        # final activations live on the last stage; broadcast to all (f32:
+        # see the relay note above)
+        outs = lax.psum(
+            jnp.where(s == S - 1, outs.astype(jnp.float32),
+                      jnp.zeros(outs.shape, jnp.float32)),
+            axis_name,
+        ).astype(x.dtype)
+        x_final = outs.reshape(B, T, H)
+        # [M, Ll, mb, ...] -> [Ll, B, ...] (B split as m*mb + r)
+        k_new = k_out.transpose(1, 0, 2, 3, 4, 5).reshape(Ll, B, T, KH, D)
+        v_new = v_out.transpose(1, 0, 2, 3, 4, 5).reshape(Ll, B, T, KH, D)
+        return x_final, k_new, v_new
+
+    lead = P(axis_name)
+    layer_specs = jax.tree.map(lambda _: lead, layers)
+    ll_specs = None if ll is None else jax.tree.map(lambda _: lead, ll)
+    aux_specs = jax.tree.map(lambda _: P(), aux)
+    x_final, k_new, v_new = jax.shard_map(
+        body,
+        mesh=mesh,
+        axis_names={axis_name},
+        in_specs=(P(), aux_specs, layer_specs, lead, lead, ll_specs),
+        out_specs=(P(), lead, lead),
+        check_vma=False,
+    )(x, aux, layers, k_pages, v_pages, ll)
+    return x_final, (k_new, v_new)

@@ -54,7 +54,8 @@ def ring_attention_local(
     k: jnp.ndarray,            # [B, Sl, KH, D] local KV shard
     v: jnp.ndarray,            # [B, Sl, KH, D]
     q_positions: jnp.ndarray,  # [B, Tl] global positions; -1 = padding
-    kv_lens: jnp.ndarray,      # [B] global valid KV length
+    kv_lens: jnp.ndarray | None,  # [B] global valid KV length (offset mode)
+    kv_positions: jnp.ndarray | None = None,  # [B, Sl] explicit positions
     *,
     axis_name: str = "sp",
     sm_scale: float | None = None,
@@ -64,6 +65,11 @@ def ring_attention_local(
     Device i initially holds KV block i (global offset i*Sl). Each of the
     ``sp`` steps attends local queries to the currently-held block, then
     rotates the block to the next ring neighbour.
+
+    With ``kv_positions`` the block's global positions are explicit (slots
+    with position -1 are invisible) and rotate around the ring alongside K/V —
+    the serving path uses this because page-pool gathers interleave stale
+    pool slots and in-register chunk K/V, so slot index != global position.
     """
     B, Tl, NH, D = q.shape
     Sl, KH = k.shape[1], k.shape[2]
@@ -79,22 +85,98 @@ def ring_attention_local(
     acc = jnp.zeros((B, Tl, KH, G, D), jnp.float32)
 
     def step(carry, step_idx):
-        m, l, acc, k, v = carry
-        src = (my - step_idx) % sp          # who this block belongs to
-        offset = src * Sl                   # its global position offset
-        idx = offset + jnp.arange(Sl)
-        visible = (idx[None, None, :] <= q_positions[:, :, None]) & (
-            idx[None, None, :] < kv_lens[:, None, None]
-        )
+        m, l, acc, k, v, kvp = carry
+        if kvp is not None:
+            # explicit-position semantics match flash_attention's: a slot is
+            # visible iff its position is valid (>= 0) and causal; kv_lens is
+            # not consulted (invalid slots carry -1)
+            visible = (kvp[:, None, :] <= q_positions[:, :, None]) & (
+                kvp[:, None, :] >= 0
+            )
+        else:
+            src = (my - step_idx) % sp      # who this block belongs to
+            offset = src * Sl               # its global position offset
+            idx = offset + jnp.arange(Sl)
+            visible = (idx[None, None, :] <= q_positions[:, :, None]) & (
+                idx[None, None, :] < kv_lens[:, None, None]
+            )
         m, l, acc = _online_block(qf, k, v, visible, m, l, acc)
         # rotate the KV block while the next step's math is scheduled
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
-        return (m, l, acc, k, v), None
+        if kvp is not None:
+            kvp = lax.ppermute(kvp, axis_name, perm)
+        return (m, l, acc, k, v, kvp), None
 
-    (m, l, acc, _, _), _ = lax.scan(step, (m, l, acc, k, v), jnp.arange(sp))
+    (m, l, acc, _, _, _), _ = lax.scan(
+        step, (m, l, acc, k, v, kv_positions), jnp.arange(sp)
+    )
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.reshape(B, Tl, NH, D).astype(q.dtype)
+
+
+def ring_attention_serving(
+    mesh: Mesh,
+    q: jnp.ndarray,            # [B, T, NH, D] prefill chunk queries
+    k: jnp.ndarray,            # [B, S, KH, D] gathered pool + chunk KV
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,  # [B, T] global positions, -1 pad
+    kv_positions: jnp.ndarray,  # [B, S] per-slot global positions, -1 invalid
+    *,
+    axis_name: str = "sp",
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Sequence-parallel prefill attention inside the jitted serving step.
+
+    Visibility comes from ``kv_positions`` alone (slot visible iff position
+    >= 0 and <= query position) — matching flash_attention's explicit-
+    positions semantics, which ignore kv_lens.
+
+    Partial-manual shard_map: only ``sp`` is mapped — dp/tp shardings of the
+    batch/head axes keep flowing through GSPMD automatically, so this
+    composes with tensor parallelism without explicit specs. T and S pad up
+    to multiples of sp (padded KV slots get position -1 => invisible;
+    padded queries get position -1 => discarded rows).
+    """
+    sp = mesh.shape[axis_name]
+    B, T = q.shape[:2]
+    S = k.shape[1]
+    pad_t, pad_s = (-T) % sp, (-S) % sp
+    if pad_t:
+        q = jnp.pad(q, ((0, 0), (0, pad_t), (0, 0), (0, 0)))
+        q_positions = jnp.pad(q_positions, ((0, 0), (0, pad_t)), constant_values=-1)
+    if pad_s:
+        k = jnp.pad(k, ((0, 0), (0, pad_s), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_s), (0, 0), (0, 0)))
+        kv_positions = jnp.pad(
+            kv_positions, ((0, 0), (0, pad_s)), constant_values=-1
+        )
+    def fn(q, k, v, q_positions, kv_positions):
+        return ring_attention_local(
+            q, k, v, q_positions, None, kv_positions,
+            axis_name=axis_name, sm_scale=sm_scale,
+        )
+
+    # when nested inside another partial-manual shard_map (e.g. the pp layer
+    # pipeline), the context mesh is an AbstractMesh with that axis already
+    # Manual — shard_map requires the matching mesh object, not the concrete
+    # one we were constructed with
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        if ctx is not None and not ctx.empty:
+            mesh = ctx
+    except Exception:  # noqa: BLE001 - older jax without get_abstract_mesh
+        pass
+    seq = P(None, axis_name, None, None)
+    out = jax.shard_map(
+        fn,
+        mesh=mesh,
+        axis_names={axis_name},
+        in_specs=(seq, seq, seq, P(None, axis_name), P(None, axis_name)),
+        out_specs=seq,
+        check_vma=False,
+    )(q, k, v, q_positions, kv_positions)
+    return out[:, :T]
 
 
 def ring_attention(

@@ -62,6 +62,8 @@ _LAYER_SPECS = {
 
 # [L, P, page_size, KH, D] pools: shard kv heads over tp.
 KV_PAGES_SPEC = P(None, None, None, "tp", None)
+# Under pipeline parallelism each stage holds only its own layers' pages.
+KV_PAGES_SPEC_PP = P("pp", None, None, "tp", None)
 
 BATCH_SPECS = {
     "input_ids": P("dp", None),
@@ -72,13 +74,19 @@ BATCH_SPECS = {
 }
 
 
-def param_specs_for(params: dict) -> dict:
+def param_specs_for(params: dict, pp: bool = False) -> dict:
     """PartitionSpec tree matching the structure of `params` (any model
-    family), built from the per-leaf-name tables above."""
+    family), built from the per-leaf-name tables above.
+
+    With ``pp`` the layer-stacked leaves shard their leading [L] axis over the
+    ``pp`` mesh axis (each pipeline stage holds a contiguous layer slice);
+    embed/lm_head stay replicated so first/last stages need no gathers.
+    """
+    layer_lead = "pp" if pp else None
     specs: dict = {}
     for k, v in params.items():
         if k == "layers":
-            specs[k] = {n: P(None, *_LAYER_SPECS[n]) for n in v}
+            specs[k] = {n: P(layer_lead, *_LAYER_SPECS[n]) for n in v}
         else:
             specs[k] = _TOP_SPECS[k]
     return specs

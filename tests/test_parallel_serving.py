@@ -1,0 +1,111 @@
+"""sp/ep/pp as SERVING features: a full LLMEngine (scheduler + paged KV +
+sampling) serving greedy generations on multi-axis meshes must match the
+single-device engine token for token.
+
+The reference exposes PP via Ray + vLLM flags (ray-cluster.yaml:560-566 in
+/root/reference) and has no SP/EP at all (SURVEY.md §2.3); here all three are
+EngineConfig knobs compiled into the one SPMD serving step.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.scheduler import SamplingParams
+
+
+def _cfg(**kw):
+    base = dict(
+        model="llama-debug", max_model_len=128, num_pages=64, page_size=8,
+        max_num_seqs=4, decode_steps=2, prefill_chunk=32,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _gen(engine, prompt, **params):
+    async def run():
+        text, n = "", 0
+        async for out in engine.generate(
+            f"t-{np.random.randint(1 << 30)}", prompt=prompt,
+            params=SamplingParams(**params),
+        ):
+            text += out.text_delta
+            n += len(out.token_ids)
+        return text, n
+
+    return asyncio.run(run())
+
+
+def _serve_and_compare(ref_cfg, par_cfg, prompts, eight_devices):
+    e_ref, e_par = LLMEngine(ref_cfg), LLMEngine(par_cfg)
+    e_ref.start(), e_par.start()
+    try:
+        for prompt in prompts:
+            t_ref, n_ref = _gen(e_ref, prompt, max_tokens=8, temperature=0.0,
+                                ignore_eos=True)
+            t_par, n_par = _gen(e_par, prompt, max_tokens=8, temperature=0.0,
+                                ignore_eos=True)
+            assert n_ref == n_par == 8
+            assert t_ref == t_par
+    finally:
+        e_ref.stop(), e_par.stop()
+
+
+class TestSequenceParallelServing:
+    def test_sp2_matches_single(self, eight_devices):
+        _serve_and_compare(
+            _cfg(), _cfg(sequence_parallel_size=2),
+            ["sequence parallel serving " * 3, "short"], eight_devices,
+        )
+
+    def test_sp_with_tp(self, eight_devices):
+        _serve_and_compare(
+            _cfg(), _cfg(sequence_parallel_size=2, tensor_parallel_size=2),
+            ["ring attention with tensor parallelism"], eight_devices,
+        )
+
+
+class TestPipelineParallelServing:
+    def test_pp2_matches_single(self, eight_devices):
+        _serve_and_compare(
+            _cfg(), _cfg(pipeline_parallel_size=2),
+            ["pipelined layer stack serving", "x"], eight_devices,
+        )
+
+    def test_pp_with_tp(self, eight_devices):
+        # the tutorial's flagship pairing: stages over pp, chips within a
+        # stage over tp (partial-manual shard_map composition)
+        _serve_and_compare(
+            _cfg(), _cfg(pipeline_parallel_size=2, tensor_parallel_size=2),
+            ["stages relay while tensor shards multiply"], eight_devices,
+        )
+
+    def test_pp_rejects_pre_write(self, eight_devices):
+        with pytest.raises(ValueError, match="kv-write-mode post"):
+            LLMEngine(_cfg(pipeline_parallel_size=2, kv_write_mode="pre"))
+
+    def test_pp_must_divide_layers(self, eight_devices):
+        # llama-debug has 2 layers; pp=4 cannot slice them into stages
+        with pytest.raises(ValueError, match="must divide"):
+            LLMEngine(_cfg(pipeline_parallel_size=4))
+
+
+class TestExpertParallelServing:
+    def test_ep2_matches_single(self, eight_devices):
+        _serve_and_compare(
+            _cfg(model="mixtral-debug"),
+            _cfg(model="mixtral-debug", expert_parallel_size=2),
+            ["mixture of experts expert parallel"], eight_devices,
+        )
+
+    def test_ep_with_tp(self, eight_devices):
+        _serve_and_compare(
+            _cfg(model="mixtral-debug"),
+            _cfg(model="mixtral-debug", expert_parallel_size=2,
+                 tensor_parallel_size=2),
+            ["experts and tensor shards"], eight_devices,
+        )
