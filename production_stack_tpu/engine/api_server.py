@@ -366,7 +366,10 @@ class EngineServer:
                 results = await asyncio.gather(
                     *(collect(i, g) for i, g in enumerate(gens))
                 )
-            except asyncio.CancelledError:
+            except (Exception, asyncio.CancelledError):
+                # one failed choice (or a client disconnect) must not leave
+                # its n-1 siblings generating — and holding KV pages — until
+                # their own completion
                 for sid in sub_ids:
                     self.engine.abort(sid)
                 raise

@@ -10,6 +10,7 @@ the reference stack treats as a black box (SURVEY.md §1 L4 contract).
 from __future__ import annotations
 
 import asyncio
+import os
 import dataclasses
 import queue as queue_mod
 import threading
@@ -426,6 +427,17 @@ class LLMEngine:
                     tokens = np.asarray(ids)
             except Exception:
                 logger.exception("engine step failed; aborting batch")
+                if self.cfg.distributed_num_processes > 1:
+                    # multi-host: catch-and-continue would leave the leader
+                    # serving while followers are dead or desynced (a broadcast
+                    # happens before local execution). Exit so K8s restarts the
+                    # StatefulSet and the set re-rendezvouses — this enforces
+                    # the documented failure model (distributed.py).
+                    logger.critical(
+                        "fatal in multi-host mode: exiting so the pod set "
+                        "restarts in sync"
+                    )
+                    os._exit(13)
                 # deferred errors from skipped-fetch prefill dispatches
                 # surface here: those sequences' KV is suspect, abort them too
                 suspect = list(batch.seqs)

@@ -129,9 +129,13 @@ def apply_penalties(
     all_counts = zeros.at[rows, all_ids].add(1.0, mode="drop")
     out_counts = zeros.at[rows, out_ids].add(1.0, mode="drop")
 
-    logits = logits - frequency[:, None] * out_counts
-    logits = logits - presence[:, None] * (out_counts > 0)
+    # vLLM order: repetition applies to the RAW logits first, then presence/
+    # frequency subtract — so a positive logit dragged negative by the
+    # frequency term still divides (not multiplies) by r.
     seen = all_counts > 0
     rep = jnp.maximum(repetition, 1e-6)[:, None]
     penalized = jnp.where(logits > 0, logits / rep, logits * rep)
-    return jnp.where(seen, penalized, logits)
+    logits = jnp.where(seen, penalized, logits)
+    logits = logits - frequency[:, None] * out_counts
+    logits = logits - presence[:, None] * (out_counts > 0)
+    return logits

@@ -13,7 +13,6 @@ compiles) and is marked slow-but-essential.
 
 import json
 import os
-import pickle
 import socket
 import subprocess
 import sys
@@ -27,10 +26,14 @@ from production_stack_tpu.engine.distributed import (
     REPLICATED,
     BroadcastingRunner,
     StepBroadcaster,
+    _pack_call,
     _recv_msg,
     _send_msg,
+    _unpack_call,
     follower_loop,
 )
+
+SECRET = b"test-step-sync-secret"
 
 ROOT = os.path.join(os.path.dirname(__file__), "..")
 
@@ -71,7 +74,7 @@ def test_broadcast_and_follow():
     done = threading.Event()
 
     def follower():
-        follower_loop(follower_runner, "127.0.0.1", port, timeout=30)
+        follower_loop(follower_runner, "127.0.0.1", port, timeout=30, secret=SECRET)
         done.set()
 
     t = threading.Thread(target=follower, daemon=True)
@@ -80,7 +83,7 @@ def test_broadcast_and_follow():
         target=lambda: time.sleep(0.2) or t.start(), daemon=True
     )
     t2.start()
-    bc = StepBroadcaster(port, 1, timeout=30)
+    bc = StepBroadcaster(port, 1, timeout=30, secret=SECRET)
     wrapped = BroadcastingRunner(leader_runner, bc)
 
     arr = np.arange(6).reshape(2, 3)
@@ -108,15 +111,58 @@ def test_replicated_method_list_matches_runner():
         assert hasattr(ModelRunner, name), name
 
 
-def test_framed_pickle_roundtrip():
+def test_framed_roundtrip_authenticated():
     a, b = socket.socketpair()
-    msg = pickle.dumps(("step", (np.zeros(4),), {}))
-    _send_msg(a, msg)
-    got = _recv_msg(b)
+    msg = _pack_call("step", (np.zeros(4),), {})
+    _send_msg(a, msg, SECRET, 0)
+    got = _recv_msg(b, SECRET, 0)
     assert got == msg
     a.close()
     # closed peer -> None (clean shutdown signal)
-    assert _recv_msg(b) is None
+    assert _recv_msg(b, SECRET, 1) is None
+
+
+def test_frame_rejects_wrong_secret_and_replay():
+    a, b = socket.socketpair()
+    msg = _pack_call("step", (), {})
+    _send_msg(a, msg, SECRET, 0)
+    with pytest.raises(RuntimeError, match="authentication"):
+        _recv_msg(b, b"other-secret", 0)
+    # replay: same frame re-sent, receiver expects the NEXT sequence number
+    _send_msg(a, msg, SECRET, 0)
+    with pytest.raises(RuntimeError, match="authentication"):
+        _recv_msg(b, SECRET, 1)
+    a.close()
+
+
+def test_codec_roundtrip_no_pickle():
+    """The step stream codec covers every shape the engine broadcasts:
+    StepInput trees, numpy arrays/scalars, strings, None — and never
+    executes code (tagged tree + raw buffers, not pickle)."""
+    from production_stack_tpu.engine.runner import StepInput
+
+    inp = StepInput(
+        input_ids=np.arange(6, dtype=np.int32).reshape(2, 3),
+        positions=np.zeros((2, 3), np.int32),
+        page_table=np.arange(4, dtype=np.int32).reshape(2, 2),
+        kv_lens=np.array([3, 3], np.int32),
+        temperature=np.array([0.7, 0.0], np.float32),
+        top_k=np.array([40, 0], np.int32),
+        top_p=np.array([0.9, 1.0], np.float32),
+    )
+    method, args, kwargs = _unpack_call(
+        _pack_call("step_multi", (inp, 4), {"want_logprobs": False, "tag": "x"})
+    )
+    assert method == "step_multi"
+    got, k = args
+    assert k == 4 and kwargs == {"want_logprobs": False, "tag": "x"}
+    np.testing.assert_array_equal(got.input_ids, inp.input_ids)
+    assert got.input_ids.dtype == np.int32
+    np.testing.assert_array_equal(got.temperature, inp.temperature)
+    assert got.lora_ids is None
+    # rejects anything it cannot represent safely
+    with pytest.raises(TypeError):
+        _pack_call("step", (object(),), {})
 
 
 _E2E = """

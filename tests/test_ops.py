@@ -178,9 +178,9 @@ class TestPenalties:
             repetition=jnp.array([2.0], jnp.float32),
         )
         out = np.asarray(out)[0]
-        # token 0: generated once -> -0.5 presence -0.25 freq, then seen ->
-        # positive (1-0.75=0.25) / 2
-        assert abs(out[0] - (1.0 - 0.5 - 0.25) / 2.0) < 1e-6
+        # vLLM order: repetition on the RAW logit first (1/2), then
+        # -0.25 frequency and -0.5 presence
+        assert abs(out[0] - (1.0 / 2.0 - 0.25 - 0.5)) < 1e-6
         # token 2: prompt-only (count 2 in prompt): no presence/frequency,
         # repetition divides the positive logit
         assert abs(out[2] - 2.0 / 2.0) < 1e-6
