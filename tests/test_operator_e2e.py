@@ -1,0 +1,265 @@
+"""Operator + K8s discovery integration tests against the fake apiserver.
+
+The reference covers its Go operator with envtest (a real kube-apiserver;
+operator/internal/controller/suite_test.go:31-88) and its router's pod-watch
+discovery inside Kind e2e. Here `testing/fake_apiserver.py` plays the
+apiserver: the compiled C++ operator reconciles real CRs into Deployments/
+Services/status (and POSTs LoRA loads to "pods"), and
+K8sPodIPServiceDiscovery discovers/removes engines through the same watch
+stream the real apiserver would serve.
+"""
+
+import asyncio
+import json
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from production_stack_tpu.testing.procs import free_port, start_proc, stop_proc
+
+REPO = Path(__file__).resolve().parent.parent
+GROUP = "production-stack.tpu.ai"
+VERSION = "v1alpha1"
+
+
+def _req(port, method, path, obj=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=None if obj is None else json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _wait_up(port, proc, timeout=30):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError("fake apiserver died")
+        try:
+            _req(port, "GET", "/api/v1/namespaces/default/pods")
+            return
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError("fake apiserver never came up")
+
+
+@pytest.fixture()
+def apiserver():
+    port = free_port()
+    proc = start_proc(
+        ["-m", "production_stack_tpu.testing.fake_apiserver", "--port", str(port)]
+    )
+    try:
+        _wait_up(port, proc)
+        yield port
+    finally:
+        stop_proc(proc)
+
+
+# -- C++ operator reconcile ---------------------------------------------------
+
+
+needs_native = pytest.mark.skipif(
+    shutil.which("cmake") is None or shutil.which("ninja") is None,
+    reason="needs cmake + ninja",
+)
+
+
+def _operator_bin() -> Path:
+    build = REPO / "operator" / "build"
+    subprocess.run(
+        ["cmake", "-S", str(REPO / "operator"), "-B", str(build), "-G", "Ninja"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(["ninja", "-C", str(build)], check=True, capture_output=True)
+    return build / "pstpu-operator"
+
+
+def _run_operator(bin_path, port, passes=2):
+    subprocess.run(
+        [str(bin_path), "--apiserver-host", "127.0.0.1",
+         "--apiserver-port", str(port), "--namespace", "default",
+         "--max-passes", str(passes), "--resync-seconds", "1"],
+        check=True, capture_output=True, timeout=120,
+    )
+
+
+@needs_native
+def test_operator_reconciles_tpuruntime(apiserver):
+    """A TPURuntime CR becomes a Deployment + Service; status tracks the
+    Deployment's readiness (reference vllmruntime_controller.go:56-150)."""
+    port = apiserver
+    base = f"/apis/{GROUP}/{VERSION}/namespaces/default/tpuruntimes"
+    _req(port, "POST", base, {
+        "apiVersion": f"{GROUP}/{VERSION}", "kind": "TPURuntime",
+        "metadata": {"name": "llama"},
+        "spec": {
+            "model": {"name": "llama-3-8b", "modelURL": "meta-llama/Meta-Llama-3-8B"},
+            "image": {"repository": "pstpu/engine", "tag": "latest"},
+            "replicas": 1,
+            "engineConfig": {"port": 8100, "tensorParallelSize": 8},
+            "tpu": {"accelerator": "tpu-v5-lite-podslice", "topology": "2x4",
+                    "chips": 8},
+        },
+    })
+    op = _operator_bin()
+    _run_operator(op, port)
+
+    dep = _req(port, "GET", "/apis/apps/v1/namespaces/default/deployments/llama-engine")
+    assert dep["spec"]["template"]["spec"]["containers"][0]["image"] == (
+        "pstpu/engine:latest"
+    )
+    args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--tensor-parallel-size" in args and "8" in args
+    svc = _req(port, "GET", "/api/v1/namespaces/default/services/llama-engine-service")
+    assert svc["spec"]["ports"][0]["port"] == 8100
+
+    cr = _req(port, "GET", f"{base}/llama")
+    assert cr["status"]["modelStatus"] == "Pending"  # no ready replicas yet
+
+    # mark the Deployment ready; the next pass flips status to Ready
+    dep["status"] = {"readyReplicas": 1}
+    _req(port, "PUT",
+         "/apis/apps/v1/namespaces/default/deployments/llama-engine", dep)
+    _run_operator(op, port)
+    cr = _req(port, "GET", f"{base}/llama")
+    assert cr["status"]["modelStatus"] == "Ready"
+
+
+@needs_native
+def test_operator_loads_lora_onto_pods(apiserver):
+    """A LoraAdapter CR POSTs /v1/load_lora_adapter to matching ready pods and
+    records them in status (reference loraadapter_controller.go:403-616)."""
+    port = apiserver
+    hits = []
+
+    class Handler(__import__("http.server", fromlist=["BaseHTTPRequestHandler"]).BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            hits.append((self.path, json.loads(body)))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    import http.server
+
+    eng_port = free_port()
+    httpd = http.server.HTTPServer(("127.0.0.1", eng_port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        _req(port, "POST", "/api/v1/namespaces/default/pods", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "llama-engine-0",
+                         "labels": {"model": "llama-3-8b"}},
+            "status": {"podIP": "127.0.0.1",
+                       "containerStatuses": [{"ready": True}]},
+        })
+        base = f"/apis/{GROUP}/{VERSION}/namespaces/default/loraadapters"
+        _req(port, "POST", base, {
+            "apiVersion": f"{GROUP}/{VERSION}", "kind": "LoraAdapter",
+            "metadata": {"name": "sql-lora"},
+            "spec": {"baseModel": "llama-3-8b",
+                     "source": {"path": "/adapters/sql-lora"},
+                     "enginePort": eng_port},
+        })
+        _run_operator(_operator_bin(), port)
+
+        assert hits and hits[0][0] == "/v1/load_lora_adapter"
+        assert hits[0][1] == {"lora_name": "sql-lora",
+                              "lora_path": "/adapters/sql-lora"}
+        cr = _req(port, "GET", f"{base}/sql-lora")
+        assert cr["status"]["phase"] == "Loaded"
+        assert cr["status"]["loadedPods"] == ["llama-engine-0"]
+    finally:
+        httpd.shutdown()
+
+
+# -- K8sPodIPServiceDiscovery watch -------------------------------------------
+
+
+def test_k8s_discovery_watch_add_and_delete(apiserver):
+    """Pods appearing/disappearing on the watch stream add/remove engines;
+    the pod's /v1/models is queried for what it serves (reference
+    service_discovery.py:542-666)."""
+    port = apiserver
+    eng_port = free_port()
+    fake = start_proc(
+        ["-m", "production_stack_tpu.testing.fake_engine",
+         "--port", str(eng_port), "--model", "fake/model"]
+    )
+
+    async def run():
+        from production_stack_tpu.router.service_discovery import (
+            K8sPodIPServiceDiscovery,
+        )
+
+        sd = K8sPodIPServiceDiscovery(
+            namespace="default", label_selector="app=engine",
+            port=str(eng_port),
+            api_server=f"http://127.0.0.1:{port}", token="test-token",
+        )
+        await sd.start()
+        try:
+            for _ in range(100):
+                if sd.get_health():
+                    break
+                await asyncio.sleep(0.1)
+            assert sd.get_health()
+
+            pod = {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": "eng-0",
+                             "labels": {"app": "engine", "model": "fake/model"}},
+                "status": {"podIP": "127.0.0.1",
+                           "containerStatuses": [{"ready": True}]},
+            }
+            await asyncio.to_thread(
+                _req, port, "POST", "/api/v1/namespaces/default/pods", pod
+            )
+            for _ in range(100):
+                if sd.get_endpoint_info():
+                    break
+                await asyncio.sleep(0.1)
+            eps = sd.get_endpoint_info()
+            assert len(eps) == 1
+            assert eps[0].url == f"http://127.0.0.1:{eng_port}"
+            assert eps[0].model_names == ["fake/model"]
+            assert eps[0].model_label == "fake/model"
+
+            await asyncio.to_thread(
+                _req, port, "DELETE", "/api/v1/namespaces/default/pods/eng-0"
+            )
+            for _ in range(100):
+                if not sd.get_endpoint_info():
+                    break
+                await asyncio.sleep(0.1)
+            assert sd.get_endpoint_info() == []
+        finally:
+            await sd.close()
+
+    try:
+        # wait for the fake engine to answer /v1/models
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{eng_port}/health", timeout=2
+                )
+                break
+            except OSError:
+                time.sleep(0.2)
+        asyncio.run(run())
+    finally:
+        stop_proc(fake)
