@@ -684,6 +684,15 @@ class LLMEngine:
         /v1/score. Runs on the device thread, bucketed like generation."""
         if self._sleeping:
             raise RuntimeError("engine is sleeping")
+        # capability check BEFORE the runner call: in multi-host mode every
+        # runner.encode is broadcast to followers first, and a validation
+        # error after broadcast desyncs the set (the wrapper treats it as
+        # fatal) — a client request must never reach that path
+        if not hasattr(self.runner.module, "encode"):
+            raise ValueError(
+                f"embeddings are not supported for model family "
+                f"{self.runner.module.__name__.rsplit('.', 1)[-1]!r}"
+            )
         for ids in token_id_lists:
             if len(ids) > self.cfg.max_model_len:
                 raise ValueError(

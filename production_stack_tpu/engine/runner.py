@@ -78,10 +78,13 @@ class ModelRunner:
         mesh_shape = dict(self.mesh.shape)
         self._sp = mesh_shape.get("sp", 1)
         self._pp = mesh_shape.get("pp", 1)
-        if self._sp > 1 or self._pp > 1:
-            import inspect
+        import inspect
 
-            if "mesh" not in inspect.signature(self.module.forward).parameters:
+        fwd_takes_mesh = (
+            "mesh" in inspect.signature(self.module.forward).parameters
+        )
+        if self._sp > 1 or self._pp > 1:
+            if not fwd_takes_mesh:
                 raise ValueError(
                     f"model family {self.module.__name__.rsplit('.', 1)[-1]!r} "
                     "does not support sequence/pipeline parallelism"
@@ -91,19 +94,35 @@ class ModelRunner:
                     f"pipeline_parallel_size={self._pp} must divide "
                     f"num_layers={cfg.num_layers}"
                 )
-            self._forward = functools.partial(self.module.forward, mesh=self.mesh)
-        else:
-            self._forward = self.module.forward
         if cfg.attn_impl == "auto":
-            # pallas decode kernel: single-shard meshes on real TPU only (the
-            # XLA gather path partitions under GSPMD; the kernel does not yet)
-            use_pallas = (
-                jax.default_backend() == "tpu" and self.mesh.devices.size == 1
-            )
+            # pallas decode kernel on real TPU for single-chip and dp/tp
+            # meshes (the sharded path runs it per shard via shard_map —
+            # ops/pallas/paged_attention.py). sp/ep/pp stay on the XLA
+            # gather path: their decode shardings aren't plain dp x tp.
+            mesh_ok = all(
+                mesh_shape.get(ax, 1) == 1 for ax in ("sp", "ep", "pp")
+            ) and (self.mesh.devices.size == 1 or fwd_takes_mesh)
+            use_pallas = jax.default_backend() == "tpu" and mesh_ok
             cfg = dataclasses.replace(
                 cfg, attn_impl="pallas" if use_pallas else "xla"
             )
             self.cfg = cfg
+        # the forward needs the mesh for sp/pp and for the sharded pallas
+        # decode path on multi-device meshes
+        needs_mesh = self._sp > 1 or self._pp > 1 or (
+            cfg.attn_impl.startswith("pallas") and self.mesh.devices.size > 1
+        )
+        if needs_mesh and not fwd_takes_mesh:
+            raise ValueError(
+                f"model family {self.module.__name__.rsplit('.', 1)[-1]!r} "
+                f"does not support attn_impl={cfg.attn_impl!r} on a "
+                "multi-device mesh"
+            )
+        self._forward = (
+            functools.partial(self.module.forward, mesh=self.mesh)
+            if needs_mesh
+            else self.module.forward
+        )
 
         if params is None:
             params = self.module.init_params(cfg, jax.random.key(seed))

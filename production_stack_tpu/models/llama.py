@@ -469,18 +469,30 @@ def forward(
             )
         if Tm == 1 and cfg.attn_impl.startswith("pallas"):
             # decode: stream pages HBM->VMEM, no gather materialization; in
-            # post mode the current token's K/V fold in from registers
+            # post mode the current token's K/V fold in from registers. On a
+            # multi-device dp x tp mesh the kernel runs per shard via
+            # shard_map (GSPMD cannot partition a pallas_call).
             from production_stack_tpu.ops.pallas.paged_attention import (
                 ragged_paged_attention_decode,
+                ragged_paged_attention_decode_sharded,
             )
 
-            attn = ragged_paged_attention_decode(
-                q[:, 0], kp, vp, aux["page_table"], aux["kv_lens"],
+            pallas_kw = dict(
                 window=cfg.sliding_window,
                 interpret=cfg.attn_impl == "pallas_interpret",
                 k_cur=k[:, 0].astype(kp.dtype) if post_write else None,
                 v_cur=v[:, 0].astype(vp.dtype) if post_write else None,
-            )[:, None]
+            )
+            if mesh is not None and mesh.devices.size > 1:
+                attn = ragged_paged_attention_decode_sharded(
+                    mesh, q[:, 0], kp, vp, aux["page_table"], aux["kv_lens"],
+                    **pallas_kw,
+                )[:, None]
+            else:
+                attn = ragged_paged_attention_decode(
+                    q[:, 0], kp, vp, aux["page_table"], aux["kv_lens"],
+                    **pallas_kw,
+                )[:, None]
         else:
             kc, vc = gather_kv_pages(kp, vp, aux["page_table"])
             if post_write:

@@ -215,3 +215,62 @@ def ragged_paged_attention_decode(
             transcendentals=B * NH * max_pages * page_size,
         ),
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), win, *operands)
+
+
+def ragged_paged_attention_decode_sharded(
+    mesh,
+    q: jnp.ndarray,          # [B, NH, D], B sharded over dp / NH over tp
+    k_pages: jnp.ndarray,    # [P, page_size, KH, D], KH sharded over tp
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray, # [B, max_pages]
+    seq_lens: jnp.ndarray,   # [B]
+    window=None,
+    *,
+    sm_scale: float | None = None,
+    logit_softcap: float | None = None,
+    interpret: bool = False,
+    k_cur: jnp.ndarray | None = None,
+    v_cur: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """The decode kernel on a dp x tp mesh via fully-manual shard_map.
+
+    GSPMD cannot partition a pallas_call, so the north-star TP config (v5e-8,
+    kv heads sharded over tp per shardings.KV_PAGES_SPEC) previously fell
+    back to the XLA gather path whose HBM copy the kernel exists to avoid.
+    Each (dp, tp) shard runs the kernel on its local batch rows and kv-head
+    slice: attention is embarrassingly parallel over both axes (GQA groups
+    stay whole because NH and KH divide by tp together), and page indices are
+    global pool coordinates valid on every shard. sp/ep/pp stay on the XLA
+    path (the runner gates attn_impl accordingly).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    D = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else D**-0.5
+
+    has_cur = k_cur is not None
+
+    def body(q, kp, vp, pt, lens, *cur):
+        kc, vc = cur if has_cur else (None, None)
+        return ragged_paged_attention_decode(
+            q, kp, vp, pt, lens, window,
+            sm_scale=scale, logit_softcap=logit_softcap, interpret=interpret,
+            k_cur=kc, v_cur=vc,
+        )
+
+    head = P("dp", "tp", None)
+    pool = P(None, None, "tp", None)
+    in_specs = [head, pool, pool, P("dp", None), P("dp")]
+    operands = [q, k_pages, v_pages, page_table, seq_lens]
+    if has_cur:
+        in_specs += [head, head]
+        operands += [k_cur, v_cur]
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        axis_names={"dp", "tp"},
+        in_specs=tuple(in_specs),
+        out_specs=head,
+        check_vma=False,
+    )(*operands)
+    return out
