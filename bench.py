@@ -12,6 +12,7 @@ switch this to the full multi-round-qa run through the HTTP stack.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -28,17 +29,38 @@ def main() -> None:
 
     platform = jax.default_backend()
     on_tpu = platform not in ("cpu",)
-    if on_tpu:
+    # PSTPU_BENCH_MODEL_DIR: a local HF directory (safetensors + tokenizer)
+    # benches REAL weights through the production loader; default is the
+    # flagship preset with random weights (hermetic environments)
+    model_dir = os.environ.get("PSTPU_BENCH_MODEL_DIR")
+    runner_kw = {}
+    if model_dir:
+        from production_stack_tpu.engine.model_loader import load_model
+
+        mod, cfg, params = load_model(model_dir)
+        runner_kw = {"params": params, "module": mod}
+        model_desc = f"{model_dir} (real weights)"
+        prefill_len, decode_batch, ctx_pages, page_size = 1024, 16, 64, 16
+        if not on_tpu:
+            prefill_len, decode_batch, ctx_pages, page_size = 64, 4, 8, 8
+        # respect the checkpoint's context limit: positions past a short
+        # position table clamp silently and would bench garbage
+        prefill_len = min(prefill_len, (cfg.max_model_len - 1) // page_size * page_size)
+        ctx_pages = min(ctx_pages, (cfg.max_model_len - 1) // page_size)
+    elif on_tpu:
         cfg = llama.PRESETS["llama-3.2-1b"]
+        model_desc = "llama-3.2-1b-class (random weights)"
         prefill_len, decode_batch, ctx_pages = 1024, 16, 64  # 1024-token contexts
         page_size = 16
-        num_pages = decode_batch * ctx_pages + ctx_pages
     else:  # tiny fallback so the benchmark is runnable anywhere
         cfg = dataclasses.replace(llama.PRESETS["llama-debug"])
+        model_desc = "llama-debug (random weights)"
         prefill_len, decode_batch, ctx_pages, page_size = 64, 4, 8, 8
-        num_pages = decode_batch * ctx_pages + ctx_pages
+    num_pages = decode_batch * ctx_pages + ctx_pages
 
-    runner = ModelRunner(cfg, num_pages=num_pages, page_size=page_size, seed=0)
+    runner = ModelRunner(
+        cfg, num_pages=num_pages, page_size=page_size, seed=0, **runner_kw
+    )
     rng = np.random.RandomState(0)
 
     # --- TTFT: single-request prefill of `prefill_len` tokens + sample ---
@@ -111,9 +133,9 @@ def main() -> None:
         "decode_batch": B,
         "decode_context": ctx + 1,
         "platform": platform,
-        "model": "llama-3.2-1b-class (random weights)",
+        "model": model_desc,
     }
-    extras.update(http_stack_metrics(on_tpu))
+    extras.update(http_stack_metrics(on_tpu, model_dir))
 
     print(
         json.dumps(
@@ -129,7 +151,7 @@ def main() -> None:
     )
 
 
-def http_stack_metrics(on_tpu: bool) -> dict:
+def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
     """Phase 2: TTFT/throughput through the FULL serving stack — streaming
     HTTP client -> router (round-robin, static discovery) -> engine API
     server -> LLMEngine — matching the north star's shape ("p50 TTFT … via
@@ -156,7 +178,9 @@ def http_stack_metrics(on_tpu: bool) -> dict:
         from production_stack_tpu.router.parser import parse_args
         from production_stack_tpu.testing.procs import free_port
 
-        model = "llama-3.2-1b" if on_tpu else "llama-debug"
+        # same weights as phase 1: the HTTP metrics must describe the model
+        # the JSON line names
+        model = model_dir or ("llama-3.2-1b" if on_tpu else "llama-debug")
         # byte tokenizer: ~1 token per char
         plen, n_reqs, conc, gen = (1000, 10, 8, 64) if on_tpu else (64, 3, 2, 8)
         eport, rport = free_port(), free_port()

@@ -1,0 +1,167 @@
+"""Real-model serving e2e: a genuine safetensors checkpoint (written by HF
+transformers' save_pretrained) plus a genuine HF fast tokenizer (with a chat
+template) served through the full HTTP stack — the production model path,
+not the preset/byte-tokenizer shortcut.
+
+Reference contract: the stack's smoke deployments serve facebook/opt-125m
+from a mounted directory (values-01-minimal-example.yaml in
+/root/reference); this is the hermetic equivalent (no downloads).
+"""
+
+import json
+
+import numpy as np
+import pytest
+import requests
+
+from production_stack_tpu.testing.procs import free_port, start_proc, stop_proc, wait_healthy
+
+WORDS = [
+    "the", "cat", "sat", "on", "a", "mat", "dog", "ran", "fast", "slow",
+    "red", "blue", "sun", "moon", "star", "sky", "tree", "rock", "fish",
+    "bird", "hand", "foot", "eye", "ear", "day", "night", "hot", "cold",
+]
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    import torch
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import LlamaConfig, LlamaForCausalLM, PreTrainedTokenizerFast
+
+    torch.manual_seed(0)
+    path = tmp_path_factory.mktemp("real-model")
+
+    # real tokenizer: word-level over a tiny vocabulary + specials
+    specials = ["<unk>", "<s>", "</s>"]
+    vocab = {w: i for i, w in enumerate(specials + WORDS)}
+    tok = Tokenizer(WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = Whitespace()
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok, unk_token="<unk>", bos_token="<s>",
+        eos_token="</s>", pad_token="</s>",
+    )
+    fast.chat_template = (
+        "{% for m in messages %}{{ m['content'] }} {% endfor %}"
+    )
+    fast.save_pretrained(path)
+
+    # real weights: tiny llama, saved as safetensors
+    cfg = LlamaConfig(
+        vocab_size=len(vocab), hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+        bos_token_id=vocab["<s>"], eos_token_id=vocab["</s>"],
+    )
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    model.save_pretrained(path, safe_serialization=True)
+    assert (path / "model.safetensors").exists()
+    assert (path / "tokenizer.json").exists()
+    return path
+
+
+@pytest.fixture(scope="module")
+def server(model_dir):
+    port = free_port()
+    proc = start_proc(
+        ["-m", "production_stack_tpu.engine.api_server",
+         "--model", str(model_dir), "--served-model-name", "tiny-llama",
+         "--port", str(port), "--max-model-len", "128",
+         "--num-pages", "64", "--page-size", "8"]
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        wait_healthy(f"{base}/health", proc, timeout=120)
+        yield base
+    finally:
+        print(stop_proc(proc)[-2000:])
+
+
+def test_chat_completion_real_weights(server):
+    r = requests.post(
+        f"{server}/v1/chat/completions",
+        json={"model": "tiny-llama",
+              "messages": [{"role": "user", "content": "the cat sat on"}],
+              "max_tokens": 8, "temperature": 0.0, "ignore_eos": True},
+        timeout=120,
+    )
+    r.raise_for_status()
+    body = r.json()
+    assert body["usage"]["completion_tokens"] == 8
+    text = body["choices"][0]["message"]["content"]
+    # every emitted token decodes through the REAL tokenizer's vocabulary
+    for w in text.split():
+        assert w in WORDS + ["<unk>"], text
+
+
+def test_chat_streaming_real_weights(server):
+    with requests.post(
+        f"{server}/v1/chat/completions",
+        json={"model": "tiny-llama",
+              "messages": [{"role": "user", "content": "dog ran fast"}],
+              "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+              "stream": True},
+        stream=True, timeout=120,
+    ) as r:
+        r.raise_for_status()
+        chunks = []
+        for line in r.iter_lines():
+            if line.startswith(b"data:") and b"[DONE]" not in line:
+                chunks.append(json.loads(line[5:]))
+    roles = [c["choices"][0]["delta"].get("role")
+             for c in chunks if c.get("choices")]
+    assert roles[0] == "assistant"
+    text = "".join(
+        c["choices"][0]["delta"].get("content") or ""
+        for c in chunks if c.get("choices")
+    )
+    for w in text.split():
+        assert w in WORDS + ["<unk>"]
+
+
+def test_tokenize_uses_real_tokenizer(server):
+    r = requests.post(
+        f"{server}/tokenize",
+        json={"prompt": "the cat sat"}, timeout=60,
+    )
+    r.raise_for_status()
+    body = r.json()
+    # word-level: 3 words (+ possible bos) — NOT ~11 byte tokens
+    assert 3 <= body["count"] <= 4
+    # round-trips through /detokenize
+    r2 = requests.post(f"{server}/detokenize",
+                       json={"tokens": body["tokens"]}, timeout=60)
+    assert "cat" in r2.json()["prompt"]
+
+
+def test_greedy_matches_hf_reference(server, model_dir):
+    """The served first token equals the HF model's argmax — real weights
+    are actually loaded, not random-initialized."""
+    import torch
+    from transformers import AutoTokenizer, LlamaForCausalLM
+
+    tok = AutoTokenizer.from_pretrained(model_dir, local_files_only=True)
+    model = LlamaForCausalLM.from_pretrained(model_dir).eval()
+    prompt = "the cat sat on"
+    ids = tok.encode(prompt)
+    with torch.no_grad():
+        logits = model(torch.tensor([ids])).logits[0, -1]
+    # serving runs bf16 while the reference is fp32, so exact argmax can flip
+    # on near-ties; membership in the fp32 top-3 is robust to bf16 error yet
+    # vanishingly unlikely (3/64) if the weights were NOT actually loaded
+    top3 = {
+        tok.decode([int(i)], skip_special_tokens=True).strip()
+        for i in torch.topk(logits, 3).indices
+    }
+    r = requests.post(
+        f"{server}/v1/completions",
+        json={"model": "tiny-llama", "prompt": prompt,
+              "max_tokens": 1, "temperature": 0.0, "ignore_eos": True},
+        timeout=120,
+    )
+    r.raise_for_status()
+    got = r.json()["choices"][0]["text"].strip()
+    assert got in top3, (got, top3)
