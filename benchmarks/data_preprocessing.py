@@ -3,8 +3,10 @@
 Parity: /root/reference benchmarks/multi-round-qa/data_preprocessing.py —
 filters conversations to those starting with a human turn, keeps alternating
 human/gpt rounds, drops short dialogues, and emits
-[{"num_round", "conversations": [{"role", "content"}...]}] consumed by
-multi_round_qa.py's --sharegpt mode.
+[{"num_round", "conversations": [{"role", "content", "num_tokens"}...]}]
+consumed by multi_round_qa.py's --sharegpt mode ("num_tokens" is the
+estimated token count of the turn; gpt turns' values cap the per-answer
+max_tokens, mirroring the reference's recorded answer lengths).
 """
 
 from __future__ import annotations
@@ -26,9 +28,14 @@ def convert(conversations: list[dict], min_rounds: int = 4) -> list[dict]:
             who = t.get("from")
             if who != expect:
                 break  # enforce strict alternation
+            content = t.get("value", "")
             rounds.append(
                 {"role": "user" if who == "human" else "assistant",
-                 "content": t.get("value", "")}
+                 "content": content,
+                 # token estimate consumed by multi_round_qa --sharegpt as a
+                 # per-answer max_tokens (reference preprocessing records the
+                 # real tokenizer count; ~4 chars/token keeps this hermetic)
+                 "num_tokens": max(1, len(content) // 4)}
             )
             expect = "gpt" if expect == "human" else "human"
         if len(rounds) >= min_rounds:

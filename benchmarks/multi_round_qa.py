@@ -101,23 +101,40 @@ class UserSession:
         round_gap: float,
         records: list[RequestRecord],
         timeout: float = 120.0,
+        conversation: Optional[list[dict]] = None,
     ):
         self.user_id = user_id
         self.base_url = base_url.rstrip("/")
         self.model = model
-        self.messages = [
-            {"role": "system", "content": system_prompt},
-            {"role": "user", "content": user_context},
-            {"role": "assistant", "content": "Understood."},
-        ]
-        self.num_rounds = num_rounds
+        # ShareGPT mode: questions (and per-answer token budgets) come from a
+        # real conversation instead of the synthetic context + question bank
+        # (reference multi-round-qa.py --sharegpt, :236-262)
+        self.conversation = conversation
+        if conversation is not None:
+            self.messages = [{"role": "system", "content": system_prompt}]
+            self.num_rounds = min(num_rounds, len(conversation) // 2)
+        else:
+            self.messages = [
+                {"role": "system", "content": system_prompt},
+                {"role": "user", "content": user_context},
+                {"role": "assistant", "content": "Understood."},
+            ]
+            self.num_rounds = num_rounds
         self.answer_len = answer_len
         self.round_gap = round_gap
         self.records = records
         self.timeout = timeout
 
     async def _one_round(self, session: aiohttp.ClientSession, round_idx: int) -> None:
-        question = QUESTIONS[round_idx % len(QUESTIONS)]
+        max_tokens = self.answer_len
+        if self.conversation is not None:
+            question = self.conversation[2 * round_idx]["content"]
+            gpt_turn = self.conversation[2 * round_idx + 1]
+            max_tokens = min(
+                int(gpt_turn.get("num_tokens", self.answer_len)), self.answer_len
+            )
+        else:
+            question = QUESTIONS[round_idx % len(QUESTIONS)]
         self.messages.append({"role": "user", "content": question})
         rec = RequestRecord(self.user_id, round_idx, launch_time=time.monotonic())
         self.records.append(rec)
@@ -128,7 +145,7 @@ class UserSession:
                 json={
                     "model": self.model,
                     "messages": self.messages,
-                    "max_tokens": self.answer_len,
+                    "max_tokens": max_tokens,
                     "temperature": 0.0,
                     "ignore_eos": True,
                     "stream": True,
@@ -226,9 +243,34 @@ class UserSessionManager:
 
     async def run(self) -> ProcessSummary:
         a = self.args
-        shared, users = synthesize_workload(
-            a.num_users, a.shared_prefix_len, a.user_history_len, seed=a.seed
-        )
+        convs = None
+        if getattr(a, "sharegpt", None):
+            # preprocessed ShareGPT (benchmarks/data_preprocessing.py):
+            # [{"num_round", "conversations": [{"role","content","num_tokens"}]}]
+            # Only conversations long enough for FULL sessions are kept
+            # (reference filter: num_round >= 2 * num_rounds) so request
+            # count and history depth stay comparable across runs.
+            with open(a.sharegpt) as f:
+                data = json.load(f)
+            convs = [
+                d["conversations"] for d in data
+                if d.get("num_round", len(d.get("conversations", [])))
+                >= 2 * a.num_rounds
+            ]
+            if not convs:
+                raise SystemExit(
+                    f"no conversations in {a.sharegpt} have >= "
+                    f"{2 * a.num_rounds} rounds; lower --num-rounds"
+                )
+            # per-user contexts are unused in ShareGPT mode; skip
+            # synthesizing (potentially huge) histories for them
+            shared, users = synthesize_workload(
+                a.num_users, a.shared_prefix_len, 0, seed=a.seed
+            )
+        else:
+            shared, users = synthesize_workload(
+                a.num_users, a.shared_prefix_len, a.user_history_len, seed=a.seed
+            )
         conn = aiohttp.TCPConnector(limit=0)
         start = time.monotonic()
         async with aiohttp.ClientSession(connector=conn) as session:
@@ -238,6 +280,7 @@ class UserSessionManager:
                     i, a.base_url, a.model, shared, users[i],
                     a.num_rounds, a.answer_len, a.round_gap, self.records,
                     timeout=a.request_timeout,
+                    conversation=None if convs is None else convs[i % len(convs)],
                 )
                 tasks.append(asyncio.create_task(us.run(session)))
                 # user arrivals paced at --qps (reference: session launch rate)
@@ -278,6 +321,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--user-history-len", type=int, default=100, help="words")
     p.add_argument("--round-gap", type=float, default=1.0, help="seconds between rounds")
     p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--sharegpt", default=None,
+                   help="preprocessed ShareGPT JSON (data_preprocessing.py); "
+                        "questions and per-answer token budgets come from real "
+                        "conversations instead of the synthetic workload")
     p.add_argument("--output", default=None, help="per-request CSV path")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args(argv)

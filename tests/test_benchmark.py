@@ -44,3 +44,70 @@ def test_multi_round_qa_against_fake_engine(tmp_path):
         assert len(lines) == 1 + 8  # header + one row per request
     finally:
         stop_proc(proc)
+
+
+def test_sharegpt_mode_against_fake_engine(tmp_path):
+    """--sharegpt: questions + per-answer budgets come from a preprocessed
+    conversation file (reference multi-round-qa.py:181-262 + its
+    data_preprocessing)."""
+    import json
+
+    import data_preprocessing
+    import multi_round_qa
+
+    raw = [
+        {"conversations": [
+            {"from": "human", "value": "What is the tallest mountain on earth?"},
+            {"from": "gpt", "value": "Mount Everest, at 8849 meters above sea level."},
+            {"from": "human", "value": "And the second tallest?"},
+            {"from": "gpt", "value": "K2, at 8611 meters."},
+        ]},
+        {"conversations": [  # starts with gpt -> leading turn dropped
+            {"from": "gpt", "value": "Hello!"},
+            {"from": "human", "value": "Tell me a story about a fox."},
+            {"from": "gpt", "value": "Once upon a time a fox " + "ran far " * 40},
+            {"from": "human", "value": "What happened next?"},
+            {"from": "gpt", "value": "It found a friend."},
+        ]},
+        {"conversations": [  # too short after filtering
+            {"from": "human", "value": "hi"},
+        ]},
+    ]
+    converted = data_preprocessing.convert(raw, min_rounds=4)
+    assert len(converted) == 2
+    assert all(c["conversations"][0]["role"] == "user" for c in converted)
+    assert all("num_tokens" in t for c in converted for t in c["conversations"])
+    data_path = tmp_path / "sharegpt.json"
+    data_path.write_text(json.dumps(converted))
+
+    port = free_port()
+    proc = start_proc(
+        ["-m", "production_stack_tpu.testing.fake_engine",
+         "--port", str(port), "--model", "bench-model", "--speed", "500"]
+    )
+    try:
+        wait_healthy(f"http://127.0.0.1:{port}/health", proc)
+        csv_path = str(tmp_path / "out.csv")
+        summary = multi_round_qa.main(
+            ["--base-url", f"http://127.0.0.1:{port}/v1",
+             "--model", "bench-model",
+             "--qps", "20", "--num-users", "3", "--num-rounds", "2",
+             "--answer-len", "64", "--round-gap", "0.05",
+             "--sharegpt", str(data_path), "--output", csv_path]
+        )
+        # 3 users x 2 rounds (both conversations have >= 2 user turns)
+        assert summary.completed == 6
+        assert summary.failed == 0
+        with open(csv_path) as f:
+            rows = f.read().strip().splitlines()
+        assert len(rows) == 1 + 6
+        # ShareGPT answer budgets cap generation: "K2, at 8611 meters." is
+        # ~5 tokens (num_tokens = len//4), so round 2 of conversation 0 must
+        # generate far fewer than answer-len tokens
+        import csv as csv_mod
+
+        gen = {(int(r["user_id"]), int(r["round"])): int(r["generation_tokens"])
+               for r in csv_mod.DictReader(open(csv_path))}
+        assert gen[(0, 1)] <= 8
+    finally:
+        stop_proc(proc)
