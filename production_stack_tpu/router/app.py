@@ -58,6 +58,22 @@ class RouterApp:
 
     async def initialize_all(self) -> None:
         args = self.args
+        if getattr(args, "sentry_dsn", None):
+            # error reporting parity (reference app.py:118-119). The SDK is
+            # optional in this environment; the flag degrades gracefully.
+            try:
+                import sentry_sdk
+
+                sentry_sdk.init(dsn=args.sentry_dsn, traces_sample_rate=0.1)
+                logger.info("sentry error reporting initialized")
+            except ImportError:
+                logger.warning(
+                    "--sentry-dsn set but sentry_sdk is not installed; "
+                    "error reporting disabled"
+                )
+            except Exception as e:  # noqa: BLE001 - e.g. BadDsn
+                # a typo'd DSN must not crash-loop the router pod
+                logger.warning("sentry init failed (%s); error reporting disabled", e)
         if args.service_discovery == "static":
             sd = initialize_service_discovery(
                 "static",
