@@ -93,5 +93,10 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--kv-peer-url"
 - "{{ .model.kvPeerService }}:{{ .model.kvTransferPort | default 55555 }}"
 {{- end }}
+{{- if .model.kvTransferDevice }}
+- "--kv-transfer-device"
+- "--kv-transfer-device-host"
+- "$(POD_IP)"
+{{- end }}
 {{- end }}
 {{- end }}

@@ -101,6 +101,15 @@ class EngineConfig:
     kv_role: str = "none"
     kv_transfer_port: int = 55555
     kv_peer_url: Optional[str] = None
+    # device-to-device KV for co-located P/D slices: pages move over the XLA
+    # transfer service (jax.experimental.transfer — ICI/DCN on TPU pods)
+    # instead of host serde + TCP blobs (kvoffload/transfer.py). Both roles
+    # must enable it; any failure falls back to the TCP path per page.
+    kv_transfer_device: bool = False
+    # host other pods reach this engine's transfer server at (producer side)
+    kv_transfer_device_host: str = "127.0.0.1"
+    # staging budget for device-pulled pages awaiting admission (consumer)
+    kv_transfer_stage_mb: int = 1024
 
     @property
     def name(self) -> str:

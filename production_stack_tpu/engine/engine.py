@@ -131,12 +131,24 @@ class LLMEngine:
                 raise ValueError("kv_role=producer requires --kv-peer-url")
             from production_stack_tpu.kvoffload.transfer import KVTransferSender
 
-            self._kv_sender = KVTransferSender(cfg.kv_peer_url)
+            endpoint = self._make_device_endpoint(cfg)
+            self._kv_sender = KVTransferSender(
+                cfg.kv_peer_url, device_endpoint=endpoint
+            )
         elif cfg.kv_role == "consumer":
-            from production_stack_tpu.kvoffload.transfer import KVTransferReceiver
+            from production_stack_tpu.kvoffload.transfer import (
+                DeviceStaging,
+                KVTransferReceiver,
+            )
 
+            endpoint = self._make_device_endpoint(cfg)
+            staging = None
+            if endpoint is not None:
+                staging = DeviceStaging(cfg.kv_transfer_stage_mb << 20)
+                self._offload.device_staging = staging
             self._kv_receiver = KVTransferReceiver(
-                self._offload.store, host=cfg.host, port=cfg.kv_transfer_port
+                self._offload.store, host=cfg.host, port=cfg.kv_transfer_port,
+                device_endpoint=endpoint, staging=staging,
             )
             self._kv_receiver.start()
         self.scheduler = Scheduler(
@@ -171,6 +183,24 @@ class LLMEngine:
         self.spec_accepted_tokens = 0  # drafts the target accepted
         self.num_preemptions = 0
 
+
+    def _make_device_endpoint(self, cfg: EngineConfig):
+        """Device-to-device KV endpoint (opt-in; falls back to None so the
+        TCP blob path serves everything when the transfer service cannot
+        start on this platform)."""
+        if not cfg.kv_transfer_device:
+            return None
+        from production_stack_tpu.kvoffload.transfer import DeviceKVEndpoint
+
+        try:
+            ep = DeviceKVEndpoint(self.runner, host=cfg.kv_transfer_device_host)
+            logger.info("device kv endpoint at %s", ep.address)
+            return ep
+        except Exception as e:  # noqa: BLE001 - platform without transfer svc
+            logger.warning(
+                "device kv transfer unavailable (%s); using TCP blobs", e
+            )
+            return None
 
     def _make_offload_connector(self, cfg: EngineConfig):
         """Build the LMCache-equivalent offload connector when any tier or the
@@ -229,8 +259,14 @@ class LLMEngine:
             self._offload.stop()
         if self._kv_sender is not None:
             self._kv_sender.close()
+            if self._kv_sender.device_endpoint is not None:
+                self._kv_sender.device_endpoint.close()
         if self._kv_receiver is not None:
             self._kv_receiver.stop()
+            if self._kv_receiver.device_endpoint is not None:
+                self._kv_receiver.device_endpoint.close()
+            if self._kv_receiver.staging is not None:
+                self._kv_receiver.staging.clear()
 
     # -- request api (asyncio side) -----------------------------------------
 
@@ -497,6 +533,14 @@ class LLMEngine:
             if pid is None:
                 continue
             key = h.hex()
+            if self._kv_sender.device_endpoint is not None:
+                # device->device: slice the page on device and offer it for
+                # pull — no host fetch, no serde (ICI/DCN carries the bytes)
+                k_dev = self.runner.k_pages[:, pid]
+                v_dev = self.runner.v_pages[:, pid]
+                if self._kv_sender.push_device(key, k_dev, v_dev):
+                    continue
+                # refused (staging full / pull failed): TCP blob fallback
             blob = None
             if self._offload is not None:
                 blob = self._offload.store.get(key)

@@ -130,6 +130,11 @@ class KVOffloadConnector:
         )
         self.saved_pages = 0
         self.loaded_pages = 0
+        # device-pulled pages awaiting admission (disaggregated prefill's
+        # device->device path; transfer.DeviceStaging) — consulted before the
+        # host-blob tiers so admission never pays a serde round trip for them
+        self.device_staging = None
+        self.device_loaded_pages = 0
 
     def _on_local_drop(self, key: str) -> None:
         # last local copy gone; remote copies (shared server) still count as
@@ -161,6 +166,8 @@ class KVOffloadConnector:
 
     def has(self, h: bytes) -> bool:
         try:
+            if self.device_staging is not None and self.device_staging.contains(h.hex()):
+                return True
             return self.store.contains(h.hex())
         except Exception:
             return False
@@ -169,6 +176,14 @@ class KVOffloadConnector:
         """Restore one page into HBM; returns False if the blob vanished or is
         unreadable. Never raises (same engine-loop safety as save_page)."""
         try:
+            if self.device_staging is not None:
+                staged = self.device_staging.pop(h.hex())
+                if staged is not None:
+                    # device->device injection: no host serde round trip
+                    self.runner.set_page(pid, *staged)
+                    self.device_loaded_pages += 1
+                    self.loaded_pages += 1
+                    return True
             blob = self.store.get(h.hex())
             if blob is None:
                 return False

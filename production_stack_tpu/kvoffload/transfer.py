@@ -38,11 +38,23 @@ class KVTransferReceiver:
     """TCP server inside the decode (consumer) engine process; pushes land in
     the engine's tiered store where prefix-match admission finds them."""
 
-    def __init__(self, store, host: str = "0.0.0.0", port: int = 55555):
+    def __init__(
+        self,
+        store,
+        host: str = "0.0.0.0",
+        port: int = 55555,
+        device_endpoint=None,
+        staging=None,
+    ):
         self.store = store
         self.host, self.port = host, port
+        # device-to-device mode (DeviceKVEndpoint + DeviceStaging): producers
+        # announce pages via "page_ready" and we pull them device->device
+        self.device_endpoint = device_endpoint
+        self.staging = staging
         self.received_chunks = 0
         self.received_bytes = 0
+        self.device_pages = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -62,6 +74,34 @@ class KVTransferReceiver:
                     self.received_chunks += 1
                     self.received_bytes += len(payload)
                     await write_frame(writer, {"ok": True})
+                elif op == "page_query":
+                    # device path phase 1: atomically reserve staging budget
+                    # so the producer registers the page with its transfer
+                    # server only once a pull is guaranteed to be attempted
+                    ok = (
+                        self.device_endpoint is not None
+                        and self.staging is not None
+                        and self.staging.reserve(hdr["key"], int(hdr["nbytes"]))
+                    )
+                    await write_frame(writer, {"ok": bool(ok)})
+                elif op == "page_ready":
+                    # device path phase 2: pull the registered page
+                    # device->device and stage it for admission
+                    ok = False
+                    if self.device_endpoint is not None and self.staging is not None:
+                        try:
+                            k_dev, v_dev = await asyncio.to_thread(
+                                self.device_endpoint.pull,
+                                hdr["addr"], hdr["uuid"],
+                                hdr["shape"], hdr["dtype"],
+                            )
+                            self.staging.put(hdr["key"], k_dev, v_dev)
+                            self.device_pages += 1
+                            ok = True
+                        except Exception as e:  # noqa: BLE001
+                            self.staging.unreserve(hdr["key"])
+                            logger.warning("device kv pull failed: %s", e)
+                    await write_frame(writer, {"ok": ok})
                 elif op == "ping":
                     await write_frame(writer, {"ok": True})
                 else:
@@ -112,13 +152,51 @@ class KVTransferSender:
     before the decode peer holds the KV (the reference gets the same ordering
     from the NIXL blocking handshake)."""
 
-    def __init__(self, peer_url: str, timeout: float = 30.0):
+    def __init__(self, peer_url: str, timeout: float = 30.0, device_endpoint=None):
         host, port = parse_hostport(peer_url, default_port=55555)
         self._client = BlockingClient(host, port, timeout=timeout)
         self._lock = threading.Lock()
+        self.device_endpoint = device_endpoint
         self.sent_chunks = 0
         self.sent_bytes = 0
+        self.device_pages = 0
         self.errors = 0
+
+    def push_device(self, key: str, k_dev, v_dev) -> bool:
+        """Ship a page device->device; the final ACK doubles as the
+        NIXL-style completion handshake (the prefill HTTP response must not
+        return before the consumer holds the KV).
+
+        Two phases: "page_query" asks the consumer to reserve staging budget
+        BEFORE the page is registered with the transfer server — the XLA API
+        has no cancel for await_pull, so a refused offer must never register
+        (a registered-then-unpulled page would pin its device buffers).
+        Returns False so the caller can fall back to a TCP blob push."""
+        if self.device_endpoint is None:
+            return False
+        nbytes = int(k_dev.nbytes) * 2
+        try:
+            with self._lock:
+                hdr, _ = self._client.request(
+                    {"op": "page_query", "key": key, "nbytes": nbytes}
+                )
+                if not hdr.get("ok"):
+                    return False  # staging full / device mode off on peer
+                uuid, shape, dtype = self.device_endpoint.offer(k_dev, v_dev)
+                hdr, _ = self._client.request({
+                    "op": "page_ready", "key": key, "uuid": uuid,
+                    "shape": shape, "dtype": dtype,
+                    "addr": self.device_endpoint.address,
+                })
+            self.device_endpoint.release(uuid)
+            if hdr.get("ok"):
+                self.device_pages += 1
+                return True
+            return False
+        except Exception as e:  # noqa: BLE001
+            self.errors += 1
+            logger.warning("device kv offer failed: %s", e)
+            return False
 
     def push(self, key: str, blob: bytes) -> bool:
         with self._lock:
@@ -136,3 +214,163 @@ class KVTransferSender:
 
     def close(self) -> None:
         self._client.close()
+
+
+# -- device-to-device path (co-located prefill/decode slices) -----------------
+
+
+class DeviceKVEndpoint:
+    """One engine's side of the jax device-to-device KV fabric.
+
+    Wraps ``jax.experimental.transfer``: the producer registers page arrays
+    for pull (``offer``); the consumer pulls them straight into its own
+    devices (``pull``) — KV moves device->device over the XLA transfer
+    service (ICI/DCN on TPU pods) with no host serde round trip. This is the
+    stack's NIXL-GPU-direct analogue (reference
+    deployment-vllm-multi.yaml:256-296) for slices that share a host or
+    fabric; the TCP blob path remains the cross-pod fallback.
+    """
+
+    def __init__(self, runner, host: str = "127.0.0.1"):
+        import jax
+        from jax.experimental import transfer
+
+        self.runner = runner
+        client = runner.mesh.devices.flat[0].client
+        self._server = transfer.start_transfer_server(
+            client, f"{host}:0", [f"{host}:0"]
+        )
+        self.address = self._server.address()
+        self._conns: dict = {}
+        self._offered: dict[int, tuple] = {}  # uuid -> arrays (kept alive)
+        self._uuid = 0
+        self._lock = threading.Lock()
+        self.offered_pages = 0
+        self.pulled_pages = 0
+
+    def offer(self, k_dev, v_dev) -> tuple[int, list, list]:
+        """Register a page's device K/V for remote pull. Returns
+        (uuid, shape, dtype-name); the arrays stay referenced until
+        ``release``."""
+        with self._lock:
+            uuid = self._uuid
+            self._uuid += 1
+            self._offered[uuid] = (k_dev, v_dev)
+        self._server.await_pull(uuid, [k_dev, v_dev])
+        self.offered_pages += 1
+        return uuid, list(k_dev.shape), str(k_dev.dtype)
+
+    def release(self, uuid: int) -> None:
+        with self._lock:
+            self._offered.pop(uuid, None)
+
+    def pull(self, addr: str, uuid: int, shape, dtype):
+        """Pull a page's (k, v) device arrays from the producer at ``addr``."""
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is None:
+                conn = self._server.connect(addr)
+                self._conns[addr] = conn
+        dev = self.runner.mesh.devices.flat[0]
+        sds = jax.ShapeDtypeStruct(
+            tuple(shape), jnp.dtype(dtype),
+            sharding=jax.sharding.SingleDeviceSharding(dev),
+        )
+        k_dev, v_dev = conn.pull(uuid, [sds, sds])
+        self.pulled_pages += 1
+        return k_dev, v_dev
+
+    def close(self) -> None:
+        """Drop connections and any still-offered arrays. The XLA API has no
+        transfer-server shutdown; releasing the Python references lets the
+        server object (and its device buffers) be collected with us."""
+        with self._lock:
+            self._conns.clear()
+            self._offered.clear()
+        self._server = None
+
+
+class DeviceStaging:
+    """Consumer-side staging for device-pulled pages awaiting admission.
+
+    Pulled pages live on device until the decode request's prefix match
+    injects them into the pool (runner.set_page — a device->device copy).
+    Bounded and self-cleaning: budget is reserved atomically BEFORE the pull
+    (so concurrent producers cannot overcommit), and both reservations and
+    staged pages expire after ``ttl`` seconds — a decode request that never
+    arrives (client abort after prefill) must not pin consumer HBM or wedge
+    the budget into permanent TCP fallback."""
+
+    def __init__(self, max_bytes: int = 1 << 30, ttl: float = 120.0):
+        import time as time_mod
+
+        self._time = time_mod.monotonic
+        self.max_bytes = max_bytes
+        self.ttl = ttl
+        self._pages: dict[str, tuple] = {}      # key -> (k, v, deadline)
+        self._reserved: dict[str, tuple] = {}   # key -> (nbytes, deadline)
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.expired_pages = 0
+
+    def _sweep_locked(self) -> None:
+        now = self._time()
+        for key in [k for k, (_, _, d) in self._pages.items() if d < now]:
+            k_dev, _, _ = self._pages.pop(key)
+            self._bytes -= int(k_dev.nbytes) * 2
+            self.expired_pages += 1
+        for key in [k for k, (_, d) in self._reserved.items() if d < now]:
+            nbytes, _ = self._reserved.pop(key)
+            self._bytes -= nbytes
+
+    def reserve(self, key: str, nbytes: int) -> bool:
+        """Atomically check-and-reserve budget for an incoming page."""
+        with self._lock:
+            self._sweep_locked()
+            if key in self._pages or key in self._reserved:
+                return False  # already staged/in flight
+            if self._bytes + nbytes > self.max_bytes:
+                return False
+            self._reserved[key] = (nbytes, self._time() + self.ttl)
+            self._bytes += nbytes
+            return True
+
+    def unreserve(self, key: str) -> None:
+        with self._lock:
+            res = self._reserved.pop(key, None)
+            if res is not None:
+                self._bytes -= res[0]
+
+    def put(self, key: str, k_dev, v_dev) -> None:
+        """Convert a reservation into a staged page (sizes may differ from
+        the reserved estimate; the delta is accounted)."""
+        with self._lock:
+            res = self._reserved.pop(key, None)
+            if res is not None:
+                self._bytes -= res[0]
+            if key not in self._pages:
+                self._pages[key] = (k_dev, v_dev, self._time() + self.ttl)
+                self._bytes += int(k_dev.nbytes) * 2
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            self._sweep_locked()
+            return key in self._pages
+
+    def pop(self, key: str):
+        with self._lock:
+            entry = self._pages.pop(key, None)
+            if entry is None:
+                return None
+            k_dev, v_dev, _ = entry
+            self._bytes -= int(k_dev.nbytes) * 2
+            return (k_dev, v_dev)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._pages.clear()
+            self._reserved.clear()
+            self._bytes = 0
