@@ -1,0 +1,229 @@
+#!/usr/bin/env python3
+"""Dependency-free docs-site builder.
+
+The reference ships a Sphinx/RTD site (/root/reference docs/ — conf.py,
+getting_started/, user_manual/, dev_guide/); this environment has no Sphinx,
+so a small stdlib generator renders the same curriculum from Markdown:
+``docs/*.md`` (handbook pages) plus every ``tutorials/*.md`` into
+``docs/_build/`` with a navigation sidebar.
+
+Usage: python docs/build.py [--out docs/_build]
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import re
+from pathlib import Path
+
+DOCS = Path(__file__).resolve().parent
+REPO = DOCS.parent
+
+PAGE = """<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{title} — production-stack-tpu</title>
+<style>
+body {{ margin: 0; font: 16px/1.6 system-ui, sans-serif; color: #1a1a24; }}
+a {{ color: #0b57d0; text-decoration: none; }} a:hover {{ text-decoration: underline; }}
+.layout {{ display: flex; min-height: 100vh; }}
+nav {{ width: 270px; flex: none; background: #f4f5f7; padding: 24px 16px;
+      border-right: 1px solid #e0e0e6; }}
+nav h2 {{ font-size: 13px; text-transform: uppercase; letter-spacing: .08em;
+         color: #5a5a66; margin: 18px 0 6px; }}
+nav a {{ display: block; padding: 3px 8px; border-radius: 6px; color: #1a1a24;
+        font-size: 14px; }}
+nav a.active, nav a:hover {{ background: #e3e8f4; text-decoration: none; }}
+main {{ flex: 1; max-width: 860px; padding: 32px 48px; }}
+pre {{ background: #f6f8fa; border: 1px solid #e0e0e6; border-radius: 8px;
+      padding: 12px 16px; overflow-x: auto; font-size: 13.5px; }}
+code {{ background: #f2f2f5; border-radius: 4px; padding: 1px 5px;
+       font-size: .92em; }}
+pre code {{ background: none; padding: 0; }}
+table {{ border-collapse: collapse; margin: 12px 0; }}
+th, td {{ border: 1px solid #d8d8e0; padding: 6px 12px; text-align: left;
+         font-size: 14.5px; }}
+th {{ background: #f4f5f7; }}
+h1, h2, h3 {{ line-height: 1.25; }}
+blockquote {{ border-left: 4px solid #c9d4ee; margin: 12px 0; padding: 2px 16px;
+             color: #44444e; }}
+</style></head>
+<body><div class="layout">
+<nav>{nav}</nav>
+<main>{body}</main>
+</div></body></html>
+"""
+
+
+def md_to_html(text: str) -> str:
+    """Small Markdown subset: headings, fenced code, lists, tables, links,
+    bold/italic/inline code, paragraphs. Enough for this repo's docs."""
+    out: list[str] = []
+    lines = text.split("\n")
+    i = 0
+    in_list = None  # "ul" | "ol"
+
+    def close_list():
+        nonlocal in_list
+        if in_list:
+            out.append(f"</{in_list}>")
+            in_list = None
+
+    def inline(s: str) -> str:
+        s = html.escape(s, quote=False)
+        s = re.sub(r"`([^`]+)`", r"<code>\1</code>", s)
+        s = re.sub(r"\*\*([^*]+)\*\*", r"<strong>\1</strong>", s)
+        s = re.sub(r"(?<!\w)\*([^*\n]+)\*(?!\w)", r"<em>\1</em>", s)
+        # [text](url) — rewrite .md targets to .html
+        def link(m):
+            label, url = m.group(1), m.group(2)
+            url = re.sub(r"\.md(#[^)]*)?$", r".html\1", url)
+            return f'<a href="{url}">{label}</a>'
+        return re.sub(r"\[([^\]]+)\]\(([^)\s]+)\)", link, s)
+
+    while i < len(lines):
+        line = lines[i]
+        if line.startswith("```"):
+            close_list()
+            block: list[str] = []
+            i += 1
+            while i < len(lines) and not lines[i].startswith("```"):
+                block.append(lines[i])
+                i += 1
+            out.append("<pre><code>" + html.escape("\n".join(block)) + "</code></pre>")
+            i += 1
+            continue
+        m = re.match(r"^(#{1,4})\s+(.*)$", line)
+        if m:
+            close_list()
+            lvl = len(m.group(1))
+            out.append(f"<h{lvl}>{inline(m.group(2))}</h{lvl}>")
+            i += 1
+            continue
+        if re.match(r"^\s*\|.*\|\s*$", line):
+            close_list()
+            rows = []
+            while i < len(lines) and re.match(r"^\s*\|.*\|\s*$", lines[i]):
+                rows.append([c.strip() for c in lines[i].strip().strip("|").split("|")])
+                i += 1
+            out.append("<table>")
+            header = True
+            for row in rows:
+                if all(re.fullmatch(r":?-{2,}:?", c) for c in row):
+                    header = False
+                    continue
+                tag = "th" if header else "td"
+                out.append(
+                    "<tr>" + "".join(f"<{tag}>{inline(c)}</{tag}>" for c in row) + "</tr>"
+                )
+                header = False
+            out.append("</table>")
+            continue
+        m = re.match(r"^\s*[-*]\s+(.*)$", line)
+        if m:
+            if in_list != "ul":
+                close_list()
+                out.append("<ul>")
+                in_list = "ul"
+            # absorb continuation lines (indented, non-list)
+            item = [m.group(1)]
+            while (
+                i + 1 < len(lines)
+                and lines[i + 1].startswith("  ")
+                and not re.match(r"^\s*[-*]\s+", lines[i + 1])
+            ):
+                i += 1
+                item.append(lines[i].strip())
+            out.append(f"<li>{inline(' '.join(item))}</li>")
+            i += 1
+            continue
+        m = re.match(r"^\s*\d+\.\s+(.*)$", line)
+        if m:
+            if in_list != "ol":
+                close_list()
+                out.append("<ol>")
+                in_list = "ol"
+            out.append(f"<li>{inline(m.group(1))}</li>")
+            i += 1
+            continue
+        if line.startswith(">"):
+            close_list()
+            out.append(f"<blockquote>{inline(line.lstrip('> '))}</blockquote>")
+            i += 1
+            continue
+        if not line.strip():
+            close_list()
+            i += 1
+            continue
+        close_list()
+        para = [line]
+        while i + 1 < len(lines) and lines[i + 1].strip() and not re.match(
+            r"^(#{1,4}\s|```|\s*[-*]\s|\s*\d+\.\s|\s*\|.*\||>)", lines[i + 1]
+        ):
+            i += 1
+            para.append(lines[i])
+        out.append(f"<p>{inline(' '.join(para))}</p>")
+        i += 1
+    close_list()
+    return "\n".join(out)
+
+
+def page_title(md: str, fallback: str) -> str:
+    m = re.search(r"^#\s+(.*)$", md, re.M)
+    return m.group(1).strip() if m else fallback
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(DOCS / "_build"))
+    args = ap.parse_args()
+    out_dir = Path(args.out)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    order = ["index", "getting-started", "user-manual", "deployment",
+             "benchmarking", "developer-guide"]
+    handbook = sorted(
+        DOCS.glob("*.md"),
+        key=lambda p: (order.index(p.stem) if p.stem in order else 99, p.stem),
+    )
+    tutorials = sorted((REPO / "tutorials").glob("*.md"))
+    pages = [(p, p.stem + ".html") for p in handbook] + [
+        (p, "tutorial-" + p.stem + ".html") for p in tutorials
+    ]
+    titles = {
+        out_name: page_title(p.read_text(), p.stem) for p, out_name in pages
+    }
+
+    def nav_html(active: str) -> str:
+        parts = ["<h2>Handbook</h2>"]
+        for p, name in pages[: len(handbook)]:
+            cls = ' class="active"' if name == active else ""
+            parts.append(f'<a href="{name}"{cls}>{titles[name]}</a>')
+        parts.append("<h2>Tutorials</h2>")
+        for p, name in pages[len(handbook):]:
+            cls = ' class="active"' if name == active else ""
+            parts.append(f'<a href="{name}"{cls}>{titles[name]}</a>')
+        return "\n".join(parts)
+
+    for p, name in pages:
+        md = p.read_text()
+        if name.startswith("tutorial-"):
+            # tutorial cross-links are tutorial-<n>-*.html in the built site
+            md = re.sub(r"\]\((\d{2}-[^)]+)\.md\)", r"](tutorial-\1.html)", md)
+        body = md_to_html(md)
+        (out_dir / name).write_text(
+            PAGE.format(title=titles[name], nav=nav_html(name), body=body)
+        )
+    # index.html = the handbook landing page
+    if (out_dir / "index.html").exists() or handbook:
+        first = handbook[0].stem + ".html" if handbook else pages[0][1]
+        if first != "index.html":
+            (out_dir / "index.html").write_text(
+                (out_dir / first).read_text()
+            )
+    print(f"built {len(pages)} pages -> {out_dir}")
+
+
+if __name__ == "__main__":
+    main()

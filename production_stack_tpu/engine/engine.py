@@ -534,10 +534,10 @@ class LLMEngine:
                 continue
             key = h.hex()
             if self._kv_sender.device_endpoint is not None:
-                # device->device: slice the page on device and offer it for
-                # pull — no host fetch, no serde (ICI/DCN carries the bytes)
-                k_dev = self.runner.k_pages[:, pid]
-                v_dev = self.runner.v_pages[:, pid]
+                # device->device: gather the page to a single device (ICI;
+                # pools may be tp-sharded) and offer it for pull — no host
+                # fetch, no serde
+                k_dev, v_dev = self.runner.get_page_device(pid)
                 if self._kv_sender.push_device(key, k_dev, v_dev):
                     continue
                 # refused (staging full / pull failed): TCP blob fallback

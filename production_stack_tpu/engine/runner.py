@@ -470,18 +470,33 @@ class ModelRunner:
         """Fetch one page's K/V to host ([L, page_size, KH, D] each)."""
         return jax.device_get((self.k_pages[:, pid], self.v_pages[:, pid]))
 
+    def get_page_device(self, pid: int):
+        """One page's K/V as SINGLE-DEVICE arrays (device 0), for the
+        device-to-device transfer path: the pool may be kv-head-sharded over
+        tp, but the XLA transfer service pulls whole single-shard buffers —
+        the gather rides ICI, never the host."""
+        sh = jax.sharding.SingleDeviceSharding(self.mesh.devices.flat[0])
+        return (
+            jax.device_put(self.k_pages[:, pid], sh),
+            jax.device_put(self.v_pages[:, pid], sh),
+        )
+
     def set_page(self, pid: int, k, v) -> None:
         """Write one page's K/V into the pools in place (offload restore /
-        disaggregated-prefill KV injection)."""
+        disaggregated-prefill KV injection). Accepts host arrays or device
+        arrays from another mesh/device (device-to-device transfer staging) —
+        those reshard onto this runner's mesh first, device-side."""
         if self._set_page_fn is None:
             self._set_page_fn = jax.jit(
                 lambda kp, vp, i, k, v: (kp.at[:, i].set(k), vp.at[:, i].set(v)),
                 donate_argnums=(0, 1),
             )
         dt = self.k_pages.dtype
+        rep = self._rep  # replicated over this runner's mesh
+        k = jax.device_put(jnp.asarray(k, dt), rep)
+        v = jax.device_put(jnp.asarray(v, dt), rep)
         self.k_pages, self.v_pages = self._set_page_fn(
-            self.k_pages, self.v_pages, jnp.int32(pid),
-            jnp.asarray(k, dt), jnp.asarray(v, dt),
+            self.k_pages, self.v_pages, jnp.int32(pid), k, v,
         )
 
     def reset_kv(self) -> None:

@@ -78,12 +78,16 @@ class KVTransferReceiver:
                     # device path phase 1: atomically reserve staging budget
                     # so the producer registers the page with its transfer
                     # server only once a pull is guaranteed to be attempted
-                    ok = (
-                        self.device_endpoint is not None
-                        and self.staging is not None
-                        and self.staging.reserve(hdr["key"], int(hdr["nbytes"]))
-                    )
-                    await write_frame(writer, {"ok": bool(ok)})
+                    if self.device_endpoint is None or self.staging is None:
+                        await write_frame(writer, {"ok": False})
+                    else:
+                        verdict = self.staging.reserve(
+                            hdr["key"], int(hdr["nbytes"])
+                        )
+                        await write_frame(writer, {
+                            "ok": verdict == "reserved",
+                            "have": verdict == "have",
+                        })
                 elif op == "page_ready":
                     # device path phase 2: pull the registered page
                     # device->device and stage it for admission
@@ -175,11 +179,17 @@ class KVTransferSender:
         if self.device_endpoint is None:
             return False
         nbytes = int(k_dev.nbytes) * 2
+        uuid = None
         try:
             with self._lock:
                 hdr, _ = self._client.request(
                     {"op": "page_query", "key": key, "nbytes": nbytes}
                 )
+                if hdr.get("have"):
+                    # consumer already holds/is pulling this page (shared
+                    # prefix) — nothing to ship, and no TCP fallback either
+                    self.device_pages += 1
+                    return True
                 if not hdr.get("ok"):
                     return False  # staging full / device mode off on peer
                 uuid, shape, dtype = self.device_endpoint.offer(k_dev, v_dev)
@@ -188,8 +198,10 @@ class KVTransferSender:
                     "shape": shape, "dtype": dtype,
                     "addr": self.device_endpoint.address,
                 })
-            self.device_endpoint.release(uuid)
-            if hdr.get("ok"):
+            ok = bool(hdr.get("ok"))
+            self.device_endpoint.release(uuid, pulled=ok)
+            uuid = None
+            if ok:
                 self.device_pages += 1
                 return True
             return False
@@ -197,6 +209,9 @@ class KVTransferSender:
             self.errors += 1
             logger.warning("device kv offer failed: %s", e)
             return False
+        finally:
+            if uuid is not None:
+                self.device_endpoint.release(uuid, pulled=False)
 
     def push(self, key: str, blob: bytes) -> bool:
         with self._lock:
@@ -247,6 +262,7 @@ class DeviceKVEndpoint:
         self._lock = threading.Lock()
         self.offered_pages = 0
         self.pulled_pages = 0
+        self.leaked_offers = 0
 
     def offer(self, k_dev, v_dev) -> tuple[int, list, list]:
         """Register a page's device K/V for remote pull. Returns
@@ -260,9 +276,20 @@ class DeviceKVEndpoint:
         self.offered_pages += 1
         return uuid, list(k_dev.shape), str(k_dev.dtype)
 
-    def release(self, uuid: int) -> None:
+    def release(self, uuid: int, pulled: bool = True) -> None:
+        """Drop our reference to an offered page. LIMITATION: the XLA API has
+        no await_pull cancel, so if the peer never pulled, the transfer
+        server's own registration (and the page's device buffers) persist
+        until this endpoint is closed — tracked in ``leaked_offers`` and
+        bounded in practice because offers only outlive their pull on
+        transient pull errors (refusals never register; see push_device)."""
         with self._lock:
-            self._offered.pop(uuid, None)
+            if self._offered.pop(uuid, None) is not None and not pulled:
+                self.leaked_offers += 1
+                logger.warning(
+                    "unpulled transfer offer %d leaks one page of device "
+                    "memory until shutdown (%d total)", uuid, self.leaked_offers,
+                )
 
     def pull(self, addr: str, uuid: int, shape, dtype):
         """Pull a page's (k, v) device arrays from the producer at ``addr``."""
@@ -326,17 +353,19 @@ class DeviceStaging:
             nbytes, _ = self._reserved.pop(key)
             self._bytes -= nbytes
 
-    def reserve(self, key: str, nbytes: int) -> bool:
-        """Atomically check-and-reserve budget for an incoming page."""
+    def reserve(self, key: str, nbytes: int) -> str:
+        """Atomically check-and-reserve budget for an incoming page.
+        Returns "reserved", "have" (already staged/in flight — the producer
+        can skip the page entirely), or "full"."""
         with self._lock:
             self._sweep_locked()
             if key in self._pages or key in self._reserved:
-                return False  # already staged/in flight
+                return "have"
             if self._bytes + nbytes > self.max_bytes:
-                return False
+                return "full"
             self._reserved[key] = (nbytes, self._time() + self.ttl)
             self._bytes += nbytes
-            return True
+            return "reserved"
 
     def unreserve(self, key: str) -> None:
         with self._lock:

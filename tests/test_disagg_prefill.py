@@ -136,3 +136,38 @@ class TestDisaggPrefillDeviceTransfer:
         finally:
             mono.stop()
         assert toks == expected
+
+    def test_device_transfer_with_tp_mesh(self):
+        """tp-sharded pools: the producer gathers pages to a single device
+        before offering (ICI, not host) and the consumer reshards on
+        injection — the device path must work on the meshes it targets."""
+        consumer = LLMEngine(
+            _base(kv_role="consumer", kv_transfer_port=0, port=8321,
+                  kv_transfer_device=True, tensor_parallel_size=2)
+        )
+        consumer.start()
+        producer = LLMEngine(
+            _base(kv_role="producer", port=8320, kv_transfer_device=True,
+                  tensor_parallel_size=2,
+                  kv_peer_url=f"127.0.0.1:{consumer._kv_receiver.bound_port}")
+        )
+        producer.start()
+        try:
+            if producer._kv_sender.device_endpoint is None:
+                pytest.skip("transfer service unavailable")
+            prompt = "pages sharded over tensor parallel ranks " * 4
+            _run(producer, prompt, "pdt-1", 1)
+            assert producer._kv_sender.device_pages > 0
+            assert producer._kv_sender.sent_chunks == 0
+            toks = _run(consumer, prompt, "pdt-2", 8)
+            assert consumer._offload.device_loaded_pages > 0
+            mono = LLMEngine(_base(port=8322, tensor_parallel_size=2))
+            mono.start()
+            try:
+                expected = _run(mono, prompt, "mono-t", 8)
+            finally:
+                mono.stop()
+            assert toks == expected
+        finally:
+            producer.stop()
+            consumer.stop()

@@ -1,0 +1,26 @@
+"""Docs site build (reference parity: docs/ Sphinx site — here a stdlib
+generator over docs/*.md + tutorials/*.md)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_docs_build(tmp_path):
+    out = subprocess.run(
+        [sys.executable, str(REPO / "docs" / "build.py"), "--out", str(tmp_path)],
+        check=True, capture_output=True, text=True, cwd=REPO,
+    )
+    assert "built" in out.stdout
+    pages = list(tmp_path.glob("*.html"))
+    # 6 handbook pages + every tutorial + index alias
+    tutorials = list((REPO / "tutorials").glob("*.md"))
+    assert len(pages) >= 6 + len(tutorials)
+    index = (tmp_path / "index.html").read_text()
+    assert "<nav>" in index and "Tutorials" in index
+    um = (tmp_path / "user-manual.html").read_text()
+    assert "<table>" in um and "--pipeline-parallel-size" in um
+    # markdown links rewrote to .html
+    assert 'href="getting-started.html"' in index
