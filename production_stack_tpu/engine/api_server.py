@@ -780,8 +780,10 @@ def _init_multihost(cfg: EngineConfig) -> int:
         raise ValueError("KV offload tiers are not supported in multi-host mode")
     if cfg.enable_sleep_mode:
         raise ValueError("sleep mode is not supported in multi-host mode")
-    if cfg.enable_lora:
-        raise ValueError("LoRA serving is not supported in multi-host mode yet")
+    # LoRA works multi-host: the leader parses adapter checkpoints and the
+    # resulting set_lora_slot/clear_lora_slot device writes are REPLICATED
+    # dispatches — followers receive the weights over the step stream, so
+    # adapters need no shared filesystem.
     if cfg.kv_role != "none":
         raise ValueError("disaggregated prefill is not supported in multi-host mode")
     pid = _resolve_process_id(cfg)

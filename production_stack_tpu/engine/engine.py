@@ -534,11 +534,16 @@ class LLMEngine:
                 continue
             key = h.hex()
             if self._kv_sender.device_endpoint is not None:
-                # device->device: gather the page to a single device (ICI;
-                # pools may be tp-sharded) and offer it for pull — no host
-                # fetch, no serde
-                k_dev, v_dev = self.runner.get_page_device(pid)
-                if self._kv_sender.push_device(key, k_dev, v_dev):
+                # device->device: nbytes from pool metadata only; the
+                # single-device gather (ICI; pools may be tp-sharded) runs
+                # inside push_device AFTER the consumer accepts — refusals
+                # cost no device work
+                kp = self.runner.k_pages
+                page_nbytes = 2 * (kp.nbytes // kp.shape[1])
+                if self._kv_sender.push_device(
+                    key, page_nbytes,
+                    lambda pid=pid: self.runner.get_page_device(pid),
+                ):
                     continue
                 # refused (staging full / pull failed): TCP blob fallback
             blob = None

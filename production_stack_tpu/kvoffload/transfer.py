@@ -164,21 +164,23 @@ class KVTransferSender:
         self.sent_chunks = 0
         self.sent_bytes = 0
         self.device_pages = 0
+        self.skipped_pages = 0
         self.errors = 0
 
-    def push_device(self, key: str, k_dev, v_dev) -> bool:
+    def push_device(self, key: str, nbytes: int, make_arrays) -> bool:
         """Ship a page device->device; the final ACK doubles as the
         NIXL-style completion handshake (the prefill HTTP response must not
         return before the consumer holds the KV).
 
         Two phases: "page_query" asks the consumer to reserve staging budget
-        BEFORE the page is registered with the transfer server — the XLA API
-        has no cancel for await_pull, so a refused offer must never register
-        (a registered-then-unpulled page would pin its device buffers).
+        BEFORE anything is gathered or registered — the XLA API has no cancel
+        for await_pull, so a refused offer must never register (a
+        registered-then-unpulled page would pin its device buffers), and
+        ``make_arrays()`` (the producer's single-device page gather) only
+        runs once the consumer has said yes.
         Returns False so the caller can fall back to a TCP blob push."""
         if self.device_endpoint is None:
             return False
-        nbytes = int(k_dev.nbytes) * 2
         uuid = None
         try:
             with self._lock:
@@ -186,12 +188,13 @@ class KVTransferSender:
                     {"op": "page_query", "key": key, "nbytes": nbytes}
                 )
                 if hdr.get("have"):
-                    # consumer already holds/is pulling this page (shared
-                    # prefix) — nothing to ship, and no TCP fallback either
-                    self.device_pages += 1
+                    # consumer already STAGED this page (shared prefix) —
+                    # nothing to ship, and no TCP fallback either
+                    self.skipped_pages += 1
                     return True
                 if not hdr.get("ok"):
                     return False  # staging full / device mode off on peer
+                k_dev, v_dev = make_arrays()
                 uuid, shape, dtype = self.device_endpoint.offer(k_dev, v_dev)
                 hdr, _ = self._client.request({
                     "op": "page_ready", "key": key, "uuid": uuid,
@@ -359,8 +362,13 @@ class DeviceStaging:
         can skip the page entirely), or "full"."""
         with self._lock:
             self._sweep_locked()
-            if key in self._pages or key in self._reserved:
-                return "have"
+            if key in self._pages:
+                return "have"  # staged and ready for admission
+            if key in self._reserved:
+                # an in-flight reservation may never complete (producer died
+                # mid-handshake); do NOT claim we have it — the producer must
+                # keep its TCP fallback for this page
+                return "full"
             if self._bytes + nbytes > self.max_bytes:
                 return "full"
             self._reserved[key] = (nbytes, self._time() + self.ttl)

@@ -181,6 +181,7 @@ cfg = EngineConfig(
     distributed_coordinator="127.0.0.1:{coord_port}",
     distributed_num_processes=2, distributed_process_id={pid},
     worker_sync_port={sync_port},
+    enable_lora=True, max_loras=2, max_lora_rank=8,
 )
 
 async def run():
@@ -237,13 +238,60 @@ def test_two_process_serving_e2e():
                     body = json.loads(r.read())
                 assert body["usage"]["completion_tokens"] == 4
                 assert body["choices"][0]["text"] is not None
-                return
+                break  # LoRA roundtrip runs OUTSIDE the retry loop: a
+                # transient error after the adapter loads must not retry
+                # the (non-idempotent) load until the deadline
             except (ConnectionError, OSError, TimeoutError) as e:
                 last_err = e
                 time.sleep(2.0)
-        pytest.fail(f"leader never served: {last_err}")
+        else:
+            pytest.fail(f"leader never served: {last_err}")
+        _lora_roundtrip(http)
     finally:
         for p in procs:
             p.kill()
         for p in procs:
             p.wait(timeout=30)
+
+
+def _lora_roundtrip(http_port: int) -> None:
+    """Multi-host LoRA: the leader parses the adapter; set_lora_slot is a
+    REPLICATED dispatch, so followers receive the weights over the step
+    stream and serving with model=<adapter> stays in SPMD lockstep."""
+    import tempfile
+    import urllib.request
+
+    import numpy as np
+
+    from production_stack_tpu.engine.lora import save_peft_adapter
+    from production_stack_tpu.models import llama
+
+    cfg = llama.PRESETS["llama-debug"]
+    rng = np.random.RandomState(5)
+    rank = 4
+    dims = llama.lora_dims(cfg)
+    tensors = {}
+    for tgt in ("wq", "wv"):
+        din, dout = dims[tgt]
+        tensors[tgt] = (
+            0.2 * rng.randn(cfg.num_layers, rank, din),   # PEFT [r, in]
+            0.2 * rng.randn(cfg.num_layers, dout, rank),  # PEFT [out, r]
+        )
+    path = tempfile.mkdtemp(prefix="mh-lora-")
+    save_peft_adapter(path, cfg, rank, 8.0, tensors)
+
+    def post(url_path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http_port}{url_path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    post("/v1/load_lora_adapter", {"lora_name": "mh-lora", "lora_path": path})
+    body = post("/v1/completions", {
+        "model": "mh-lora", "prompt": "multi host adapters",
+        "max_tokens": 3, "temperature": 0.0,
+    })
+    assert body["usage"]["completion_tokens"] == 3
