@@ -677,14 +677,23 @@ class EngineServer:
     async def sleep(self, request: web.Request) -> web.Response:
         if not self.cfg.enable_sleep_mode:
             return web.json_response({"error": "sleep mode disabled"}, status=400)
-        level = int(request.query.get("level", "1"))
-        self.engine.sleep(level)
+        try:
+            level = int(request.query.get("level", "1"))
+            # executor: sleep waits for the device thread (an in-flight step
+            # must drain first) — the event loop must keep serving probes
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.engine.sleep, level
+            )
+        except ValueError as e:  # bad level param / level 2 in multi-host
+            return web.json_response({"error": str(e)}, status=400)
         return web.Response(text="")
 
     async def wake_up(self, request: web.Request) -> web.Response:
         if not self.cfg.enable_sleep_mode:
             return web.json_response({"error": "sleep mode disabled"}, status=400)
-        self.engine.wake_up()
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.wake_up
+        )
         return web.Response(text="")
 
     async def is_sleeping(self, request: web.Request) -> web.Response:
@@ -778,8 +787,10 @@ def _init_multihost(cfg: EngineConfig) -> int:
         )
     if cfg.kv_offload_cpu_gb > 0 or cfg.kv_offload_dir or cfg.kv_remote_url:
         raise ValueError("KV offload tiers are not supported in multi-host mode")
-    if cfg.enable_sleep_mode:
-        raise ValueError("sleep mode is not supported in multi-host mode")
+    # sleep mode works multi-host at level 1: drop_kv_pools/reset_kv are
+    # replicated dispatches, so followers free and re-create their pool
+    # shards in lockstep (level 2 is rejected at request time: each process
+    # can only fetch its own param shards).
     # LoRA works multi-host: the leader parses adapter checkpoints and the
     # resulting set_lora_slot/clear_lora_slot device writes are REPLICATED
     # dispatches — followers receive the weights over the step stream, so
@@ -827,6 +838,11 @@ async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
             cfg.worker_sync_port, cfg.distributed_num_processes - 1
         )
         engine.runner = BroadcastingRunner(engine.runner, bc)
+        if engine.lora is not None:
+            # LoRAManager captured the raw runner at engine construction;
+            # re-point it at the wrapper or set_lora_slot/clear_lora_slot
+            # would bypass replication and followers would keep zero slots
+            engine.lora.runner = engine.runner
     server = EngineServer(cfg, engine)
     server.engine.start()
     app = server.build_app()

@@ -796,42 +796,62 @@ class LLMEngine:
     def sleep(self, level: int = 1) -> None:
         """Free HBM without killing the process. Level 1 drops the KV pools;
         level 2 additionally moves weights to host DRAM (SURVEY.md §7 hard
-        part #5)."""
+        part #5). Runs on the device thread, serialized with steps."""
         if self._sleeping:
             return
-        self._sleeping = True
-        self._sleep_level = level
-        for s in list(self.scheduler.running) + list(self.scheduler.waiting):
-            self.scheduler._finish(s, "abort")
-            self._emit(s, "")
-        self.runner.k_pages = None
-        self.runner.v_pages = None
-        if level >= 2:
-            import jax
+        if level >= 2 and self.cfg.distributed_num_processes > 1:
+            raise ValueError(
+                "sleep level 2 is not supported in multi-host mode (each "
+                "process can only fetch its own param shards); use level 1"
+            )
 
-            self._saved_params = jax.device_get(self.runner.params)
-            self.runner.params = None
-        import gc
+        def do_sleep():
+            if self._sleeping:
+                return  # raced with a concurrent sleep (handlers run on
+                        # executor threads; only the device thread is serial)
+            self._sleeping = True
+            self._sleep_level = level
+            for s in list(self.scheduler.running) + list(self.scheduler.waiting):
+                self.scheduler._finish(s, "abort")
+                self._emit(s, "")
+            # replicated in multi-host: followers drop their pool shards too
+            self.runner.drop_kv_pools()
+            if level >= 2:
+                import jax
 
-        gc.collect()
+                self._saved_params = jax.device_get(self.runner.params)
+                self.runner.params = None
+            import gc
+
+            gc.collect()
+
+        self._run_on_device_thread(do_sleep, what="sleep")
 
     def wake_up(self) -> None:
         if not self._sleeping:
             return
-        if self._sleep_level >= 2 and self._saved_params is not None:
-            from production_stack_tpu.parallel import shardings
 
-            pspecs = shardings.param_specs_for(self._saved_params)
-            self.runner.params = shardings.shard_tree(
-                self._saved_params, pspecs, self.runner.mesh
+        def do_wake():
+            if not self._sleeping:
+                return  # raced with a concurrent wake
+            if self._sleep_level >= 2 and self._saved_params is not None:
+                from production_stack_tpu.parallel import shardings
+
+                pspecs = shardings.param_specs_for(
+                    self._saved_params, pp=self.runner._pp > 1
+                )
+                self.runner.params = shardings.shard_tree(
+                    self._saved_params, pspecs, self.runner.mesh
+                )
+                self._saved_params = None
+            self.runner.reset_kv()  # replicated in multi-host
+            self.kv = KVPageManager(
+                self.kv.num_pages, self.kv.page_size, offload=self._offload
             )
-            self._saved_params = None
-        self.runner.reset_kv()
-        self.kv = KVPageManager(
-            self.kv.num_pages, self.kv.page_size, offload=self._offload
-        )
-        self.scheduler.kv = self.kv
-        self._sleeping = False
+            self.scheduler.kv = self.kv
+            self._sleeping = False
+
+        self._run_on_device_thread(do_wake, what="wake_up")
 
     @property
     def is_sleeping(self) -> bool:

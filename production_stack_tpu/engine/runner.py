@@ -129,10 +129,7 @@ class ModelRunner:
         pspecs = shardings.param_specs_for(params, pp=self._pp > 1)
         self.params = shardings.shard_tree(params, pspecs, self.mesh)
         kp, vp = self.module.init_kv_pages(cfg, num_pages, page_size)
-        kv_sh = NamedSharding(
-            self.mesh,
-            shardings.KV_PAGES_SPEC_PP if self._pp > 1 else shardings.KV_PAGES_SPEC,
-        )
+        kv_sh = self._kv_sharding()
         self.k_pages = jax.device_put(kp, kv_sh)
         self.v_pages = jax.device_put(vp, kv_sh)
         self._rng = jax.random.key(seed)
@@ -499,10 +496,22 @@ class ModelRunner:
             self.k_pages, self.v_pages, jnp.int32(pid), k, v,
         )
 
+    def _kv_sharding(self) -> NamedSharding:
+        """Pool sharding for this mesh (pp shards the layer axis)."""
+        return NamedSharding(
+            self.mesh,
+            shardings.KV_PAGES_SPEC_PP if self._pp > 1 else shardings.KV_PAGES_SPEC,
+        )
+
+    def drop_kv_pools(self) -> None:
+        """Release the KV pools' device memory (sleep level 1+)."""
+        self.k_pages = None
+        self.v_pages = None
+
     def reset_kv(self) -> None:
         """Zero the page pools (sleep/wake support frees and re-creates them)."""
         kp, vp = self.module.init_kv_pages(self.cfg, self.num_pages, self.page_size)
-        kv_sh = NamedSharding(self.mesh, shardings.KV_PAGES_SPEC)
+        kv_sh = self._kv_sharding()
         self.k_pages = jax.device_put(kp, kv_sh)
         self.v_pages = jax.device_put(vp, kv_sh)
 

@@ -358,8 +358,10 @@ class DeviceStaging:
 
     def reserve(self, key: str, nbytes: int) -> str:
         """Atomically check-and-reserve budget for an incoming page.
-        Returns "reserved", "have" (already staged/in flight — the producer
-        can skip the page entirely), or "full"."""
+        Returns "reserved", "have" (already STAGED — the producer can skip
+        the page entirely), or "full" (over budget, or an in-flight
+        reservation that may never complete — the producer must keep its
+        TCP fallback)."""
         with self._lock:
             self._sweep_locked()
             if key in self._pages:

@@ -182,6 +182,7 @@ cfg = EngineConfig(
     distributed_num_processes=2, distributed_process_id={pid},
     worker_sync_port={sync_port},
     enable_lora=True, max_loras=2, max_lora_rank=8,
+    enable_sleep_mode=True,
 )
 
 async def run():
@@ -247,11 +248,34 @@ def test_two_process_serving_e2e():
         else:
             pytest.fail(f"leader never served: {last_err}")
         _lora_roundtrip(http)
+        _sleep_wake_roundtrip(http)
+        # prove the control dispatches actually REPLICATED to the follower
+        # (a LoRA load that only lands on the leader would still serve
+        # plausible tokens — the follower's replay marker is the evidence)
+        procs[1].kill()
+        follower_out = procs[1].communicate()[0].decode(errors="replace")
+        for marker in ("follower replayed set_lora_slot",
+                       "follower replayed drop_kv_pools",
+                       "follower replayed reset_kv"):
+            assert marker in follower_out, (marker, follower_out[-3000:])
     finally:
         for p in procs:
             p.kill()
         for p in procs:
             p.wait(timeout=30)
+
+
+def _post_json(http_port: int, url_path: str, payload: dict):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http_port}{url_path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        raw = r.read()
+        return json.loads(raw) if raw else None
 
 
 def _lora_roundtrip(http_port: int) -> None:
@@ -280,18 +304,29 @@ def _lora_roundtrip(http_port: int) -> None:
     path = tempfile.mkdtemp(prefix="mh-lora-")
     save_peft_adapter(path, cfg, rank, 8.0, tensors)
 
-    def post(url_path, payload):
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{http_port}{url_path}",
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=120) as r:
-            return json.loads(r.read())
-
-    post("/v1/load_lora_adapter", {"lora_name": "mh-lora", "lora_path": path})
-    body = post("/v1/completions", {
+    _post_json(http_port, "/v1/load_lora_adapter",
+               {"lora_name": "mh-lora", "lora_path": path})
+    body = _post_json(http_port, "/v1/completions", {
         "model": "mh-lora", "prompt": "multi host adapters",
+        "max_tokens": 3, "temperature": 0.0,
+    })
+    assert body["usage"]["completion_tokens"] == 3
+
+
+def _sleep_wake_roundtrip(http_port: int) -> None:
+    """Multi-host sleep/wake at level 1: drop_kv_pools/reset_kv are
+    replicated, so followers free and re-create their pool shards in
+    lockstep, and serving resumes after wake."""
+    import urllib.request
+
+    _post_json(http_port, "/sleep?level=1", {})
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{http_port}/is_sleeping", timeout=30
+    ) as r:
+        assert json.loads(r.read())["is_sleeping"] is True
+    _post_json(http_port, "/wake_up", {})
+    body = _post_json(http_port, "/v1/completions", {
+        "model": "llama-debug", "prompt": "awake again",
         "max_tokens": 3, "temperature": 0.0,
     })
     assert body["usage"]["completion_tokens"] == 3
