@@ -40,7 +40,7 @@ def main() -> None:
         mod, cfg, params = load_model(model_dir)
         runner_kw = {"params": params, "module": mod}
         model_desc = f"{model_dir} (real weights)"
-        prefill_len, decode_batch, ctx_pages, page_size = 1024, 16, 64, 16
+        prefill_len, decode_batch, ctx_pages, page_size = 1024, 16, 16, 64
         if not on_tpu:
             prefill_len, decode_batch, ctx_pages, page_size = 64, 4, 8, 8
         # respect the checkpoint's context limit: positions past a short
@@ -50,8 +50,8 @@ def main() -> None:
     elif on_tpu:
         cfg = llama.PRESETS["llama-3.2-1b"]
         model_desc = "llama-3.2-1b-class (random weights)"
-        prefill_len, decode_batch, ctx_pages = 1024, 16, 64  # 1024-token contexts
-        page_size = 16
+        prefill_len, decode_batch, ctx_pages = 1024, 16, 16  # 1024-token contexts
+        page_size = 64
     else:  # tiny fallback so the benchmark is runnable anywhere
         cfg = dataclasses.replace(llama.PRESETS["llama-debug"])
         model_desc = "llama-debug (random weights)"
@@ -243,9 +243,13 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         eng_ttfts = [one_request(16, engine_url)[0] * 1000 for _ in range(n_reqs)]
 
         # concurrent batch shapes (decode batch bucket, multi-seq prefill)
-        # compile on first use — warm them up outside the measured window
-        with cf.ThreadPoolExecutor(conc) as ex:
-            list(ex.map(lambda _i: one_request(gen), range(conc)))
+        # compile on first use — warm them up outside the measured window.
+        # Two rounds: ramp-up/down crosses several (batch, pages) buckets,
+        # and any bucket left cold would compile (~20-40s on a tunneled
+        # chip) inside the measured window
+        for _ in range(2):
+            with cf.ThreadPoolExecutor(conc) as ex:
+                list(ex.map(lambda _i: one_request(gen), range(conc)))
         t0 = time.perf_counter()
         with cf.ThreadPoolExecutor(conc) as ex:
             list(ex.map(lambda _i: one_request(gen), range(conc)))

@@ -346,6 +346,13 @@ class LLMEngine:
                 self._inbox_accept(item)
 
     def _inbox_accept(self, seq: Sequence) -> None:
+        if self._sleeping:
+            # a request can pass generate()'s sleeping check on the event loop
+            # just as sleep flips the flag on the device thread; it must be
+            # answered, not parked in the scheduler until wake
+            seq.finished = True
+            self._emit(seq, "", error=True)
+            return
         self.scheduler.add(seq)
 
     def _run_loop(self) -> None:

@@ -283,7 +283,6 @@ def _lora_roundtrip(http_port: int) -> None:
     REPLICATED dispatch, so followers receive the weights over the step
     stream and serving with model=<adapter> stays in SPMD lockstep."""
     import tempfile
-    import urllib.request
 
     import numpy as np
 
