@@ -102,8 +102,10 @@ class UserSession:
         records: list[RequestRecord],
         timeout: float = 120.0,
         conversation: Optional[list[dict]] = None,
+        headers: Optional[dict] = None,
     ):
         self.user_id = user_id
+        self.headers = headers or {}
         self.base_url = base_url.rstrip("/")
         self.model = model
         # ShareGPT mode: questions (and per-answer token budgets) come from a
@@ -142,6 +144,7 @@ class UserSession:
         try:
             async with session.post(
                 f"{self.base_url}/chat/completions",
+                headers=self.headers,
                 json={
                     "model": self.model,
                     "messages": self.messages,
@@ -275,20 +278,47 @@ class UserSessionManager:
         start = time.monotonic()
         async with aiohttp.ClientSession(connector=conn) as session:
             tasks = []
+            log_task = None
+            if a.log_interval:
+                log_task = asyncio.create_task(self._log_progress(a.log_interval))
             for i in range(a.num_users):
+                uid = i + a.init_user_id
+                headers = {}
+                if a.api_key:
+                    headers["Authorization"] = f"Bearer {a.api_key}"
+                if a.request_with_user_id:
+                    headers["x-user-id"] = str(uid)
                 us = UserSession(
-                    i, a.base_url, a.model, shared, users[i],
+                    uid, a.base_url, a.model, shared, users[i],
                     a.num_rounds, a.answer_len, a.round_gap, self.records,
                     timeout=a.request_timeout,
                     conversation=None if convs is None else convs[i % len(convs)],
+                    headers=headers,
                 )
                 tasks.append(asyncio.create_task(us.run(session)))
                 # user arrivals paced at --qps (reference: session launch rate)
                 if a.qps > 0:
                     await asyncio.sleep(1.0 / a.qps)
-            await asyncio.gather(*tasks)
+            try:
+                await asyncio.gather(*tasks)
+            finally:
+                if log_task is not None:
+                    log_task.cancel()
         elapsed = time.monotonic() - start
         return summarize(self.records, elapsed)
+
+    async def _log_progress(self, interval: float) -> None:
+        """Periodic progress line (reference --log-interval summaries)."""
+        import sys
+
+        while True:
+            await asyncio.sleep(interval)
+            done = sum(1 for r in self.records if r.finish_time > 0)
+            print(
+                f"[multi-round-qa] requests: {done} finished, "
+                f"{len(self.records) - done} in flight",
+                file=sys.stderr, flush=True,
+            )
 
     def write_csv(self, path: str) -> None:
         with open(path, "w", newline="") as f:
@@ -311,7 +341,7 @@ class UserSessionManager:
 
 def parse_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser("multi-round-qa")
-    p.add_argument("--base-url", required=True, help="e.g. http://127.0.0.1:8000/v1")
+    p.add_argument("--base-url", help="e.g. http://127.0.0.1:8000/v1 (required unless --process-summary)")
     p.add_argument("--model", default="llama-debug")
     p.add_argument("--qps", type=float, default=1.0, help="user-session launch rate")
     p.add_argument("--num-users", type=int, default=10)
@@ -321,6 +351,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--user-history-len", type=int, default=100, help="words")
     p.add_argument("--round-gap", type=float, default=1.0, help="seconds between rounds")
     p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument("--api-key", default=None, help="Authorization bearer token")
+    p.add_argument("--init-user-id", type=int, default=0,
+                   help="first user id (sweep drivers shard id ranges across runs)")
+    p.add_argument("--request-with-user-id", action="store_true",
+                   help="send x-user-id headers (session-sticky routing benches)")
+    p.add_argument("--log-interval", type=float, default=30.0,
+                   help="seconds between progress log lines (0 = off)")
+    p.add_argument("--process-summary", default=None,
+                   help="recompute the summary from an existing per-request CSV "
+                        "and exit (reference multi-round-qa.py --process-summary)")
     p.add_argument("--sharegpt", default=None,
                    help="preprocessed ShareGPT JSON (data_preprocessing.py); "
                         "questions and per-answer token budgets come from real "
@@ -330,8 +370,37 @@ def parse_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
+def summarize_csv(path: str) -> ProcessSummary:
+    """Recompute the summary from a per-request CSV (reference
+    --process-summary: reprocess an existing run's output)."""
+    records = []
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            rec = RequestRecord(
+                user_id=int(row["user_id"]), round_idx=int(row["round"]),
+                launch_time=float(row["launch_time"]),
+                ttft=float(row["ttft"]),
+                prompt_tokens=int(row["prompt_tokens"]),
+                generation_tokens=int(row["generation_tokens"]),
+                status=row["status"],
+            )
+            rec.finish_time = rec.launch_time + float(row["latency"])
+            records.append(rec)
+    elapsed = (
+        max(r.finish_time for r in records) - min(r.launch_time for r in records)
+        if records else 0.0
+    )
+    return summarize(records, max(elapsed, 1e-9))
+
+
 def main(argv=None) -> ProcessSummary:
     args = parse_args(argv)
+    if args.process_summary:
+        summary = summarize_csv(args.process_summary)
+        print(summary.to_json())
+        return summary
+    if not args.base_url:
+        raise SystemExit("--base-url is required (unless --process-summary)")
     mgr = UserSessionManager(args)
     summary = asyncio.run(mgr.run())
     if args.output:

@@ -16,10 +16,15 @@ python "$(dirname "$0")/multi_round_qa.py" \
     --qps 2.0 --num-users 40 --num-rounds 1 --answer-len 20 \
     --output "$OUT/warmup.csv"
 
+# each sweep point gets a disjoint user-id range (reference run.sh shards
+# ids so per-user histories never collide across runs)
+UID_BASE=1000
 for QPS in 0.1 0.5 0.9 1.3 1.7 2.1 2.5 2.9 3.3 3.7 4.1; do
     echo "=== QPS $QPS ==="
     python "$(dirname "$0")/multi_round_qa.py" \
         --base-url "$BASE_URL" --model "$MODEL" \
         --qps "$QPS" --num-users 32 --num-rounds 10 --answer-len 100 \
+        --init-user-id "$UID_BASE" --request-with-user-id \
         --output "$OUT/qps-$QPS.csv" | tee "$OUT/summary-$QPS.json"
+    UID_BASE=$((UID_BASE + 100))
 done

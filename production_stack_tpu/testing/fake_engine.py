@@ -66,6 +66,10 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         max_tokens = int(body.get("max_tokens", 16))
         stream = bool(body.get("stream", False))
         req_id = request.headers.get("X-Request-Id", uuid.uuid4().hex)
+        uid = request.headers.get("x-user-id")
+        if uid:
+            # visible marker for tests asserting user-id header propagation
+            print(f"x-user-id={uid}", flush=True)
         STATE["running"] += 1
         STATE["total"] += 1
         created = int(time.time())

@@ -9,6 +9,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "benchmarks"))
 
 from production_stack_tpu.testing.procs import free_port, start_proc, stop_proc, wait_healthy
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 
 def test_multi_round_qa_against_fake_engine(tmp_path):
     import multi_round_qa
@@ -111,3 +115,43 @@ def test_sharegpt_mode_against_fake_engine(tmp_path):
         assert gen[(0, 1)] <= 8
     finally:
         stop_proc(proc)
+
+
+def test_user_id_headers_and_summary_reprocess(tmp_path):
+    """--request-with-user-id sends x-user-id (session-sticky benches);
+    --process-summary recomputes metrics from a prior run's CSV."""
+    import csv as csv_mod
+
+    import multi_round_qa
+
+    port = free_port()
+    proc = start_proc(
+        ["-m", "production_stack_tpu.testing.fake_engine",
+         "--port", str(port), "--model", "bench-model", "--speed", "500"]
+    )
+    try:
+        wait_healthy(f"http://127.0.0.1:{port}/health", proc)
+        csv_path = str(tmp_path / "out.csv")
+        summary = multi_round_qa.main(
+            ["--base-url", f"http://127.0.0.1:{port}/v1",
+             "--model", "bench-model", "--qps", "20",
+             "--num-users", "2", "--num-rounds", "2",
+             "--answer-len", "8", "--round-gap", "0.05",
+             "--init-user-id", "100", "--request-with-user-id",
+             "--log-interval", "0", "--output", csv_path]
+        )
+        assert summary.completed == 4
+        with open(csv_path) as f:
+            uids = {int(r["user_id"]) for r in csv_mod.DictReader(f)}
+        assert uids == {100, 101}  # init-user-id offset
+        # the fake engine echoes x-user-id headers it saw to stdout
+        out = stop_proc(proc)
+        assert "x-user-id=100" in out and "x-user-id=101" in out
+
+        # reprocess: summary from CSV matches the live run's counts
+        re_sum = multi_round_qa.main(["--process-summary", csv_path])
+        assert re_sum.completed == summary.completed
+        assert abs(re_sum.avg_ttft - summary.avg_ttft) < 0.05
+    finally:
+        if proc.poll() is None:
+            stop_proc(proc)

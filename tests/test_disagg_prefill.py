@@ -12,6 +12,8 @@ from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.engine import LLMEngine
 from production_stack_tpu.engine.scheduler import SamplingParams
 
+pytestmark = pytest.mark.slow
+
 
 def _base(**kw):
     base = dict(

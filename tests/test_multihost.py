@@ -195,6 +195,7 @@ asyncio.run(run())
 """
 
 
+@pytest.mark.slow
 def test_two_process_serving_e2e():
     """Leader + follower over jax.distributed on CPU: a completion served
     through the leader's HTTP API with the mesh spanning both processes."""

@@ -23,6 +23,8 @@ import pytest
 
 from production_stack_tpu.testing.procs import free_port, start_proc, stop_proc
 
+pytestmark = pytest.mark.slow
+
 REPO = Path(__file__).resolve().parent.parent
 GROUP = "production-stack.tpu.ai"
 VERSION = "v1alpha1"

@@ -16,6 +16,8 @@ import requests
 
 from production_stack_tpu.testing.procs import free_port, start_proc, stop_proc, wait_healthy
 
+pytestmark = pytest.mark.slow
+
 WORDS = [
     "the", "cat", "sat", "on", "a", "mat", "dog", "ran", "fast", "slow",
     "red", "blue", "sun", "moon", "star", "sky", "tree", "rock", "fish",

@@ -12,6 +12,8 @@ import requests
 
 from production_stack_tpu.testing.procs import free_port, start_proc, stop_proc, wait_healthy
 
+pytestmark = pytest.mark.slow
+
 ROUTE_RE = re.compile(r"Routing request (\S+) for model (\S+) to (\S+) at")
 
 
