@@ -390,7 +390,7 @@ def summarize_csv(path: str) -> ProcessSummary:
         max(r.finish_time for r in records) - min(r.launch_time for r in records)
         if records else 0.0
     )
-    return summarize(records, max(elapsed, 1e-9))
+    return summarize(records, elapsed)  # summarize guards elapsed <= 0
 
 
 def main(argv=None) -> ProcessSummary:

@@ -71,6 +71,7 @@ def test_engine_embed_too_long_rejected(engine):
         asyncio.run(engine.embed([[1] * 500]))
 
 
+@pytest.mark.slow
 def test_http_embeddings_rerank_score():
     import requests
 
@@ -143,6 +144,7 @@ def test_embed_rounds_t_bucket_up_not_down(engine, monkeypatch):
     np.testing.assert_allclose(np.linalg.norm(vecs, axis=-1), 1.0, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_embed_unknown_model_rejected():
     import requests
 

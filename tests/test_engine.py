@@ -108,6 +108,7 @@ def test_stop_strings(engine):
         assert outs2[-1].finish_reason in ("stop", "length")
 
 
+@pytest.mark.slow
 class TestHTTPServer:
     @pytest.fixture(scope="class")
     def server(self):

@@ -282,6 +282,7 @@ def test_engine_prefix_cache_isolated_between_adapters(lora_engine, tmp_path):
         lora_engine.unload_lora_adapter("iso")
 
 
+@pytest.mark.slow
 def test_http_lora_endpoints(tmp_path):
     """Full HTTP contract: load -> /v1/models lists the adapter -> chat with
     model=adapter streams -> unload -> 404 for the unloaded name."""
