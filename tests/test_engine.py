@@ -224,12 +224,16 @@ class TestHTTPServer:
             chunk = json_mod.loads(line[5:])
             for c in chunk.get("choices", []):
                 i = c["index"]
-                if c.get("delta", {}).get("content"):
+                if "delta" in c:
                     seen[i] += 1
                 if c.get("finish_reason"):
                     finish[i] = c["finish_reason"]
         assert finish == {0: "length", 1: "length"}
-        assert seen[0] > 0 and seen[1] > 0
+        # every choice streams its role chunk plus per-output chunks. Count
+        # chunks, not printable text: random-weight byte-tokenizer sampling
+        # can legitimately produce 3 tokens that all decode to empty text,
+        # which made a content-based assertion flaky.
+        assert seen[0] >= 2 and seen[1] >= 2
 
     def test_n_rejects_bad_values(self, server):
         r = requests.post(
