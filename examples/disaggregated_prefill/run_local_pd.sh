@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Local two-engine P/D demo (single machine; use helm kvRole values in K8s —
+# tutorials/16-disagg-prefill.md). The producer engine pushes finished
+# prefill KV to the consumer, and the router's disaggregated_prefill logic
+# does the two-phase request flow.
+set -euo pipefail
+
+MODEL="${MODEL:-llama-debug}"
+PREFILL_PORT=8101
+DECODE_PORT=8102
+ROUTER_PORT=8000
+KV_PORT=55555
+
+cleanup() { kill 0 2>/dev/null || true; }
+trap cleanup EXIT
+
+python -m production_stack_tpu.engine.api_server \
+  --model "$MODEL" --port "$DECODE_PORT" \
+  --kv-role consumer --kv-transfer-port "$KV_PORT" &
+
+python -m production_stack_tpu.engine.api_server \
+  --model "$MODEL" --port "$PREFILL_PORT" \
+  --kv-role producer --kv-peer-url "http://127.0.0.1:$KV_PORT" &
+
+for p in "$PREFILL_PORT" "$DECODE_PORT"; do
+  until curl -sf "http://127.0.0.1:$p/health" >/dev/null; do sleep 1; done
+done
+
+python -m production_stack_tpu.router.app --port "$ROUTER_PORT" \
+  --service-discovery static \
+  --static-backends "http://127.0.0.1:$PREFILL_PORT,http://127.0.0.1:$DECODE_PORT" \
+  --static-models "$MODEL,$MODEL" \
+  --static-model-labels "prefill,decode" \
+  --routing-logic disaggregated_prefill \
+  --prefill-model-labels prefill --decode-model-labels decode &
+
+until curl -sf "http://127.0.0.1:$ROUTER_PORT/health" >/dev/null; do sleep 1; done
+
+curl -s "http://127.0.0.1:$ROUTER_PORT/v1/completions" \
+  -H 'Content-Type: application/json' \
+  -d "{\"model\": \"$MODEL\", \"prompt\": \"hello disaggregated world\", \"max_tokens\": 16}"
+echo
+wait

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Batch API walkthrough against the router (OpenAI Batch semantics).
+
+Uploads a JSONL request file, starts a batch job, polls until it finishes,
+and prints the per-request output file. Uses only `requests`, so it runs in
+any environment the stack itself runs in; the official `openai` client works
+identically against the same endpoints (set base_url to the router).
+
+Reference analogue: examples/openai_api_client_batch.py in
+FlowGPT/production-stack. Start the router with --enable-batch-api
+(tutorials/04 covers the full deployment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+import requests
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--base-url", default="http://localhost:8000",
+                    help="router URL (no trailing /v1)")
+    ap.add_argument("--file-path", default=None,
+                    help="JSONL batch input (default: batch.jsonl next to this script)")
+    ap.add_argument("--endpoint", default="/v1/chat/completions")
+    ap.add_argument("--poll-seconds", type=float, default=2.0)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args()
+
+    path = pathlib.Path(args.file_path or pathlib.Path(__file__).parent / "batch.jsonl")
+    base = args.base_url.rstrip("/")
+
+    # 1. upload the input file (multipart, purpose=batch)
+    with path.open("rb") as fh:
+        r = requests.post(
+            f"{base}/v1/files",
+            files={"file": (path.name, fh)},
+            data={"purpose": "batch"},
+            timeout=30,
+        )
+    r.raise_for_status()
+    file_meta = r.json()
+    print("uploaded:", json.dumps(file_meta, indent=2))
+
+    # 2. round-trip the metadata and content endpoints
+    fid = file_meta["id"]
+    print("retrieved:", requests.get(f"{base}/v1/files/{fid}", timeout=30).json())
+    content = requests.get(f"{base}/v1/files/{fid}/content", timeout=30)
+    print("content:", content.text.strip()[:400])
+
+    # 3. create the batch job
+    r = requests.post(
+        f"{base}/v1/batches",
+        json={
+            "input_file_id": fid,
+            "endpoint": args.endpoint,
+            "completion_window": "1h",
+        },
+        timeout=30,
+    )
+    r.raise_for_status()
+    batch = r.json()
+    print("created batch:", json.dumps(batch, indent=2))
+
+    print("all batches:", requests.get(f"{base}/v1/batches", timeout=30).json())
+
+    # 4. poll to completion
+    deadline = time.time() + args.timeout
+    while batch["status"] in ("validating", "pending", "in_progress"):
+        if time.time() > deadline:
+            print("timed out waiting for batch", file=sys.stderr)
+            return 1
+        time.sleep(args.poll_seconds)
+        batch = requests.get(f"{base}/v1/batches/{batch['id']}", timeout=30).json()
+        print("status:", batch["status"])
+
+    if batch["status"] != "completed" or not batch.get("output_file_id"):
+        print("batch did not complete:", json.dumps(batch, indent=2), file=sys.stderr)
+        return 1
+
+    # 5. fetch per-request results
+    out = requests.get(
+        f"{base}/v1/files/{batch['output_file_id']}/content", timeout=30
+    )
+    out.raise_for_status()
+    for line in out.text.strip().splitlines():
+        rec = json.loads(line)
+        print(f"--- {rec.get('custom_id')} ---")
+        print(json.dumps(rec.get("response", rec), indent=2)[:600])
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import collections
 import json
 import time
 import uuid
@@ -27,6 +28,26 @@ from production_stack_tpu.engine.scheduler import SamplingParams
 from production_stack_tpu.utils.logging import init_logger
 
 logger = init_logger(__name__)
+
+# Per-request TTFT hop samples for streaming requests, in ms:
+# (accept->engine-submit, submit->first engine output, first output->first
+# SSE write). /metrics exposes p50/p99 per hop; together with the router's
+# hop gauges this attributes stack tail latency to a stage.
+_ttft_hops: collections.deque = collections.deque(maxlen=2048)
+
+
+def _ttft_hop_quantiles() -> dict:
+    if not _ttft_hops:
+        return {}
+    names = ("accept_to_submit", "submit_to_first_token", "first_token_to_write")
+    out = {}
+    for name, vals in zip(names, zip(*_ttft_hops)):
+        s = sorted(vals)
+        out[name] = {
+            "p50": s[len(s) // 2],
+            "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+        }
+    return out
 
 
 async def _tag_stream(i, gen):
@@ -211,6 +232,17 @@ class EngineServer:
             if k.startswith(("kv_", "spec_decode_")):
                 kind = "counter" if k.endswith("_total") else "gauge"
                 emit(k, kind, s[k])
+        # TTFT hop breakdown for streaming requests (accept->submit->first
+        # token->first SSE write), p50/p99 over the sample window. ONE TYPE
+        # line per metric name — a duplicate would fail the whole Prometheus
+        # scrape
+        for hop, qs in _ttft_hop_quantiles().items():
+            lines.append(f"# TYPE vllm:ttft_hop_{hop}_ms gauge")
+            for q, v in qs.items():
+                lines.append(
+                    f'vllm:ttft_hop_{hop}_ms{{model_name="{m}",quantile="{q}"}} '
+                    f"{round(v, 3)}"
+                )
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
@@ -219,10 +251,62 @@ class EngineServer:
             messages = body.get("messages", [])
             if not isinstance(messages, list):
                 raise ValueError("'messages' must be a list")
+            tools, tool_style = self._resolve_tools(body)
         except (ValueError, TypeError) as e:
             return web.json_response({"error": {"message": f"invalid request: {e}"}}, status=400)
-        prompt = self.engine.tokenizer.apply_chat_template(messages)
-        return await self._generate(request, body, prompt, chat=True)
+        prompt = self.engine.tokenizer.apply_chat_template(messages, tools=tools)
+        return await self._generate(
+            request, body, prompt, chat=True, tool_style=tool_style
+        )
+
+    def _resolve_tools(self, body: dict) -> "tuple[Optional[list], Optional[str]]":
+        """(tools to render into the template, parser style or None).
+
+        tool_choice: "none" drops the schemas entirely; a named function
+        narrows the rendered schemas to that tool (the strongest steer
+        available without constrained decoding); "auto"/"required" render
+        all. Reference behavior comes from vLLM's --tool-call-parser flags
+        (/root/reference/tutorials/13-tool-enabled-installation.md)."""
+        tools = body.get("tools")
+        if tools is not None:
+            if not isinstance(tools, list):
+                raise ValueError("'tools' must be a list")
+            for t in tools:
+                # validate shape HERE, where ValueError maps to a 400 —
+                # malformed entries must not crash template rendering later
+                if not (
+                    isinstance(t, dict)
+                    and isinstance(t.get("function"), dict)
+                    and isinstance(t["function"].get("name"), str)
+                ):
+                    raise ValueError(
+                        "each tool must be {'type': 'function', "
+                        "'function': {'name': ..., ...}}"
+                    )
+        for msg in body.get("messages", []):
+            for c in (msg.get("tool_calls") or []) if isinstance(msg, dict) else []:
+                fn = c.get("function") if isinstance(c, dict) else None
+                if not (isinstance(fn, dict) and isinstance(fn.get("name"), str)
+                        and isinstance(fn.get("arguments", ""), str)):
+                    raise ValueError(
+                        "message tool_calls must carry function.name and "
+                        "string function.arguments"
+                    )
+        choice = body.get("tool_choice", "auto" if tools else "none")
+        if not tools or choice == "none" or self.cfg.tool_call_parser == "off":
+            return None, None
+        if isinstance(choice, dict):
+            name = (choice.get("function") or {}).get("name")
+            named = [
+                t for t in tools
+                if (t.get("function") or {}).get("name") == name
+            ]
+            if not named:
+                raise ValueError(f"tool_choice names unknown tool {name!r}")
+            tools = named
+        elif choice not in ("auto", "required"):
+            raise ValueError(f"invalid tool_choice {choice!r}")
+        return tools, self.cfg.tool_call_parser
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -235,8 +319,10 @@ class EngineServer:
         return await self._generate(request, body, prompt, chat=False)
 
     async def _generate(
-        self, request: web.Request, body: dict, prompt: str, chat: bool
+        self, request: web.Request, body: dict, prompt: str, chat: bool,
+        tool_style: Optional[str] = None,
     ) -> web.StreamResponse:
+        t_accept = time.perf_counter()
         if self.engine.is_sleeping:
             return web.json_response({"error": "engine is sleeping"}, status=503)
         model = body.get("model", self.cfg.name)
@@ -327,6 +413,7 @@ class EngineServer:
                 sid, prompt_token_ids=prompt_ids, params=params, lora_name=lora_name
             )
 
+        t_submit = time.perf_counter()
         if n == 1:
             gens = [_gen(sub_ids[0])]
         else:
@@ -385,9 +472,22 @@ class EngineServer:
                         lp_obj, _ = _completion_lp(
                             self.engine.tokenizer, tok_ids, lp_entries, 0)
                 if chat:
+                    message = {"role": "assistant", "content": full}
+                    if tool_style is not None:
+                        from production_stack_tpu.engine.tool_parser import parse_tool_calls
+
+                        content, tool_calls = parse_tool_calls(full, tool_style)
+                        if tool_calls:
+                            message = {
+                                "role": "assistant",
+                                "content": content or None,
+                                "tool_calls": tool_calls,
+                            }
+                            if finish_reason == "stop":
+                                finish_reason = "tool_calls"
                     choices.append({
                         "index": i,
-                        "message": {"role": "assistant", "content": full},
+                        "message": message,
                         "logprobs": lp_obj,
                         "finish_reason": finish_reason,
                     })
@@ -431,14 +531,24 @@ class EngineServer:
         # precede prefill completion, or client-measured TTFT would be ~0
         role_sent = [not chat] * n
         lasts: list = [None] * n
+        parsers = tool_idx = None
+        if chat and tool_style is not None:
+            from production_stack_tpu.engine.tool_parser import StreamingToolParser
+
+            parsers = [StreamingToolParser(tool_style) for _ in range(n)]
+            tool_idx = [0] * n
         try:
             if n == 1:
                 merged = _tag_stream(0, gen)
             else:
                 merged = _merge_streams(gens)
             lp_offsets = [0] * n
+            t_first_out = None
+            hop_done = False
             async for i, out in merged:
                 lasts[i] = out
+                if i == 0 and t_first_out is None:
+                    t_first_out = time.perf_counter()
                 if not role_sent[i]:
                     role_sent[i] = True
                     await send(
@@ -464,18 +574,46 @@ class EngineServer:
                             self.engine.tokenizer, out.token_ids,
                             out.logprobs, lp_offsets[i])
                 if chat:
-                    choice = {
-                        "index": i,
-                        "delta": {"content": out.text_delta} if out.text_delta else {},
-                        "logprobs": lp_obj,
-                        "finish_reason": out.finish_reason,
-                    }
-                    await send(
-                        {
-                            "id": oid, "object": "chat.completion.chunk",
-                            "created": created, "model": model, "choices": [choice],
+                    finish_reason = out.finish_reason
+                    if parsers is None:
+                        deltas = [{"content": out.text_delta} if out.text_delta else {}]
+                    else:
+                        # split the raw delta into content vs tool-call events;
+                        # candidate tool-call text is withheld until it either
+                        # completes (a tool_calls delta) or fails to parse at
+                        # end-of-stream (flushed back as content)
+                        p = parsers[i]
+                        events = p.push(out.text_delta or "")
+                        if out.finished:
+                            events.extend(p.finish())
+                            if p.tool_calls and finish_reason == "stop":
+                                finish_reason = "tool_calls"
+                        deltas = []
+                        for ev in events:
+                            if ev[0] == "content" and ev[1]:
+                                deltas.append({"content": ev[1]})
+                            elif ev[0] == "call":
+                                deltas.append(
+                                    {"tool_calls": [{"index": tool_idx[i], **ev[1]}]}
+                                )
+                                tool_idx[i] += 1
+                        # always emit at least one chunk per engine output:
+                        # the first chunk is the client's TTFT signal
+                        deltas = deltas or [{}]
+                    for j, d in enumerate(deltas):
+                        last_d = j == len(deltas) - 1
+                        choice = {
+                            "index": i,
+                            "delta": d,
+                            "logprobs": lp_obj if last_d else None,
+                            "finish_reason": finish_reason if last_d else None,
                         }
-                    )
+                        await send(
+                            {
+                                "id": oid, "object": "chat.completion.chunk",
+                                "created": created, "model": model, "choices": [choice],
+                            }
+                        )
                 else:
                     await send(
                         {
@@ -490,6 +628,13 @@ class EngineServer:
                             ],
                         }
                     )
+                if i == 0 and t_first_out is not None and not hop_done:
+                    hop_done = True
+                    _ttft_hops.append((
+                        (t_submit - t_accept) * 1000,
+                        (t_first_out - t_submit) * 1000,
+                        (time.perf_counter() - t_first_out) * 1000,
+                    ))
             if lasts[0] is not None:
                 usage = _usage(lasts[0])
                 if n > 1:
@@ -785,8 +930,11 @@ def _init_multihost(cfg: EngineConfig) -> int:
         raise ValueError(
             "--distributed-num-processes > 1 requires --distributed-coordinator"
         )
-    if cfg.kv_offload_cpu_gb > 0 or cfg.kv_offload_dir or cfg.kv_remote_url:
-        raise ValueError("KV offload tiers are not supported in multi-host mode")
+    # KV offload tiers work multi-host: get_page is a REPLICATED dispatch
+    # that gathers the page fully-replicated (SPMD) so the leader's host
+    # fetch sees the whole page; set_page restores broadcast the bytes back.
+    # The tiers/controller/cache-server connections are leader-only
+    # (followers get them disabled in serve()).
     # sleep mode works multi-host at level 1: drop_kv_pools/reset_kv are
     # replicated dispatches, so followers free and re-create their pool
     # shards in lockstep (level 2 is rejected at request time: each process
@@ -820,10 +968,19 @@ async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
 
         pid = _init_multihost(cfg)
         if pid != 0:
-            # follower: identical construction (same model, mesh, pools,
-            # seed), then replay the leader's device dispatches forever.
-            # This call BLOCKS until the leader shuts down.
-            engine = LLMEngine(cfg)
+            # follower: identical RUNNER construction (same model, mesh,
+            # pools, seed), then replay the leader's device dispatches
+            # forever. Host-side KV tiers / controller / remote-cache
+            # connections are leader-only — a follower building them would
+            # double-register with the KV index controller and waste host
+            # RAM on a tier nothing reads. This call BLOCKS until the
+            # leader shuts down.
+            import dataclasses as _dc
+
+            engine = LLMEngine(_dc.replace(
+                cfg, kv_offload_cpu_gb=0.0, kv_offload_dir=None,
+                kv_remote_url=None, kv_controller_url=None,
+            ))
             leader_host = cfg.distributed_coordinator.rsplit(":", 1)[0]
             await asyncio.get_event_loop().run_in_executor(
                 None,
@@ -843,6 +1000,11 @@ async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
             # re-point it at the wrapper or set_lora_slot/clear_lora_slot
             # would bypass replication and followers would keep zero slots
             engine.lora.runner = engine.runner
+        if engine._offload is not None:
+            # same capture pattern: the offload connector's get_page/set_page
+            # must go through the broadcaster or followers desync on the
+            # SPMD page-gather program
+            engine._offload.runner = engine.runner
     server = EngineServer(cfg, engine)
     server.engine.start()
     app = server.build_app()

@@ -57,6 +57,11 @@ class EngineConfig:
     # decode attention implementation, threaded into the model config:
     # auto | xla | pallas | pallas_interpret (ModelRunner resolves "auto")
     attn_impl: str = "auto"
+    # tool-call extraction from chat completions (engine/tool_parser.py):
+    # auto | hermes | json | off. The reference reaches this via vLLM's
+    # --tool-call-parser flag (tutorials/13); we own the engine, so the
+    # streaming parser lives here.
+    tool_call_parser: str = "auto"
     # KV write placement (threaded into the model config): "pre" writes each
     # layer's K/V into the pool before attending; "post" attends over the
     # stale pool + in-register chunk K/V and commits all layers with one
@@ -87,6 +92,11 @@ class EngineConfig:
     distributed_process_id: Optional[int] = None    # default: hostname -N suffix
     worker_sync_port: int = 8477
     enable_sleep_mode: bool = False
+    # persistent XLA compilation cache directory (utils/compile_cache.py);
+    # None resolves via $PSTPU_COMPILE_CACHE_DIR then ~/.cache. In K8s this
+    # is a PVC (helm values.compileCache) so pod restarts start warm instead
+    # of paying 20-40 s per program variant.
+    compilation_cache_dir: Optional[str] = None
     seed: int = 0
     # multi-LoRA serving (reference: vLLM --enable-lora + load/unload endpoints,
     # helm/templates/deployment-vllm-multi.yaml:197-207)

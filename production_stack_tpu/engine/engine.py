@@ -48,6 +48,18 @@ class RequestOutput:
 
 class LLMEngine:
     def __init__(self, cfg: EngineConfig, mesh=None):
+        from production_stack_tpu.utils.compile_cache import enable_persistent_cache
+
+        scope = None
+        if cfg.distributed_num_processes > 1:
+            import jax as _jax
+
+            # jax.distributed is already initialized by serve(); executables
+            # cached under a different process topology must not be reused
+            scope = (
+                f"mh{cfg.distributed_num_processes}p{_jax.process_index()}"
+            )
+        enable_persistent_cache(cfg.compilation_cache_dir, scope=scope)
         self.cfg = cfg
         model_mod, model_cfg, params = load_model(
             cfg.model, seed=cfg.seed, max_model_len=cfg.max_model_len

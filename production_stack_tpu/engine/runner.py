@@ -95,14 +95,23 @@ class ModelRunner:
                     f"num_layers={cfg.num_layers}"
                 )
         if cfg.attn_impl == "auto":
-            # pallas decode kernel on real TPU for single-chip and dp/tp
-            # meshes (the sharded path runs it per shard via shard_map —
-            # ops/pallas/paged_attention.py). sp/ep/pp stay on the XLA
-            # gather path: their decode shardings aren't plain dp x tp.
-            mesh_ok = all(
-                mesh_shape.get(ax, 1) == 1 for ax in ("sp", "ep", "pp")
-            ) and (self.mesh.devices.size == 1 or fwd_takes_mesh)
-            use_pallas = jax.default_backend() == "tpu" and mesh_ok
+            # pallas decode kernel on real TPU (the sharded path runs it per
+            # shard via shard_map — ops/pallas/paged_attention.py). sp/ep
+            # axes are mapped replicated (decode activations don't shard
+            # over them); pp calls the kernel inside the pipeline's manual
+            # region with stage-local layer pools. GSPMD alone cannot
+            # partition a pallas_call, which is why every multi-device case
+            # must reach the kernel through shard_map (fwd_takes_mesh).
+            mesh_ok = self.mesh.devices.size == 1 or fwd_takes_mesh
+            # the sharded kernel's shard_map specs split heads over tp
+            # (NH/KH) — uneven head counts (e.g. 2 KV heads at tp=4) only
+            # work on the XLA/GSPMD gather path, which tolerates padding
+            tp = mesh_shape.get("tp", 1)
+            heads_ok = (
+                getattr(cfg, "num_heads", 1) % tp == 0
+                and getattr(cfg, "num_kv_heads", 1) % tp == 0
+            )
+            use_pallas = jax.default_backend() == "tpu" and mesh_ok and heads_ok
             cfg = dataclasses.replace(
                 cfg, attn_impl="pallas" if use_pallas else "xla"
             )
@@ -164,6 +173,7 @@ class ModelRunner:
         self._rep = NamedSharding(self.mesh, P())
         self._steps: dict[bool, Any] = {}  # want_logprobs -> jitted step
         self._set_page_fn = None  # built lazily in set_page
+        self._get_page_fn = None  # built lazily in get_page (multi-host)
         self._encode = None       # built lazily in encode (pooled embeddings)
         self._multi_steps: dict[tuple, Any] = {}  # (k, want_lp) -> jitted decode
         self._spec_fns: dict[tuple, Any] = {}   # (steps, k, n) -> jitted spec decode
@@ -464,7 +474,25 @@ class ModelRunner:
         self.set_lora_slot(slot, zeros, 0.0)
 
     def get_page(self, pid: int):
-        """Fetch one page's K/V to host ([L, page_size, KH, D] each)."""
+        """Fetch one page's K/V to host ([L, page_size, KH, D] each).
+
+        Multi-host: a process can only address its own pool shards, so the
+        page is first laid out fully-replicated by an SPMD program (the
+        all-gather rides ICI/DCN) and the LOCAL replica is fetched. This is
+        a REPLICATED dispatch (distributed.py) — every process runs the same
+        program, the leader's host fetch sees the whole page — which is what
+        makes KV offload tiers work under multi-host serving (the reference
+        runs LMCache under multi-node vLLM the same leader-driven way,
+        deployment-vllm-multi.yaml:202-331)."""
+        if not self.k_pages.is_fully_addressable:
+            if self._get_page_fn is None:
+                rep = NamedSharding(self.mesh, P())
+                self._get_page_fn = jax.jit(
+                    lambda kp, vp, i: (kp[:, i], vp[:, i]),
+                    out_shardings=(rep, rep),
+                )
+            k, v = self._get_page_fn(self.k_pages, self.v_pages, jnp.int32(pid))
+            return jax.device_get((k, v))
         return jax.device_get((self.k_pages[:, pid], self.v_pages[:, pid]))
 
     def get_page_device(self, pid: int):
@@ -497,11 +525,17 @@ class ModelRunner:
         )
 
     def _kv_sharding(self) -> NamedSharding:
-        """Pool sharding for this mesh (pp shards the layer axis)."""
-        return NamedSharding(
-            self.mesh,
-            shardings.KV_PAGES_SPEC_PP if self._pp > 1 else shardings.KV_PAGES_SPEC,
-        )
+        """Pool sharding for this mesh (pp shards the layer axis).
+
+        KV heads shard over tp only when they divide evenly; a GQA model with
+        fewer KV heads than the tp axis (e.g. 2 KV heads at tp=4) replicates
+        the pool instead — the XLA attention path then reads it GSPMD-style
+        (this is also why attn_impl=auto refuses pallas there)."""
+        spec = shardings.KV_PAGES_SPEC_PP if self._pp > 1 else shardings.KV_PAGES_SPEC
+        tp = dict(self.mesh.shape).get("tp", 1)
+        if getattr(self.cfg, "num_kv_heads", 1) % tp:
+            spec = P(*[None if ax == "tp" else ax for ax in spec])
+        return NamedSharding(self.mesh, spec)
 
     def drop_kv_pools(self) -> None:
         """Release the KV pools' device memory (sleep level 1+)."""

@@ -8,7 +8,55 @@ tokenizer is used so the whole stack runs with zero downloads.
 
 from __future__ import annotations
 
+import json
 from typing import Optional, Sequence
+
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+
+def _generic_chat_template(messages: list[dict], tools: Optional[list] = None) -> str:
+    """Dependency-free fallback template. Renders tool schemas into a system
+    preamble (hermes-tag convention, matched by engine/tool_parser.py) and
+    serializes assistant tool_calls / tool-result turns so multi-step tool
+    conversations round-trip."""
+    parts = []
+    if tools:
+        schemas = "\n".join(
+            json.dumps(t.get("function", t), sort_keys=True) for t in tools
+        )
+        parts.append(
+            "<|system|>\nYou may call functions. Available tools:\n"
+            f"{schemas}\n"
+            "To call one, reply with "
+            '<tool_call>{"name": <name>, "arguments": {...}}</tool_call>\n'
+        )
+    for m in messages:
+        role = m.get("role", "user")
+        content = m.get("content") or ""
+        if m.get("tool_calls"):
+            calls = []
+            for c in m["tool_calls"]:
+                if c.get("type", "function") != "function":
+                    continue
+                raw = c["function"].get("arguments") or "{}"
+                try:
+                    args = json.loads(raw)
+                except ValueError:
+                    args = raw  # pass malformed arguments through verbatim
+                calls.append(
+                    "<tool_call>"
+                    + json.dumps(
+                        {"name": c["function"]["name"], "arguments": args},
+                        sort_keys=True,
+                    )
+                    + "</tool_call>"
+                )
+            content = f"{content}{''.join(calls)}"
+        parts.append(f"<|{role}|>\n{content}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)
 
 
 class ByteTokenizer:
@@ -27,10 +75,10 @@ class ByteTokenizer:
         data = bytes(i for i in ids if 0 <= i < 256)
         return data.decode("utf-8", errors="replace")
 
-    def apply_chat_template(self, messages: list[dict]) -> str:
-        parts = [f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}\n" for m in messages]
-        parts.append("<|assistant|>\n")
-        return "".join(parts)
+    def apply_chat_template(
+        self, messages: list[dict], tools: Optional[list] = None
+    ) -> str:
+        return _generic_chat_template(messages, tools)
 
 
 class HFTokenizer:
@@ -51,15 +99,22 @@ class HFTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
 
-    def apply_chat_template(self, messages: list[dict]) -> str:
+    def apply_chat_template(
+        self, messages: list[dict], tools: Optional[list] = None
+    ) -> str:
         try:
+            kw = {"tools": tools} if tools else {}
             return self._tok.apply_chat_template(
-                messages, tokenize=False, add_generation_prompt=True
+                messages, tokenize=False, add_generation_prompt=True, **kw
             )
-        except Exception:
-            parts = [f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}\n" for m in messages]
-            parts.append("<|assistant|>\n")
-            return "".join(parts)
+        except Exception as e:
+            # a malformed template (or a tools-rendering bug) must not
+            # degrade output silently
+            logger.warning(
+                "HF chat template failed (%s: %s); falling back to the "
+                "generic <|role|> template", type(e).__name__, e,
+            )
+            return _generic_chat_template(messages, tools)
 
 
 def load_tokenizer(model_path: Optional[str]):

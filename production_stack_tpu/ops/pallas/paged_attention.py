@@ -47,23 +47,26 @@ def _decode_kernel(
     win_ref,     # [1] int32 window size (huge = full causal)
     # blocks
     q_ref,       # [1, NH, D]
-    k_ref,       # [1, page_size, KH, D]
-    v_ref,       # [1, page_size, KH, D]
-    *refs,       # [k_cur_ref, v_cur_ref,] o_ref, m_ref, l_ref, acc_ref
+    *refs,       # N x (k_ref, v_ref) [1, page_size, KH, D] each,
+                 # [k_cur_ref, v_cur_ref,] o_ref, m_ref, l_ref, acc_ref
     sm_scale: float,
     kv_heads: int,
     logit_softcap: float | None,
     has_cur: bool,
+    pages_per_block: int,
 ):
+    N = pages_per_block
+    kv_refs = refs[: 2 * N]  # k0, v0, k1, v1, ...
+    rest = refs[2 * N:]
     if has_cur:
         # write-after-attend mode: the current token's pool slot is stale;
         # its K/V arrive in-register and fold in on the last grid step
-        k_cur_ref, v_cur_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        k_cur_ref, v_cur_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
-        o_ref, m_ref, l_ref, acc_ref = refs
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     p = pl.program_id(1)
-    page_size = k_ref.shape[1]
+    page_size = kv_refs[0].shape[1]
     NH, D = q_ref.shape[1], q_ref.shape[2]
     KH = kv_heads
     G = NH // KH
@@ -79,35 +82,43 @@ def _decode_kernel(
     # (the current token, position kv_len - 1) is stale in the pool
     paged_end = kv_len - 1 if has_cur else kv_len
     lo = jnp.maximum(kv_len - win_ref[0], 0)   # first visible KV slot
-    start = (lo // page_size + p) * page_size  # this block's first slot
 
-    @pl.when(start < paged_end)
-    def _():
-        q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
-        k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [KH, page, D]
-        v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
-        # batched over KH: [KH, G, D] x [KH, page, D] -> [KH, G, page]
-        scores = lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
-        )
-        if logit_softcap is not None:
-            scores = logit_softcap * jnp.tanh(scores / logit_softcap)
-        idx = start + lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
-        visible = (idx >= lo) & (idx < paged_end)
-        scores = jnp.where(visible, scores, NEG_INF)
+    # N pages per grid cell (unrolled): each page is its own input block with
+    # the single-page layout — same compute per page as the N=1 kernel, but
+    # the grid (and its per-cell pipeline overhead, the reason small pages
+    # used to decode slower) shrinks N-fold. No cross-page reshapes or lane
+    # slicing, which Mosaic rejects for these layouts.
+    for i in range(N):
+        # this sub-block's first slot
+        start = (lo // page_size + p * N + i) * page_size
 
-        m_prev, l_prev = m_ref[...], l_ref[...]
-        m_new = jnp.maximum(m_prev, scores.max(axis=-1))
-        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-        pij = jnp.exp(scores - m_new[..., None])
-        pij = jnp.where(visible, pij, 0.0)
-        m_ref[...] = m_new
-        l_ref[...] = l_prev * alpha + pij.sum(axis=-1)
-        # [KH, G, page] x [KH, page, D] -> [KH, G, D]
-        pv = lax.dot_general(
-            pij, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
-        )
-        acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+        @pl.when(start < paged_end)
+        def _(k_ref=kv_refs[2 * i], v_ref=kv_refs[2 * i + 1], start=start):
+            q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
+            k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [KH, page, D]
+            v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+            # batched over KH: [KH, G, D] x [KH, page, D] -> [KH, G, page]
+            scores = lax.dot_general(
+                q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
+            )
+            if logit_softcap is not None:
+                scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+            idx = start + lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
+            visible = (idx >= lo) & (idx < paged_end)
+            scores = jnp.where(visible, scores, NEG_INF)
+
+            m_prev, l_prev = m_ref[...], l_ref[...]
+            m_new = jnp.maximum(m_prev, scores.max(axis=-1))
+            alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+            pij = jnp.exp(scores - m_new[..., None])
+            pij = jnp.where(visible, pij, 0.0)
+            m_ref[...] = m_new
+            l_ref[...] = l_prev * alpha + pij.sum(axis=-1)
+            # [KH, G, page] x [KH, page, D] -> [KH, G, D]
+            pv = lax.dot_general(
+                pij, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
+            )
+            acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
 
     @pl.when(p == pl.num_programs(1) - 1)
     def _():
@@ -131,7 +142,8 @@ def _decode_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("sm_scale", "logit_softcap", "interpret")
+    jax.jit,
+    static_argnames=("sm_scale", "logit_softcap", "interpret", "pages_per_block"),
 )
 def ragged_paged_attention_decode(
     q: jnp.ndarray,          # [B, NH, D]
@@ -146,6 +158,7 @@ def ragged_paged_attention_decode(
     interpret: bool = False,
     k_cur: jnp.ndarray | None = None,  # [B, KH, D] current token's K (post-write)
     v_cur: jnp.ndarray | None = None,
+    pages_per_block: int | None = None,
 ) -> jnp.ndarray:
     """Decode attention over paged KV, streaming pages HBM->VMEM.
 
@@ -154,6 +167,12 @@ def ragged_paged_attention_decode(
     from registers instead. Returns [B, NH, D] in q.dtype. Matches
     ops/attention.paged_attention_decode (the XLA oracle) — tests assert
     equivalence.
+
+    ``pages_per_block``: pages fetched per grid cell, each as its own input
+    block (auto: ~128 KV slots per cell). The per-cell pipeline overhead is
+    what made small pages slow (876 tok/s at page 16 vs 1,501 at 128 on
+    v5e, engine/config.py) — grouping fetches recovers the throughput while
+    keeping page_size (the prefix-cache sharing granule) fine.
     """
     B, NH, D = q.shape
     _, page_size, KH, _ = k_pages.shape
@@ -161,25 +180,37 @@ def ragged_paged_attention_decode(
     G = NH // KH
     scale = sm_scale if sm_scale is not None else D**-0.5
     has_cur = k_cur is not None
+    if pages_per_block is None:
+        pages_per_block = max(1, min(128 // page_size, max_pages))
+    N = max(1, min(pages_per_block, max_pages))
+    n_blocks = -(-max_pages // N)
     win = (
         jnp.full((1,), 2**30, jnp.int32)
         if window is None
         else jnp.asarray(window, jnp.int32).reshape(1)
     )
 
-    def kv_index(b, p, pt, lens, w):
-        # start fetching at the first page with a visible slot so windowed
-        # layers stream ~window bytes regardless of context length
-        lo_page = jnp.maximum(lens[b] - w[0], 0) // page_size
-        return (pt[b, jnp.minimum(lo_page + p, max_pages - 1)], 0, 0, 0)
+    def kv_index(i):
+        def index(b, p, pt, lens, w):
+            # start fetching at the first page with a visible slot so
+            # windowed layers stream ~window bytes regardless of context
+            lo_page = jnp.maximum(lens[b] - w[0], 0) // page_size
+            return (
+                pt[b, jnp.minimum(lo_page + p * N + i, max_pages - 1)],
+                0, 0, 0,
+            )
+
+        return index
 
     row = lambda b, p, pt, lens, w: (b, 0, 0)
-    in_specs = [
-        pl.BlockSpec((1, NH, D), row),
-        pl.BlockSpec((1, page_size, KH, D), kv_index),
-        pl.BlockSpec((1, page_size, KH, D), kv_index),
-    ]
-    operands = [q, k_pages, v_pages]
+    in_specs = [pl.BlockSpec((1, NH, D), row)]
+    operands = [q]
+    for i in range(N):
+        in_specs += [
+            pl.BlockSpec((1, page_size, KH, D), kv_index(i)),
+            pl.BlockSpec((1, page_size, KH, D), kv_index(i)),
+        ]
+        operands += [k_pages, v_pages]
     if has_cur:
         in_specs += [
             pl.BlockSpec((1, KH, D), row),
@@ -189,7 +220,7 @@ def ragged_paged_attention_decode(
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B, max_pages),
+        grid=(B, n_blocks),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, NH, D), row),
         scratch_shapes=[
@@ -200,7 +231,7 @@ def ragged_paged_attention_decode(
     )
     kernel = functools.partial(
         _decode_kernel, sm_scale=scale, kv_heads=KH,
-        logit_softcap=logit_softcap, has_cur=has_cur,
+        logit_softcap=logit_softcap, has_cur=has_cur, pages_per_block=N,
     )
     return pl.pallas_call(
         kernel,
@@ -232,7 +263,7 @@ def ragged_paged_attention_decode_sharded(
     k_cur: jnp.ndarray | None = None,
     v_cur: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """The decode kernel on a dp x tp mesh via fully-manual shard_map.
+    """The decode kernel on a multi-device mesh via manual shard_map.
 
     GSPMD cannot partition a pallas_call, so the north-star TP config (v5e-8,
     kv heads sharded over tp per shardings.KV_PAGES_SPEC) previously fell
@@ -240,8 +271,18 @@ def ragged_paged_attention_decode_sharded(
     Each (dp, tp) shard runs the kernel on its local batch rows and kv-head
     slice: attention is embarrassingly parallel over both axes (GQA groups
     stay whole because NH and KH divide by tp together), and page indices are
-    global pool coordinates valid on every shard. sp/ep/pp stay on the XLA
-    path (the runner gates attn_impl accordingly).
+    global pool coordinates valid on every shard.
+
+    sp/ep are ALSO mapped, with no spec mention: decode activations are
+    replicated along them (sp shards the token dim of long prefills, ep the
+    expert weights — neither shards a 1-token decode), so each (sp, ep)
+    shard redundantly computes its (dp, tp) slice. Mapping them manually is
+    what keeps GSPMD from trying — and failing — to partition the
+    pallas_call along those axes, which is why sp/ep/pp serving configs
+    used to regress decode to the XLA gather path (engine/runner.py).
+    Under pp this function is called INSIDE the pipeline's shard_map over
+    {pp} (parallel/pipeline.py serving_layer_pipeline) with stage-local
+    layer pools — nested manual regions over disjoint axes.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -265,10 +306,22 @@ def ragged_paged_attention_decode_sharded(
     if has_cur:
         in_specs += [head, head]
         operands += [k_cur, v_cur]
+    # only axes the mesh actually has, and never an axis some caller already
+    # made manual (the pp pipeline region). When called inside a manual
+    # region the context mesh (with those axes marked Manual) must be the
+    # one passed to the nested shard_map, not the concrete mesh.
+    from jax.sharding import get_abstract_mesh
+
+    ctx = get_abstract_mesh()
+    manual_already = (
+        set(ctx.manual_axes) if ctx is not None and not ctx.empty else set()
+    )
+    sm_mesh = mesh if not manual_already else ctx
+    manual = ({"dp", "tp", "sp", "ep"} & set(mesh.axis_names)) - manual_already
     out = jax.shard_map(
         body,
-        mesh=mesh,
-        axis_names={"dp", "tp"},
+        mesh=sm_mesh,
+        axis_names=manual,
         in_specs=tuple(in_specs),
         out_specs=head,
         check_vma=False,

@@ -53,6 +53,7 @@ class RouterApp:
         )
         self._bg: list = []
         self.semantic_cache = None
+        self.pii_analyzer = None
 
     # -- bootstrap (parity app.py:initialize_all) ---------------------------
 
@@ -110,9 +111,26 @@ class RouterApp:
             load_callbacks(args.callbacks)
         initialize_feature_gates(args.feature_gates)
         if get_feature_gates().is_enabled("SemanticCache"):
-            from production_stack_tpu.router.semantic_cache import SemanticCache
+            from production_stack_tpu.router import semantic_cache as sc
 
-            self.semantic_cache = SemanticCache(threshold=args.semantic_cache_threshold)
+            choice = getattr(args, "semantic_cache_embedder", "auto")
+            if choice == "ngram":
+                embed = sc.ngram_hash_embed
+            elif choice == "sentence-transformers":
+                embed = sc.SentenceTransformerEmbedder()  # raises if absent
+            else:  # auto: real encoder when installed + cached, else n-grams
+                embed = None
+            self.semantic_cache = sc.SemanticCache(
+                threshold=args.semantic_cache_threshold, embed=embed
+            )
+        if get_feature_gates().is_enabled("PIIDetection"):
+            from production_stack_tpu.router.pii import make_analyzer
+
+            # built ONCE at startup: the Presidio tier loads an NER model —
+            # seconds of work that must not land on the first request
+            self.pii_analyzer = make_analyzer(
+                getattr(args, "pii_analyzer", "auto")
+            )
         files_service.initialize_storage(args.file_storage_path)
         if args.enable_batch_api:
             proc = batch_service.initialize_batch_processor(
@@ -173,8 +191,12 @@ class RouterApp:
             if short is not None:
                 status, payload = short
                 return web.json_response(payload, status=status)
-        if get_feature_gates().is_enabled("PIIDetection"):
-            blocked, body = self._apply_pii_policy(body, request_json)
+        if self.pii_analyzer is not None:
+            # NER analysis (Presidio tier) is CPU-bound: keep it off the
+            # event loop so concurrent streams don't stall behind it
+            blocked, body = await asyncio.get_event_loop().run_in_executor(
+                None, self._apply_pii_policy, body, request_json
+            )
             if blocked is not None:
                 return blocked
         if self.semantic_cache is not None and endpoint == "/v1/chat/completions":
@@ -213,13 +235,14 @@ class RouterApp:
         Parity: experimental/pii/middleware.py:43-154 in /root/reference."""
         from production_stack_tpu.router.pii import check_pii_content, redact
 
+        analyzer = self.pii_analyzer
         texts = []
         if isinstance(request_json.get("prompt"), str):
             texts.append(request_json["prompt"])
         for m in request_json.get("messages", []) or []:
             if isinstance(m, dict) and isinstance(m.get("content"), str):
                 texts.append(m["content"])
-        matches = [m for t in texts for m in check_pii_content(t)]
+        matches = [m for t in texts for m in check_pii_content(t, analyzer)]
         if not matches:
             return None, body
         kinds = sorted({m.kind for m in matches})
@@ -233,10 +256,10 @@ class RouterApp:
             )
         logger.info("redacting PII from request: %s", kinds)
         if isinstance(request_json.get("prompt"), str):
-            request_json["prompt"] = redact(request_json["prompt"])
+            request_json["prompt"] = redact(request_json["prompt"], analyzer=analyzer)
         for m in request_json.get("messages", []) or []:
             if isinstance(m, dict) and isinstance(m.get("content"), str):
-                m["content"] = redact(m["content"])
+                m["content"] = redact(m["content"], analyzer=analyzer)
         return None, json.dumps(request_json).encode()
 
     async def models(self, request: web.Request) -> web.Response:
@@ -332,6 +355,16 @@ class RouterApp:
             gauge("vllm_router:engine_waiting_requests", es.num_queuing_requests, lab)
             gauge("vllm_router:gpu_cache_usage_perc", es.gpu_cache_usage_perc, lab)
             gauge("vllm_router:gpu_prefix_cache_hit_rate", es.gpu_prefix_cache_hit_rate, lab)
+        # per-hop TTFT breakdown (receive->route->backend-headers->first
+        # chunk): attributes tail latency to a stage instead of "the stack".
+        # One TYPE line per metric name (duplicates fail the whole scrape).
+        from production_stack_tpu.router.request_service import get_hop_quantiles
+
+        for hop, qs in get_hop_quantiles().items():
+            name = f"vllm_router:ttft_hop_{hop}_ms"
+            lines.append(f"# TYPE {name} gauge")
+            for q, v in qs.items():
+                lines.append(f'{name}{{quantile="{q}"}} {round(v, 3)}')
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
     # -- files & batches (parity files_router.py, batches_router.py) --------

@@ -78,8 +78,17 @@ def parse_args(argv=None):
     p.add_argument("--feature-gates", type=str, default="",
                    help="e.g. SemanticCache=true,PIIDetection=true")
     p.add_argument("--semantic-cache-threshold", type=float, default=0.92)
+    p.add_argument("--semantic-cache-embedder", type=str, default="auto",
+                   choices=["auto", "ngram", "sentence-transformers"],
+                   help="auto probes for a locally-cached sentence-transformers "
+                        "model (HF-offline, fails fast) and falls back to the "
+                        "dependency-free n-gram embedder")
     p.add_argument("--pii-policy", type=str, default="redact",
                    choices=["redact", "block"])
+    p.add_argument("--pii-analyzer", type=str, default="auto",
+                   choices=["auto", "regex", "presidio"],
+                   help="presidio activates the NER tier (requires "
+                        "presidio-analyzer); auto falls back to regex")
     p.add_argument("--sentry-dsn", type=str, default=None)
     args = p.parse_args(argv)
     validate_args(args)

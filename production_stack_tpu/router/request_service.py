@@ -12,6 +12,7 @@ too (SURVEY.md §4.3).
 
 from __future__ import annotations
 
+import collections
 import json
 import time
 import uuid
@@ -32,6 +33,32 @@ from production_stack_tpu.utils.logging import init_logger
 logger = init_logger(__name__)
 
 _client_session: Optional[aiohttp.ClientSession] = None
+
+# Per-request TTFT hop samples, (recv->route, route->backend-headers,
+# backend-headers->first-chunk) in ms. /metrics exposes p50/p99 per hop so
+# tail latency is attributable to a stage, not just "the stack".
+_hop_samples: collections.deque = collections.deque(maxlen=2048)
+
+
+def record_hop_sample(recv_to_route: float, route_to_connect: float,
+                      connect_to_first: float) -> None:
+    _hop_samples.append((recv_to_route, route_to_connect, connect_to_first))
+
+
+def get_hop_quantiles() -> dict:
+    """{hop: {p50, p99}} in ms over the sample window."""
+    if not _hop_samples:
+        return {}
+    cols = list(zip(*_hop_samples))
+    names = ("recv_to_route", "route_to_connect", "connect_to_first_chunk")
+    out = {}
+    for name, vals in zip(names, cols):
+        s = sorted(vals)
+        out[name] = {
+            "p50": s[len(s) // 2],
+            "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+        }
+    return out
 
 
 async def get_client_session() -> aiohttp.ClientSession:
@@ -66,24 +93,28 @@ async def process_request(
     *,
     is_streaming: bool,
     capture_body: Optional[object] = None,
+    ts_recv: Optional[float] = None,
 ) -> web.StreamResponse:
     """Proxy `body` to backend and stream the response back, firing request
     stats callbacks (parity request.py:54-138).
 
     `capture_body(status, bytes)` — optional async callback fired with the full
     response once the proxy completes (semantic-cache store, post_request
-    callbacks)."""
+    callbacks). ``ts_recv`` is the perf_counter when the router first saw the
+    request, for the per-hop TTFT breakdown."""
     monitor = get_request_stats_monitor()
     monitor.on_new_request(backend_url, request_id)
     session = await get_client_session()
     resp: Optional[web.StreamResponse] = None
     captured: list[bytes] = []
+    t_route = time.perf_counter()
     try:
         async with session.post(
             f"{backend_url}{endpoint}",
             data=body,
             headers=_filter_headers(request.headers),
         ) as backend_resp:
+            t_conn = time.perf_counter()
             resp = web.StreamResponse(
                 status=backend_resp.status,
                 headers={
@@ -97,6 +128,12 @@ async def process_request(
                 if first:
                     monitor.on_request_response(backend_url, request_id)
                     first = False
+                    t_first = time.perf_counter()
+                    record_hop_sample(
+                        (t_route - (ts_recv or t_route)) * 1000,
+                        (t_conn - t_route) * 1000,
+                        (t_first - t_conn) * 1000,
+                    )
                 else:
                     monitor.on_token(backend_url, request_id)
                 if capture_body is not None:
@@ -133,6 +170,7 @@ async def route_general_request(
     """Parse, filter endpoints by model + sleep state, route, proxy.
     Parity request.py:141-304."""
     in_router_time = time.time()
+    ts_recv = time.perf_counter()
     body = body_override if body_override is not None else await request.read()
     request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
     try:
@@ -184,7 +222,7 @@ async def route_general_request(
     is_streaming = bool(request_json.get("stream", False))
     return await process_request(
         request, body, server_url, endpoint, request_id,
-        is_streaming=is_streaming, capture_body=capture_body,
+        is_streaming=is_streaming, capture_body=capture_body, ts_recv=ts_recv,
     )
 
 

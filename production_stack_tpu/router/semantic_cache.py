@@ -4,11 +4,14 @@ Parity: src/vllm_router/experimental/semantic_cache/ in /root/reference
 (SemanticCache semantic_cache.py:16-120+, FAISSAdapter db_adapters/
 faiss_adapter.py:14-134, integration check/store hooks).
 
-The reference embeds with sentence-transformers and searches a FAISS index;
-neither ships in this environment, so the default embedder is a hashed
-character-n-gram featurizer (deterministic, dependency-free) with exact
-brute-force cosine search over a numpy matrix — the right structure with a
-pluggable `embed` function where a real encoder can drop in.
+Backends are optional-import, mirroring the reference's dependency split
+(pyproject extra ``semantic_cache``): when ``sentence-transformers`` is
+installed the embedder is a real sentence encoder, and when ``faiss`` is
+installed similarity search runs on an ``IndexFlatIP``. Neither ships in
+hermetic environments, so the always-available fallbacks are a hashed
+character-n-gram featurizer (deterministic, dependency-free) and exact
+brute-force cosine search over a numpy matrix — same interfaces, proven by
+the unit tests with fake modules.
 """
 
 from __future__ import annotations
@@ -39,17 +42,134 @@ def ngram_hash_embed(text: str, dim: int = DIM) -> np.ndarray:
     return v / n if n > 0 else v
 
 
+class SentenceTransformerEmbedder:
+    """Real sentence encoder (reference: semantic_cache.py uses
+    sentence-transformers). Activates when the package is installed; inject
+    ``module`` to test the adapter without it."""
+
+    def __init__(self, model_name: str = "all-MiniLM-L6-v2", module=None):
+        if module is None:
+            import sentence_transformers as module  # optional dep
+        self._model = module.SentenceTransformer(model_name)
+        self.dim = int(self._model.get_sentence_embedding_dimension())
+
+    def __call__(self, text: str) -> np.ndarray:
+        v = np.asarray(self._model.encode([text])[0], np.float32)
+        n = np.linalg.norm(v)
+        return v / n if n > 0 else v
+
+
+class NumpyIndex:
+    """Exact brute-force cosine search (vectors pre-normalized)."""
+
+    def __init__(self, dim: int):
+        self.vectors = np.zeros((0, dim), np.float32)
+
+    def __len__(self) -> int:
+        return len(self.vectors)
+
+    def add(self, v: np.ndarray) -> None:
+        self.vectors = np.vstack([self.vectors, v[None]])
+
+    def search(self, q: np.ndarray) -> "tuple[float, int]":
+        if not len(self.vectors):
+            return -1.0, -1
+        sims = self.vectors @ q
+        best = int(np.argmax(sims))
+        return float(sims[best]), best
+
+    def pop_front(self) -> None:
+        self.vectors = self.vectors[1:]
+
+
+class FaissIndex:
+    """FAISS ``IndexFlatIP`` adapter (reference: faiss_adapter.py:14-134 —
+    inner product over normalized vectors == cosine). Inject ``module`` to
+    test without faiss installed."""
+
+    def __init__(self, dim: int, module=None):
+        if module is None:
+            import faiss as module  # optional dep
+        self._faiss = module
+        self.dim = dim
+        self._index = module.IndexFlatIP(dim)
+        self._count = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+    def add(self, v: np.ndarray) -> None:
+        self._index.add(np.ascontiguousarray(v[None], np.float32))
+        self._count += 1
+
+    def search(self, q: np.ndarray) -> "tuple[float, int]":
+        if not self._count:
+            return -1.0, -1
+        sims, ids = self._index.search(np.ascontiguousarray(q[None], np.float32), 1)
+        return float(sims[0, 0]), int(ids[0, 0])
+
+    def pop_front(self) -> None:
+        # IndexFlatIP stores vectors densely: rebuild without row 0 (eviction
+        # is rare — once per insert beyond max_entries)
+        n = self._count
+        kept = np.vstack(
+            [self._index.reconstruct(i) for i in range(1, n)]
+        ) if n > 1 else np.zeros((0, self.dim), np.float32)
+        self._index = self._faiss.IndexFlatIP(self.dim)
+        if len(kept):
+            self._index.add(np.ascontiguousarray(kept, np.float32))
+        self._count = n - 1
+
+
+def default_embedder() -> "tuple[Callable[[str], np.ndarray], int]":
+    """(embed_fn, dim): sentence-transformers when installed AND its model is
+    already cached locally, else n-grams. The probe runs HF-offline so a
+    router in an air-gapped cluster fails fast to the fallback instead of
+    stalling startup on download retries; pre-download the model (or bake it
+    into the image) to activate the real embedder."""
+    import os
+
+    prev = os.environ.get("HF_HUB_OFFLINE")
+    os.environ["HF_HUB_OFFLINE"] = "1"
+    try:
+        emb = SentenceTransformerEmbedder()
+        logger.info("semantic cache: sentence-transformers embedder (dim=%d)", emb.dim)
+        return emb, emb.dim
+    except Exception:  # noqa: BLE001 - package absent or model not cached
+        return ngram_hash_embed, DIM
+    finally:
+        if prev is None:
+            os.environ.pop("HF_HUB_OFFLINE", None)
+        else:
+            os.environ["HF_HUB_OFFLINE"] = prev
+
+
+def default_index(dim: int):
+    """FAISS IndexFlatIP when installed, else exact numpy search."""
+    try:
+        idx = FaissIndex(dim)
+        logger.info("semantic cache: FAISS IndexFlatIP backend")
+        return idx
+    except Exception:  # noqa: BLE001
+        return NumpyIndex(dim)
+
+
 class SemanticCache:
     def __init__(
         self,
         threshold: float = 0.92,
         max_entries: int = 4096,
         embed: Optional[Callable[[str], np.ndarray]] = None,
+        index=None,
     ):
         self.threshold = threshold
         self.max_entries = max_entries
-        self.embed = embed or ngram_hash_embed
-        self.vectors = np.zeros((0, DIM), np.float32)
+        if embed is None:
+            embed, dim = default_embedder()
+        else:
+            dim = getattr(embed, "dim", DIM)
+        self.embed = embed
+        self.index = index if index is not None else default_index(dim)
         self.entries: list[dict] = []
         self.hits = 0
         self.misses = 0
@@ -70,12 +190,10 @@ class SemanticCache:
         if prompt is None or len(self.entries) == 0:
             self.misses += 1
             return None
-        q = self.embed(prompt)
-        sims = self.vectors @ q
-        best = int(np.argmax(sims))
-        if sims[best] >= self.threshold:
+        sim, best = self.index.search(self.embed(prompt))
+        if best >= 0 and sim >= self.threshold:
             self.hits += 1
-            logger.info("semantic cache hit (sim=%.3f)", float(sims[best]))
+            logger.info("semantic cache hit (sim=%.3f)", sim)
             return self.entries[best]["response"]
         self.misses += 1
         return None
@@ -84,9 +202,8 @@ class SemanticCache:
         prompt = self._prompt_of(body)
         if prompt is None:
             return
-        q = self.embed(prompt)
-        self.vectors = np.vstack([self.vectors, q[None]])
+        self.index.add(self.embed(prompt))
         self.entries.append({"response": response, "ts": time.time()})
         if len(self.entries) > self.max_entries:
-            self.vectors = self.vectors[1:]
+            self.index.pop_front()
             self.entries.pop(0)

@@ -26,8 +26,14 @@ async def cancel_task(task: Optional["asyncio.Task"]) -> None:
     task.cancel()
     try:
         await task
-    except (asyncio.CancelledError, Exception):  # noqa: BLE001
-        pass
+    except asyncio.CancelledError:
+        if not task.cancelled():
+            # the CancelledError came from OUR caller being cancelled
+            # mid-shutdown, not from the awaited task — propagate it
+            raise
+    except Exception as e:  # noqa: BLE001
+        logger.warning("background task %r died: %s: %s",
+                       task.get_name(), type(e).__name__, e)
 
 
 class SingletonMeta(type):

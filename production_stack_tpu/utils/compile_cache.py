@@ -1,0 +1,86 @@
+"""Persistent XLA compilation cache wiring.
+
+Cold compiles on a network-attached TPU cost 20-40 s per program variant;
+a serving engine compiles dozens of (batch bucket, pages bucket) shapes at
+startup. The reference stack never pays this (vLLM ships precompiled CUDA
+kernels); the TPU-native equivalent is JAX's persistent compilation cache,
+which serves every repeat compile from disk — across engine restarts, test
+runs, and bench invocations.
+
+Called from engine startup (engine/engine.py), the test harness
+(tests/conftest.py), and bench.py. In Kubernetes the cache directory is a
+PVC mounted into the engine pod (helm/templates/deployment-engine.yaml) so
+restarts and same-model replicas skip straight to warm starts.
+"""
+
+from __future__ import annotations
+
+import os
+
+from production_stack_tpu.utils.logging import init_logger
+
+logger = init_logger(__name__)
+
+_DEFAULT_DIR = os.path.join(
+    os.environ.get("PSTPU_CACHE_ROOT", os.path.expanduser("~/.cache")),
+    "production_stack_tpu",
+    "xla_cache",
+)
+
+_enabled_dir: str | None = None
+
+
+def enable_persistent_cache(
+    cache_dir: str | None = None, scope: str | None = None
+) -> str | None:
+    """Point JAX's compilation cache at a persistent directory. Idempotent.
+
+    Resolution order: explicit arg > $PSTPU_COMPILE_CACHE_DIR > JAX's own
+    $JAX_COMPILATION_CACHE_DIR (left untouched if set) > ~/.cache default.
+    Set PSTPU_COMPILE_CACHE_DIR=off to disable. Returns the directory in
+    effect, or None when disabled.
+
+    ``scope`` appends a subdirectory — multi-host serving passes its process
+    topology (engine/engine.py): an executable compiled for one topology
+    must never be served to another (same device ids, different process
+    boundaries — observed to hang the jax.distributed rendezvous), and
+    per-process subdirs also keep concurrent writers apart.
+    """
+    global _enabled_dir
+    import jax
+
+    env = os.environ.get("PSTPU_COMPILE_CACHE_DIR")
+    cache_dir = cache_dir or env
+    if cache_dir in ("off", "none", "0"):
+        return None
+    if cache_dir is None:
+        # respect a cache dir the operator already configured via JAX's env
+        cache_dir = jax.config.jax_compilation_cache_dir
+    if cache_dir is None:
+        # Default-on only for TPU backends, where a cold compile costs
+        # 20-40 s per program. XLA:CPU AOT cache loads are NOT robust: an
+        # entry written by a process with different CPU tuning features
+        # (e.g. TensorFlow loaded via sentence-transformers flips
+        # prefer-no-scatter/-gather) fails the loader's machine check and
+        # can spin for minutes per entry — observed hanging engine startup.
+        # CPU users opt in with an explicit dir (tests/conftest.py does).
+        if jax.default_backend() != "tpu":
+            return None
+        cache_dir = _DEFAULT_DIR
+    if scope:
+        cache_dir = os.path.join(cache_dir, scope)
+    if _enabled_dir == cache_dir:
+        return _enabled_dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # default thresholds (1 s / 0 bytes) skip exactly the small programs
+        # whose compiles add up across a 150-test suite — cache everything
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _enabled_dir = cache_dir
+        logger.info("persistent XLA compilation cache at %s", cache_dir)
+    except Exception as e:  # noqa: BLE001 - cache is an optimization, never fatal
+        logger.warning("compilation cache disabled (%s: %s)", type(e).__name__, e)
+        return None
+    return _enabled_dir

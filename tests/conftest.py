@@ -17,6 +17,15 @@ import jax  # noqa: E402
 if not os.environ.get("PSTPU_TEST_TPU"):
     jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: every engine test pays fresh jit compiles
+# otherwise, which is what kept the fast suite from finishing in CI time.
+# Repo-local so the first full run warms every later one.
+from production_stack_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache(
+    os.path.join(os.path.dirname(__file__), os.pardir, ".cache", "xla")
+)
+
 import pytest  # noqa: E402
 
 

@@ -53,9 +53,13 @@ class FakeRunner:
     def reset_kv(self):
         self.calls.append(("reset_kv", (), {}))
 
-    def get_page(self, pid):  # NOT replicated
+    def get_page(self, pid):  # replicated (SPMD page gather), local return
         self.calls.append(("get_page", (pid,), {}))
         return "page"
+
+    def get_page_device(self, pid):  # NOT replicated (leader-local staging)
+        self.calls.append(("get_page_device", (pid,), {}))
+        return "dev-page"
 
 
 def _free_port():
@@ -90,16 +94,17 @@ def test_broadcast_and_follow():
     assert wrapped.step(arr, k=2) == "local-result"
     assert wrapped.step_multi("x") == "multi"
     wrapped.reset_kv()
-    assert wrapped.get_page(7) == "page"  # local-only
+    assert wrapped.get_page(7) == "page"  # replicated, local return value
+    assert wrapped.get_page_device(9) == "dev-page"  # local-only
     bc.close()
     assert done.wait(10)
 
     names = [c[0] for c in follower_runner.calls]
-    assert names == ["step", "step_multi", "reset_kv"]  # no get_page
+    assert names == ["step", "step_multi", "reset_kv", "get_page"]
     np.testing.assert_array_equal(follower_runner.calls[0][1][0], arr)
     assert follower_runner.calls[0][2] == {"k": 2}
     assert [c[0] for c in leader_runner.calls] == [
-        "step", "step_multi", "reset_kv", "get_page",
+        "step", "step_multi", "reset_kv", "get_page", "get_page_device",
     ]
 
 
@@ -165,6 +170,25 @@ def test_codec_roundtrip_no_pickle():
         _pack_call("step", (object(),), {})
 
 
+def test_codec_roundtrips_bfloat16_pages():
+    """KV pages cross the stream as ml_dtypes.bfloat16 — an extended dtype
+    whose .str form ('|V2') is NOT round-trippable; the codec must carry the
+    dtype by name (regression: set_page replay crashed followers)."""
+    import ml_dtypes
+
+    page = (np.arange(64, dtype=np.float32) / 7).astype(ml_dtypes.bfloat16)
+    page = page.reshape(2, 8, 2, 2)
+    _, args, _ = _unpack_call(_pack_call("set_page", (3, page, page * 2), {}))
+    pid, k, v = args
+    assert pid == 3
+    assert k.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(k, page)
+    np.testing.assert_array_equal(v, page * 2)
+    # bf16 scalars too
+    s = _unpack_call(_pack_call("x", (ml_dtypes.bfloat16(1.5),), {}))[1][0]
+    assert s == ml_dtypes.bfloat16(1.5) and s.dtype == ml_dtypes.bfloat16
+
+
 _E2E = """
 import sys, asyncio, json
 sys.path.insert(0, {root!r})
@@ -183,6 +207,12 @@ cfg = EngineConfig(
     worker_sync_port={sync_port},
     enable_lora=True, max_loras=2, max_lora_rank=8,
     enable_sleep_mode=True,
+    # KV offload tiers + kvaware controller under multi-host serving:
+    # leader-owned tiers, REPLICATED get_page/set_page SPMD page moves
+    kv_offload_cpu_gb=0.001,
+    kv_controller_url="127.0.0.1:{ctl_port}",
+    kv_instance_id="mh-engine",
+    advertise_host="127.0.0.1",
 )
 
 async def run():
@@ -198,19 +228,35 @@ asyncio.run(run())
 @pytest.mark.slow
 def test_two_process_serving_e2e():
     """Leader + follower over jax.distributed on CPU: a completion served
-    through the leader's HTTP API with the mesh spanning both processes."""
-    coord, sync, http = _free_port(), _free_port(), _free_port()
+    through the leader's HTTP API with the mesh spanning both processes —
+    plus KV offload tiers (spill + restore via replicated SPMD page moves)
+    and kvaware-routing controller registration from the 2-host engine."""
+    import asyncio
+
+    from production_stack_tpu.kvoffload import controller as ctl
+
+    coord, sync, http, ctl_port = (
+        _free_port(), _free_port(), _free_port(), _free_port(),
+    )
     env = dict(
         os.environ,
         XLA_FLAGS="--xla_force_host_platform_device_count=4",
         JAX_PLATFORMS="",
     )
+    # KV-index controller in this process (the router-side component)
+    ctl_loop = asyncio.new_event_loop()
+    ctl_thread = threading.Thread(target=ctl_loop.run_forever, daemon=True)
+    ctl_thread.start()
+    asyncio.run_coroutine_threadsafe(
+        ctl.serve("127.0.0.1", ctl_port), ctl_loop
+    ).result(30)
     procs = []
     try:
         for pid in (0, 1):
             code = _E2E.format(
                 root=os.path.abspath(ROOT), http_port=http,
                 coord_port=coord, pid=pid, sync_port=sync,
+                ctl_port=ctl_port,
             )
             procs.append(
                 subprocess.Popen(
@@ -250,6 +296,8 @@ def test_two_process_serving_e2e():
             pytest.fail(f"leader never served: {last_err}")
         _lora_roundtrip(http)
         _sleep_wake_roundtrip(http)
+        _offload_roundtrip(http)
+        _kvaware_roundtrip(http, ctl_port)
         # prove the control dispatches actually REPLICATED to the follower
         # (a LoRA load that only lands on the leader would still serve
         # plausible tokens — the follower's replay marker is the evidence)
@@ -257,13 +305,18 @@ def test_two_process_serving_e2e():
         follower_out = procs[1].communicate()[0].decode(errors="replace")
         for marker in ("follower replayed set_lora_slot",
                        "follower replayed drop_kv_pools",
-                       "follower replayed reset_kv"):
+                       "follower replayed reset_kv",
+                       # offload spill fetched a page via the replicated
+                       # SPMD gather on BOTH processes
+                       "follower replayed get_page"):
             assert marker in follower_out, (marker, follower_out[-3000:])
     finally:
         for p in procs:
             p.kill()
         for p in procs:
             p.wait(timeout=30)
+        ctl_loop.call_soon_threadsafe(ctl_loop.stop)
+        ctl_thread.join(timeout=10)
 
 
 def _post_json(http_port: int, url_path: str, payload: dict):
@@ -311,6 +364,72 @@ def _lora_roundtrip(http_port: int) -> None:
         "max_tokens": 3, "temperature": 0.0,
     })
     assert body["usage"]["completion_tokens"] == 3
+
+
+def _metric(http_port: int, name: str) -> float:
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{http_port}/metrics", timeout=30
+    ) as r:
+        for line in r.read().decode().splitlines():
+            if line.startswith(f"vllm:{name}{{"):
+                return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _offload_roundtrip(http_port: int) -> None:
+    """KV offload under multi-host: fill the 32-page pool until prompt A's
+    pages spill to the leader's CPU tier (replicated get_page gathers each
+    page across BOTH processes), then re-serve A and verify the restored KV
+    reproduces the greedy output exactly."""
+    prompt_a = "offload me across two hosts please " * 1  # ~36 tokens, 5 pages
+
+    def greedy(prompt):
+        body = _post_json(http_port, "/v1/completions", {
+            "model": "llama-debug", "prompt": prompt,
+            "max_tokens": 3, "temperature": 0.0, "ignore_eos": True,
+        })
+        return body["choices"][0]["text"]
+
+    first = greedy(prompt_a)
+    for i in range(10):  # evict A's pages
+        greedy(f"filler prompt number {i:02d} with padding text")
+    assert _metric(http_port, "kv_offload_saved_pages_total") > 0, \
+        "pool pressure should have spilled pages to the leader's CPU tier"
+    again = greedy(prompt_a)
+    assert again == first, "restored KV must reproduce greedy output"
+    assert _metric(http_port, "kv_offload_loaded_pages_total") > 0
+
+
+def _kvaware_roundtrip(http_port: int, ctl_port: int) -> None:
+    """kvaware routing against the 2-host engine: the leader registered with
+    the KV-index controller and reported admitted chunk hashes; a router-side
+    lookup for a served prompt resolves to the leader's advertised URL."""
+    import asyncio
+
+    from production_stack_tpu.kvoffload import controller as ctl
+
+    # tokens exactly as the engine hashes them (its own /tokenize)
+    prompt = "offload me across two hosts please "
+    toks = _post_json(http_port, "/tokenize", {"prompt": prompt})["tokens"]
+
+    async def lookup():
+        c = ctl.ControllerClient(f"127.0.0.1:{ctl_port}")
+        try:
+            return await c.lookup(toks)
+        finally:
+            await c.close()
+
+    deadline = time.time() + 60  # reporter thread batches asynchronously
+    while time.time() < deadline:
+        res = asyncio.run(lookup())
+        if res.get("instance_id") == "mh-engine":
+            assert res["url"] == f"http://127.0.0.1:{http_port}"
+            assert res["matched_chunks"] >= 1
+            return
+        time.sleep(1.0)
+    raise AssertionError(f"controller never indexed the 2-host engine: {res}")
 
 
 def _sleep_wake_roundtrip(http_port: int) -> None:

@@ -1,0 +1,202 @@
+"""Optional-import backends for the experimental router features: the
+sentence-transformers/FAISS semantic-cache adapters and the Presidio PII
+tier, proven against fake modules (the real packages are absent here, as in
+any hermetic environment — the adapters activate when they are installed).
+
+Reference: semantic_cache/db_adapters/faiss_adapter.py:14-134 and
+pii/analyzers/presidio.py:45 in /root/reference.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.router.pii import (
+    PresidioAnalyzer,
+    RegexAnalyzer,
+    make_analyzer,
+)
+from production_stack_tpu.router.semantic_cache import (
+    FaissIndex,
+    NumpyIndex,
+    SemanticCache,
+    SentenceTransformerEmbedder,
+    default_embedder,
+    default_index,
+    ngram_hash_embed,
+)
+
+
+# -- fakes standing in for the optional packages ----------------------------
+
+
+class _FakeFlatIP:
+    """faiss.IndexFlatIP: dense rows, inner-product top-1 search."""
+
+    def __init__(self, dim):
+        self.dim = dim
+        self.rows = np.zeros((0, dim), np.float32)
+
+    def add(self, arr):
+        self.rows = np.vstack([self.rows, np.asarray(arr, np.float32)])
+
+    def search(self, q, k):
+        sims = self.rows @ np.asarray(q, np.float32)[0]
+        order = np.argsort(-sims)[:k]
+        return sims[order][None], order[None]
+
+    def reconstruct(self, i):
+        return self.rows[i]
+
+
+class _FakeFaissModule:
+    IndexFlatIP = _FakeFlatIP
+
+
+class _FakeSTModel:
+    def __init__(self, name):
+        self.name = name
+
+    def get_sentence_embedding_dimension(self):
+        return 8
+
+    def encode(self, texts):
+        # deterministic text-dependent vectors
+        return [
+            np.array(
+                [float((hash((t, i)) % 1000) - 500) for i in range(8)], np.float32
+            )
+            for t in texts
+        ]
+
+
+class _FakeSTModule:
+    SentenceTransformer = _FakeSTModel
+
+
+class _FakePresidioResult:
+    def __init__(self, entity_type, start, end):
+        self.entity_type = entity_type
+        self.start = start
+        self.end = end
+
+
+class _FakePresidioEngine:
+    def analyze(self, text, language):
+        assert language == "en"
+        i = text.find("Alice")
+        return [_FakePresidioResult("PERSON", i, i + 5)] if i >= 0 else []
+
+
+# -- semantic cache ---------------------------------------------------------
+
+
+def _chat_body(text):
+    return json.dumps(
+        {"messages": [{"role": "user", "content": text}]}
+    ).encode()
+
+
+class TestFaissAdapter:
+    def test_add_search_evict_matches_numpy(self):
+        fa = FaissIndex(4, module=_FakeFaissModule())
+        npx = NumpyIndex(4)
+        rng = np.random.RandomState(0)
+        vs = [v / np.linalg.norm(v) for v in rng.randn(5, 4).astype(np.float32)]
+        for v in vs:
+            fa.add(v)
+            npx.add(v)
+        q = vs[3]
+        assert fa.search(q)[1] == npx.search(q)[1] == 3
+        assert np.isclose(fa.search(q)[0], npx.search(q)[0], atol=1e-6)
+        fa.pop_front()
+        npx.pop_front()
+        assert len(fa) == len(npx) == 4
+        # indices shifted by one after eviction; same best match
+        assert fa.search(q)[1] == npx.search(q)[1] == 2
+
+    def test_empty_index_misses(self):
+        fa = FaissIndex(4, module=_FakeFaissModule())
+        assert fa.search(np.ones(4, np.float32)) == (-1.0, -1)
+
+
+class TestSentenceTransformerAdapter:
+    def test_normalized_and_dim(self):
+        emb = SentenceTransformerEmbedder("m", module=_FakeSTModule())
+        assert emb.dim == 8
+        v = emb("hello world")
+        assert v.shape == (8,)
+        assert np.isclose(np.linalg.norm(v), 1.0, atol=1e-5)
+        # deterministic
+        assert np.allclose(v, emb("hello world"))
+
+
+class TestSemanticCacheWithBackends:
+    def test_hit_through_faiss_and_st(self):
+        emb = SentenceTransformerEmbedder("m", module=_FakeSTModule())
+        cache = SemanticCache(
+            threshold=0.99, embed=emb, index=FaissIndex(8, module=_FakeFaissModule())
+        )
+
+        async def run():
+            await cache.store(_chat_body("what is the capital of France"), {"a": 1})
+            hit = await cache.check(_chat_body("what is the capital of France"))
+            miss = await cache.check(_chat_body("how do rockets work"))
+            return hit, miss
+
+        hit, miss = asyncio.run(run())
+        assert hit == {"a": 1}
+        assert miss is None
+
+    def test_eviction_keeps_entries_aligned(self):
+        cache = SemanticCache(
+            threshold=0.99, max_entries=2, embed=ngram_hash_embed,
+            index=FaissIndex(256, module=_FakeFaissModule()),
+        )
+
+        async def run():
+            for i, text in enumerate(["alpha bravo", "charlie delta", "echo foxtrot"]):
+                await cache.store(_chat_body(text), {"i": i})
+            # oldest ("alpha bravo") evicted; the others still resolve
+            assert await cache.check(_chat_body("alpha bravo")) is None
+            assert (await cache.check(_chat_body("charlie delta")))["i"] == 1
+            assert (await cache.check(_chat_body("echo foxtrot")))["i"] == 2
+
+        asyncio.run(run())
+
+    def test_defaults_fall_back_without_packages(self, monkeypatch):
+        # when the optional packages are absent (simulated — importing the
+        # real sentence-transformers costs ~30 s of torch/TF imports even
+        # when installed), resolution must land on the fallbacks
+        from production_stack_tpu.router import semantic_cache as sc
+
+        def boom(*a, **kw):
+            raise ImportError("not installed")
+
+        monkeypatch.setattr(sc, "SentenceTransformerEmbedder", boom)
+        monkeypatch.setattr(sc, "FaissIndex", boom)
+        emb, dim = default_embedder()
+        assert emb is ngram_hash_embed and dim == 256
+        assert isinstance(default_index(dim), NumpyIndex)
+
+
+# -- PII --------------------------------------------------------------------
+
+
+class TestPresidioAdapter:
+    def test_presidio_matches(self):
+        a = PresidioAnalyzer(engine=_FakePresidioEngine())
+        ms = a.analyze("hello Alice of wonderland")
+        assert len(ms) == 1
+        assert ms[0].kind == "PERSON"
+        assert ms[0].text == "Alice"
+
+    def test_make_analyzer_falls_back_to_regex(self):
+        assert isinstance(make_analyzer("auto"), RegexAnalyzer)
+        assert isinstance(make_analyzer("regex"), RegexAnalyzer)
+
+    def test_make_analyzer_presidio_required_raises_without_package(self):
+        with pytest.raises(RuntimeError):
+            make_analyzer("presidio")

@@ -243,3 +243,165 @@ class TestShardedKernel:
         ids_p, log_p = run("pallas_interpret")
         np.testing.assert_array_equal(ids_p, ids_x)
         np.testing.assert_allclose(log_p, log_x, rtol=5e-2, atol=5e-2)
+
+
+class TestAutoImplResolution:
+    """attn_impl=auto must only pick pallas when the mesh can actually run it:
+    the sharded kernel's shard_map specs split heads over tp, so uneven head
+    counts (e.g. 2 KV heads at tp=4) must fall back to the XLA gather path."""
+
+    def _resolve(self, monkeypatch, tp, dp=1, num_heads=4, num_kv_heads=2):
+        import jax
+
+        from production_stack_tpu.engine.runner import ModelRunner
+        from production_stack_tpu.models import llama
+        from production_stack_tpu.parallel.mesh import make_mesh
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        cfg = dataclasses.replace(
+            llama.PRESETS["llama-debug"],
+            num_heads=num_heads, num_kv_heads=num_kv_heads, attn_impl="auto",
+        )
+        r = ModelRunner(
+            cfg, mesh=make_mesh(tp=tp, dp=dp), num_pages=16, page_size=8, seed=0
+        )
+        return r.cfg.attn_impl
+
+    def test_even_heads_pick_pallas(self, monkeypatch, eight_devices):
+        assert self._resolve(monkeypatch, tp=2) == "pallas"
+
+    def test_uneven_kv_heads_fall_back_to_xla(self, monkeypatch, eight_devices):
+        assert self._resolve(monkeypatch, tp=4) == "xla"
+
+    def test_uneven_heads_fall_back_to_xla(self, monkeypatch, eight_devices):
+        # 6 q / 2 kv heads at tp=4: neither divides (for valid GQA configs
+        # tp | kv_heads already implies tp | num_heads, so the q check only
+        # fires together with the kv one)
+        assert (
+            self._resolve(monkeypatch, tp=4, num_heads=6, num_kv_heads=2) == "xla"
+        )
+
+
+class TestShardedKernelOnParallelMeshes:
+    """pallas decode on sp/ep/pp meshes (VERDICT r2 #4): the sharded kernel
+    maps sp/ep replicated-manual, and under pp it nests inside the
+    pipeline's manual region with stage-local layer pools — no more XLA
+    gather fallback for exactly the configs where bandwidth matters most."""
+
+    def _run(self, attn_impl, mesh_kw, cfg, prefill, dec_ids):
+        from production_stack_tpu.engine.runner import ModelRunner, StepInput
+        from production_stack_tpu.parallel.mesh import make_mesh
+
+        B = prefill.input_ids.shape[0]
+        T = prefill.input_ids.shape[1]
+        r = ModelRunner(
+            dataclasses.replace(cfg, attn_impl=attn_impl),
+            mesh=make_mesh(**mesh_kw), num_pages=32, page_size=8, seed=0,
+        )
+        r.step(prefill)
+        dec = StepInput(
+            input_ids=dec_ids, positions=np.full((B, 1), T),
+            page_table=prefill.page_table, kv_lens=np.full((B,), T + 1),
+            temperature=np.zeros(B), top_k=np.zeros(B, int), top_p=np.ones(B),
+        )
+        ids, logits = r.step(dec)
+        return np.asarray(ids), np.asarray(logits)
+
+    @pytest.mark.parametrize(
+        "mesh_kw",
+        [{"pp": 2, "tp": 2}, {"sp": 2, "tp": 2}, {"ep": 2, "tp": 2}],
+        ids=["pp2xtp2", "sp2xtp2", "ep2xtp2"],
+    )
+    def test_matches_xla_on_mesh(self, mesh_kw, eight_devices):
+        from production_stack_tpu.engine.runner import StepInput
+        from production_stack_tpu.models import llama
+
+        cfg = dataclasses.replace(
+            llama.PRESETS["llama-debug"], num_heads=8, num_kv_heads=4
+        )
+        rng = np.random.RandomState(0)
+        B, T = 2, 16
+        prefill = StepInput(
+            input_ids=rng.randint(0, cfg.vocab_size, (B, T)),
+            positions=np.broadcast_to(np.arange(T), (B, T)).copy(),
+            page_table=np.arange(B * 4).reshape(B, 4),
+            kv_lens=np.full((B,), T),
+            temperature=np.zeros(B), top_k=np.zeros(B, int), top_p=np.ones(B),
+        )
+        dec_ids = rng.randint(0, cfg.vocab_size, (B, 1))
+        ids_x, log_x = self._run("xla", mesh_kw, cfg, prefill, dec_ids)
+        ids_p, log_p = self._run("pallas_interpret", mesh_kw, cfg, prefill, dec_ids)
+        np.testing.assert_array_equal(ids_p, ids_x)
+        np.testing.assert_allclose(log_p, log_x, rtol=5e-2, atol=5e-2)
+
+    def test_parallel_meshes_resolve_pallas(self, monkeypatch, eight_devices):
+        """sp/ep/pp serving meshes now pick the kernel on TPU (r2 VERDICT #4
+        — they used to regress decode to the XLA gather path)."""
+        import jax
+
+        from production_stack_tpu.engine.runner import ModelRunner
+        from production_stack_tpu.models import llama
+        from production_stack_tpu.parallel.mesh import make_mesh
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        for mesh_kw in ({"pp": 2, "tp": 2}, {"sp": 2, "tp": 2},
+                        {"ep": 2, "tp": 2}, {"sp": 2, "ep": 2, "tp": 2}):
+            cfg = dataclasses.replace(
+                llama.PRESETS["llama-debug"],
+                num_heads=8, num_kv_heads=4, attn_impl="auto",
+            )
+            r = ModelRunner(
+                cfg, mesh=make_mesh(**mesh_kw), num_pages=16, page_size=8,
+                seed=0,
+            )
+            assert r.cfg.attn_impl == "pallas", mesh_kw
+
+
+class TestMultiPageBlocks:
+    """pages_per_block > 1: N pages stream per grid cell (each its own input
+    block), shrinking the grid N-fold — the fix for small-page decode
+    throughput (876 tok/s at page 16 vs 1,501 at 128, engine/config.py)."""
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 8])
+    def test_matches_oracle_any_block_factor(self, n):
+        q, kp, vp, pt = _case(B=3, NH=8, KH=2, D=64, page=8, P=32, maxp=8, seed=11)
+        lens = jnp.asarray([5, 33, 64], jnp.int32)
+        ref = paged_attention_decode(q, kp, vp, pt, lens)
+        out = ragged_paged_attention_decode(
+            q, kp, vp, pt, lens, interpret=True, pages_per_block=n
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5, err_msg=f"n={n}"
+        )
+
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_window_with_multipage_blocks(self, n):
+        q, kp, vp, pt = _case(B=2, NH=4, KH=2, D=32, page=8, P=16, maxp=8, seed=12)
+        lens = jnp.asarray([64, 49], jnp.int32)
+        for w in (5, 16, 40):
+            ref = paged_attention_decode(q, kp, vp, pt, lens, window=w)
+            out = ragged_paged_attention_decode(
+                q, kp, vp, pt, lens, window=w, interpret=True, pages_per_block=n
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+                err_msg=f"n={n} w={w}",
+            )
+
+    def test_has_cur_with_multipage_blocks(self):
+        q, kp, vp, pt = _case(B=2, NH=4, KH=2, D=32, page=8, P=16, maxp=4, seed=13)
+        lens = jnp.asarray([9, 26], jnp.int32)
+        rng = np.random.RandomState(14)
+        kc = jnp.asarray(rng.randn(2, 2, 32), q.dtype)
+        vc = jnp.asarray(rng.randn(2, 2, 32), q.dtype)
+        ref = ragged_paged_attention_decode(
+            q, kp, vp, pt, lens, interpret=True, k_cur=kc, v_cur=vc,
+            pages_per_block=1,
+        )
+        out = ragged_paged_attention_decode(
+            q, kp, vp, pt, lens, interpret=True, k_cur=kc, v_cur=vc,
+            pages_per_block=4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )

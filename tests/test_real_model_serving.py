@@ -46,6 +46,8 @@ def model_dir(tmp_path_factory):
         eos_token="</s>", pad_token="</s>",
     )
     fast.chat_template = (
+        "{% if tools %}{% for t in tools %}"
+        "{{ t.function.name }} {% endfor %}{% endif %}"
         "{% for m in messages %}{{ m['content'] }} {% endfor %}"
     )
     fast.save_pretrained(path)
@@ -137,6 +139,36 @@ def test_tokenize_uses_real_tokenizer(server):
     r2 = requests.post(f"{server}/detokenize",
                        json={"tokens": body["tokens"]}, timeout=60)
     assert "cat" in r2.json()["prompt"]
+
+
+def test_tools_render_through_real_hf_template(server):
+    """A `tools` request flows through the REAL HF tokenizer's chat template
+    (the template above renders tool names): the engine's prompt grows by
+    exactly the schema tokens, and the request round-trips the tool-calling
+    surface (tutorial 13) on the production model path."""
+    msgs = [{"role": "user", "content": "the cat sat"}]
+    tools = [
+        {"type": "function",
+         "function": {"name": "dog", "parameters": {"type": "object"}}},
+        {"type": "function",
+         "function": {"name": "fish", "parameters": {"type": "object"}}},
+    ]
+    def ptoks(body):
+        r = requests.post(
+            f"{server}/v1/chat/completions",
+            json={"model": "tiny-llama", "max_tokens": 2,
+                  "temperature": 0.0, "ignore_eos": True, **body},
+            timeout=120,
+        )
+        r.raise_for_status()
+        return r.json()["usage"]["prompt_tokens"]
+
+    base = ptoks({"messages": msgs})
+    with_tools = ptoks({"messages": msgs, "tools": tools})
+    # word-level tokenizer: the two rendered tool names add exactly 2 tokens
+    assert with_tools == base + 2
+    # tool_choice=none drops the schemas again
+    assert ptoks({"messages": msgs, "tools": tools, "tool_choice": "none"}) == base
 
 
 def test_greedy_matches_hf_reference(server, model_dir):

@@ -164,7 +164,11 @@ class TestExperimentalFeatures:
         router, base = _start_router(
             urls,
             extra=["--feature-gates", "SemanticCache=true,PIIDetection=true",
-                   "--pii-policy", "block", "--semantic-cache-threshold", "0.99"],
+                   "--pii-policy", "block", "--semantic-cache-threshold", "0.99",
+                   # the auto embedder probe imports sentence-transformers
+                   # (~30 s of torch/TF imports) — pin the fast fallback so
+                   # router startup stays inside the health-wait budget
+                   "--semantic-cache-embedder", "ngram"],
         )
         try:
             # PII gets blocked

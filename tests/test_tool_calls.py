@@ -1,0 +1,453 @@
+"""Tool calling: streaming parser units, chat-template rendering, and the
+OpenAI surface end-to-end (scripted engine -> real HTTP server -> parsed
+`tool_calls` + `finish_reason`).
+
+Reference behavior: vLLM engine flags render tool schemas into the chat
+template and parse tool-call output back into `message.tool_calls`
+(/root/reference/tutorials/13-tool-enabled-installation.md); here the engine
+is ours, so the whole path is first-party (engine/tool_parser.py).
+"""
+
+import asyncio
+import json
+import threading
+
+import pytest
+import requests
+
+from production_stack_tpu.engine.tokenizer import ByteTokenizer
+from production_stack_tpu.engine.tool_parser import (
+    StreamingToolParser,
+    parse_tool_calls,
+)
+
+TOOLS = [
+    {
+        "type": "function",
+        "function": {
+            "name": "get_weather",
+            "parameters": {
+                "type": "object",
+                "properties": {"city": {"type": "string"}},
+            },
+        },
+    }
+]
+
+
+class TestParserUnits:
+    def test_hermes_single_call_with_surrounding_content(self):
+        text = 'Sure! <tool_call>{"name": "get_weather", "arguments": {"city": "SF"}}</tool_call> done'
+        content, calls = parse_tool_calls(text)
+        assert content == "Sure!  done"
+        assert len(calls) == 1
+        assert calls[0]["function"]["name"] == "get_weather"
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "SF"}
+        assert calls[0]["id"].startswith("call_")
+
+    def test_hermes_streaed_one_char_at_a_time(self):
+        text = 'hi <tool_call>{"name": "f", "arguments": {}}</tool_call>'
+        p = StreamingToolParser("auto")
+        events = []
+        for ch in text:
+            events += p.push(ch)
+        events += p.finish()
+        content = "".join(e[1] for e in events if e[0] == "content")
+        assert content == "hi "
+        assert [c["function"]["name"] for c in p.tool_calls] == ["f"]
+
+    def test_hermes_false_prefix_is_flushed(self):
+        # '<tool' that never becomes the tag must come back as content
+        content, calls = parse_tool_calls("a <tool wrench")
+        assert content == "a <tool wrench"
+        assert calls == []
+
+    def test_unclosed_hermes_tag_reverts_to_content(self):
+        text = '<tool_call>{"name": "f"'
+        content, calls = parse_tool_calls(text)
+        assert content == text
+        assert calls == []
+
+    def test_json_whole_output_llama_style(self):
+        text = '{"name": "get_weather", "parameters": {"city": "Paris"}}'
+        content, calls = parse_tool_calls(text)
+        assert content == ""
+        assert json.loads(calls[0]["function"]["arguments"]) == {"city": "Paris"}
+
+    def test_json_array_parallel_calls(self):
+        text = '[{"name": "a", "arguments": {}}, {"name": "b", "arguments": {"x": 1}}]'
+        content, calls = parse_tool_calls(text)
+        assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+    def test_json_that_is_not_a_tool_call_flushes_as_content(self):
+        text = '{"answer": 42}'
+        content, calls = parse_tool_calls(text)
+        assert content == text
+        assert calls == []
+
+    def test_invalid_json_flushes_as_content(self):
+        text = "{not json at all"
+        content, calls = parse_tool_calls(text)
+        assert content == text
+        assert calls == []
+
+    def test_malformed_member_voids_whole_array(self):
+        text = '[{"name": "a", "arguments": {}}, {"no_name": 1}]'
+        content, calls = parse_tool_calls(text)
+        assert calls == []
+        assert content == text
+
+    def test_leading_text_disables_json_mode(self):
+        text = 'The answer is {"name": "f", "arguments": {}}'
+        content, calls = parse_tool_calls(text, style="json")
+        assert calls == []
+        assert content == text
+
+    def test_off_style_passes_everything_through(self):
+        text = '<tool_call>{"name": "f", "arguments": {}}</tool_call>'
+        content, calls = parse_tool_calls(text, style="off")
+        assert content == text
+        assert calls == []
+
+    def test_hermes_two_calls(self):
+        text = (
+            '<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+            '<tool_call>{"name": "b", "arguments": {}}</tool_call>'
+        )
+        content, calls = parse_tool_calls(text)
+        assert content == ""
+        assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+
+class TestTemplateRendering:
+    def test_byte_template_renders_tool_schemas(self):
+        tok = ByteTokenizer()
+        out = tok.apply_chat_template(
+            [{"role": "user", "content": "weather in SF?"}], tools=TOOLS
+        )
+        assert "get_weather" in out
+        assert "<tool_call>" in out  # the calling convention is instructed
+        assert out.endswith("<|assistant|>\n")
+
+    def test_byte_template_round_trips_tool_turns(self):
+        tok = ByteTokenizer()
+        messages = [
+            {"role": "user", "content": "weather?"},
+            {
+                "role": "assistant",
+                "content": None,
+                "tool_calls": [
+                    {
+                        "id": "call_1",
+                        "type": "function",
+                        "function": {
+                            "name": "get_weather",
+                            "arguments": '{"city": "SF"}',
+                        },
+                    }
+                ],
+            },
+            {"role": "tool", "content": '{"temp_c": 18}'},
+        ]
+        out = tok.apply_chat_template(messages, tools=TOOLS)
+        assert '"name": "get_weather"' in out
+        assert "<|tool|>" in out
+        assert '{"temp_c": 18}' in out
+
+    def test_no_tools_no_preamble(self):
+        tok = ByteTokenizer()
+        out = tok.apply_chat_template([{"role": "user", "content": "hi"}])
+        assert "Available tools" not in out
+
+
+class _ScriptedEngine:
+    """Engine stub: yields a fixed sequence of text deltas through the real
+    RequestOutput/async-generator contract, so the HTTP layer above it (the
+    part under test) is exercised for real."""
+
+    def __init__(self, deltas, finish_reason="stop"):
+        self.deltas = deltas
+        self.finish_reason = finish_reason
+        self.tokenizer = ByteTokenizer()
+        self.is_sleeping = False
+        self.lora = None
+        self.prompts = []
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def abort(self, sid):
+        pass
+
+    def list_lora_adapters(self):
+        return []
+
+    def stats(self):
+        return {
+            "num_requests_running": 0, "num_requests_waiting": 0,
+            "gpu_cache_usage_perc": 0.0, "gpu_prefix_cache_hit_rate": 0.0,
+            "gpu_prefix_cache_hits_total": 0,
+            "gpu_prefix_cache_queries_total": 0,
+            "prompt_tokens_total": 0, "generation_tokens_total": 0,
+        }
+
+    async def generate(self, seq_id, prompt_token_ids, params, lora_name=None):
+        from production_stack_tpu.engine.engine import RequestOutput
+
+        self.prompts.append(list(prompt_token_ids))
+        n = len(self.deltas)
+        for i, d in enumerate(self.deltas):
+            yield RequestOutput(
+                seq_id=seq_id, text_delta=d, token_ids=[i],
+                finished=i == n - 1,
+                finish_reason=self.finish_reason if i == n - 1 else None,
+                prompt_tokens=len(prompt_token_ids), completion_tokens=i + 1,
+            )
+            await asyncio.sleep(0)
+
+
+@pytest.fixture()
+def scripted_server():
+    """(make(deltas, **cfg_kw) -> base_url) running on a loop thread."""
+    from production_stack_tpu.engine import api_server
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.testing.procs import free_port
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    runners = []
+    engines = []
+
+    def make(deltas, finish_reason="stop", **cfg_kw):
+        port = free_port()
+        cfg = EngineConfig(model="llama-debug", host="127.0.0.1", port=port, **cfg_kw)
+        eng = _ScriptedEngine(deltas, finish_reason)
+        server, runner = asyncio.run_coroutine_threadsafe(
+            api_server.serve(cfg, engine=eng), loop
+        ).result(30)
+        runners.append(runner)
+        engines.append(eng)
+        return f"http://127.0.0.1:{port}", eng
+
+    yield make
+    for r in runners:
+        try:
+            asyncio.run_coroutine_threadsafe(r.cleanup(), loop).result(10)
+        except Exception:
+            pass
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
+    loop.close()
+
+
+CALL_TEXT = '<tool_call>{"name": "get_weather", "arguments": {"city": "SF"}}</tool_call>'
+
+
+class TestHTTPToolCalls:
+    def test_nonstream_tool_call(self, scripted_server):
+        base, eng = scripted_server(
+            ["I'll check. ", CALL_TEXT[:20], CALL_TEXT[20:]]
+        )
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "weather in SF?"}],
+                "tools": TOOLS,
+            },
+            timeout=30,
+        )
+        r.raise_for_status()
+        choice = r.json()["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        msg = choice["message"]
+        assert msg["content"] == "I'll check. "
+        [call] = msg["tool_calls"]
+        assert call["type"] == "function"
+        assert call["function"]["name"] == "get_weather"
+        assert json.loads(call["function"]["arguments"]) == {"city": "SF"}
+        # the schemas were rendered into the prompt the engine saw
+        prompt_text = eng.tokenizer.decode(eng.prompts[0])
+        assert "get_weather" in prompt_text
+
+    def test_stream_tool_call_deltas(self, scripted_server):
+        base, _ = scripted_server(["hello ", CALL_TEXT[:10], CALL_TEXT[10:]])
+        with requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "weather?"}],
+                "tools": TOOLS,
+                "stream": True,
+            },
+            stream=True, timeout=30,
+        ) as r:
+            r.raise_for_status()
+            chunks = [
+                json.loads(line[5:])
+                for line in r.iter_lines()
+                if line.startswith(b"data:") and b"[DONE]" not in line
+            ]
+        deltas = [c["choices"][0]["delta"] for c in chunks if c.get("choices")]
+        content = "".join(d.get("content") or "" for d in deltas)
+        assert content == "hello "
+        tc = [d["tool_calls"][0] for d in deltas if d.get("tool_calls")]
+        assert len(tc) == 1
+        assert tc[0]["index"] == 0
+        assert tc[0]["function"]["name"] == "get_weather"
+        finishes = [
+            c["choices"][0]["finish_reason"]
+            for c in chunks
+            if c.get("choices") and c["choices"][0].get("finish_reason")
+        ]
+        assert finishes == ["tool_calls"]
+
+    def test_json_style_whole_output(self, scripted_server):
+        base, _ = scripted_server(
+            ['{"name": "get_weather", ', '"parameters": {"city": "NY"}}']
+        )
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "weather?"}],
+                "tools": TOOLS,
+            },
+            timeout=30,
+        )
+        msg = r.json()["choices"][0]["message"]
+        assert msg["content"] is None
+        assert msg["tool_calls"][0]["function"]["name"] == "get_weather"
+
+    def test_no_tools_means_no_parsing(self, scripted_server):
+        base, _ = scripted_server([CALL_TEXT])
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}]},
+            timeout=30,
+        )
+        msg = r.json()["choices"][0]["message"]
+        assert "tool_calls" not in msg
+        assert msg["content"] == CALL_TEXT
+
+    def test_tool_choice_none_disables(self, scripted_server):
+        base, eng = scripted_server([CALL_TEXT])
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": TOOLS,
+                "tool_choice": "none",
+            },
+            timeout=30,
+        )
+        msg = r.json()["choices"][0]["message"]
+        assert "tool_calls" not in msg
+        # schemas are NOT rendered when tool_choice=none
+        assert "get_weather" not in eng.tokenizer.decode(eng.prompts[0])
+
+    def test_tool_choice_named_narrows_schema(self, scripted_server):
+        two = TOOLS + [
+            {"type": "function", "function": {"name": "other_tool", "parameters": {}}}
+        ]
+        base, eng = scripted_server([CALL_TEXT])
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": two,
+                "tool_choice": {"type": "function", "function": {"name": "get_weather"}},
+            },
+            timeout=30,
+        )
+        assert r.json()["choices"][0]["finish_reason"] == "tool_calls"
+        prompt_text = eng.tokenizer.decode(eng.prompts[0])
+        assert "get_weather" in prompt_text
+        assert "other_tool" not in prompt_text
+
+    def test_tool_choice_unknown_tool_400(self, scripted_server):
+        base, _ = scripted_server(["x"])
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": TOOLS,
+                "tool_choice": {"type": "function", "function": {"name": "nope"}},
+            },
+            timeout=30,
+        )
+        assert r.status_code == 400
+
+    def test_model_json_answer_without_tool_shape_stays_content(self, scripted_server):
+        base, _ = scripted_server(['{"answer": 42}'])
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "json please"}],
+                "tools": TOOLS,
+            },
+            timeout=30,
+        )
+        choice = r.json()["choices"][0]
+        assert choice["finish_reason"] == "stop"
+        assert choice["message"]["content"] == '{"answer": 42}'
+        assert "tool_calls" not in choice["message"]
+
+    def test_parser_off_config(self, scripted_server):
+        base, _ = scripted_server([CALL_TEXT], tool_call_parser="off")
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": TOOLS,
+            },
+            timeout=30,
+        )
+        msg = r.json()["choices"][0]["message"]
+        assert "tool_calls" not in msg
+        assert msg["content"] == CALL_TEXT
+
+
+class TestValidation:
+    def test_malformed_tool_entry_400(self, scripted_server):
+        base, _ = scripted_server(["x"])
+        for bad in (["oops"], [{"type": "function"}],
+                    [{"type": "function", "function": {"name": 3}}]):
+            r = requests.post(
+                f"{base}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "tools": bad},
+                timeout=30,
+            )
+            assert r.status_code == 400, bad
+
+    def test_malformed_message_tool_calls_400(self, scripted_server):
+        base, _ = scripted_server(["x"])
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={
+                "messages": [
+                    {"role": "assistant",
+                     "tool_calls": [{"function": {"name": "f", "arguments": {}}}]},
+                ],
+                "tools": TOOLS,
+            },
+            timeout=30,
+        )
+        assert r.status_code == 400  # arguments must be a JSON *string*
+
+    def test_metrics_single_type_line_per_hop(self, scripted_server):
+        base, _ = scripted_server(["hello ", "world"])
+        with requests.post(
+            f"{base}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}],
+                  "stream": True},
+            stream=True, timeout=30,
+        ) as r:
+            for _ in r.iter_lines():
+                pass
+        text = requests.get(f"{base}/metrics", timeout=30).text
+        type_lines = [l for l in text.splitlines() if l.startswith("# TYPE")]
+        assert len(type_lines) == len(set(type_lines)), type_lines
+        assert any("ttft_hop_submit_to_first_token" in l for l in type_lines)
