@@ -270,17 +270,19 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         stack_tps = conc * gen / (time.perf_counter() - t0)
 
         # steady-state decode THROUGH the stack: short prefill, long decode,
-        # fixed concurrency; rate counts only the post-first-chunk window of
-        # each stream, so prefill time is excluded and what remains is the
-        # router/SSE per-chunk overhead on top of the engine's decode rate
+        # fixed concurrency at the engine's full decode batch; rate counts
+        # only the post-first-chunk window of each stream, so prefill time
+        # is excluded and what remains is the router/SSE per-chunk overhead
+        # on top of the engine's decode rate
         dec_gen = 256 if on_tpu else 16
+        dec_conc = 16 if on_tpu else conc
         def decode_request(_i):
             ttft, total, chunks = one_request(dec_gen, prompt_len=64)
             return ttft, total, chunks
-        with cf.ThreadPoolExecutor(conc) as ex:  # warm the long-decode bucket
-            list(ex.map(decode_request, range(conc)))
-        with cf.ThreadPoolExecutor(conc) as ex:
-            res = list(ex.map(decode_request, range(conc)))
+        with cf.ThreadPoolExecutor(dec_conc) as ex:  # warm the bucket
+            list(ex.map(decode_request, range(dec_conc)))
+        with cf.ThreadPoolExecutor(dec_conc) as ex:
+            res = list(ex.map(decode_request, range(dec_conc)))
         decode_rates = [
             (dec_gen - 1) / (total - ttft) for ttft, total, _ in res if total > ttft
         ]
@@ -317,7 +319,7 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             "http_engine_direct_p50_ttft_ms": round(float(np.percentile(eng_ttfts, 50)), 2),
             "http_stack_tokens_per_sec": round(stack_tps, 1),
             "http_decode_tokens_per_sec": round(http_decode_tps, 1),
-            "http_decode_concurrency": conc,
+            "http_decode_concurrency": dec_conc,
             "http_concurrency": conc,
             "http_prefill_tokens": plen,
             "ttft_breakdown_ms": breakdown,

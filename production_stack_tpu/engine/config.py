@@ -22,12 +22,13 @@ class EngineConfig:
     port: int = 8100
     max_num_seqs: int = 64
     max_model_len: int = 4096
-    # KV page size (tokens). Larger pages mean fewer pallas-decode grid cells
-    # per row: measured on v5e (llama-3.2-1b class, B=16, 1k ctx) decode runs
-    # 876 tok/s at 16, 1104 at 32, 1294 at 64, 1501 at 128 — the per-cell
-    # pipeline overhead dominates at 16. 64 keeps prefix-cache sharing 4x
-    # finer than the reference's 256-token LMCache chunks while recovering
-    # most of the throughput.
+    # KV page size (tokens). Larger pages mean fewer (bigger) page DMAs per
+    # decode step: measured on v5e (llama-3.2-1b class, B=16, 1k ctx, with
+    # deferred-burst KV + stacked-pool streaming) decode runs 1037 tok/s at
+    # page 16, 1387 at 32, 1706 at 64, 1954 at 128 — DMA issue rate, not
+    # bandwidth, is the limiter at small pages. 64 keeps prefix-cache
+    # sharing 4x finer than the reference's 256-token LMCache chunks while
+    # recovering most of the throughput.
     page_size: int = 64
     num_pages: Optional[int] = None     # default: sized from kv_cache_memory_gb
     kv_cache_memory_gb: float = 4.0

@@ -432,19 +432,28 @@ class LLMEngine:
                         devs = self.runner.step_multi_pipelined(
                             inp, self.scheduler.decode_steps, batch.bursts, wlp
                         )
+                        # concatenate ON DEVICE and fetch once: each
+                        # np.asarray is a full host<->device round trip
+                        # (~100 ms on a network-attached chip), so per-burst
+                        # fetches would cost bursts*RTT and erase most of
+                        # what chaining saves
+                        import jax.numpy as jnp
+
                         if wlp:
-                            tokens = np.concatenate(
-                                [np.asarray(d[0]) for d in devs], axis=1
-                            )
-                            lp_data = tuple(
-                                np.concatenate(
-                                    [np.asarray(d[1][x]) for d in devs], axis=1
-                                )
-                                for x in range(3)
-                            )
+                            import jax
+
+                            # one pytree fetch: device_get starts all four
+                            # copies together (~1 RTT), where sequential
+                            # np.asarray calls would pay one RTT each
+                            tokens, *lps = jax.device_get((
+                                jnp.concatenate([d[0] for d in devs], axis=1),
+                                *(jnp.concatenate([d[1][x] for d in devs], axis=1)
+                                  for x in range(3)),
+                            ))
+                            lp_data = tuple(lps)
                         else:
-                            tokens = np.concatenate(
-                                [np.asarray(d) for d in devs], axis=1
+                            tokens = np.asarray(
+                                jnp.concatenate(devs, axis=1)
                             )  # [B, bursts*k]
                     elif wlp:
                         toks, lps = self.runner.step_multi(

@@ -179,12 +179,14 @@ def forward(
     page_table: jnp.ndarray,
     kv_lens: jnp.ndarray,
     all_logits: bool = False,
+    kv_burst=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
-    Same contract as models/llama.py:forward; returns (logits[B, V] for each
-    sequence's last valid token — [B, T, V] when ``all_logits``, used by
-    speculative verify — and updated k_pages, v_pages).
+    Same contract as models/llama.py:forward (including ``kv_burst``
+    deferred-scatter decode); returns (logits[B, V] for each sequence's last
+    valid token — [B, T, V] when ``all_logits``, used by speculative verify
+    — and updated k_pages, v_pages; with ``kv_burst``: the accumulators).
     """
     from production_stack_tpu.ops.rope import apply_rope, rope_cos_sin
 
@@ -196,19 +198,52 @@ def forward(
     eps = cfg.rms_norm_eps
 
     post_write = cfg.kv_write_mode == "post"
-    if post_write:
+    burst = kv_burst is not None
+    if burst:
+        if not post_write or T != 1:
+            raise ValueError("kv_burst requires kv_write_mode='post' decode")
+        k_acc0, v_acc0, burst_counts = kv_burst
+        C = k_acc0.shape[2]
+        from production_stack_tpu.ops.attention import burst_kv_positions
+
+        kv_pos = burst_kv_positions(
+            kv_lens, burst_counts + 1,
+            page_table.shape[1] * k_pages.shape[2], C,
+        )
+    elif post_write:
         # write-after-attend (see models/llama.py): stale pool + in-register
         # chunk K/V, one batched all-layer scatter after the scan
         kv_pos = stale_kv_positions(page_table, positions, k_pages.shape[2])
 
+    # pallas decode streams straight from the stacked pools via a layer
+    # index (see models/llama.py stream_pools)
+    stream_pools = (
+        cfg.attn_impl.startswith("pallas") and T == 1 and post_write
+    )
+
     def layer(x, layer_in):
-        lp, kp, vp, window = layer_in
+        if stream_pools:
+            if burst:
+                lp, li, window, ka, va = layer_in
+            else:
+                lp, li, window = layer_in
+            kp = vp = None
+        elif burst:
+            lp, kp, vp, window, ka, va = layer_in
+        else:
+            lp, kp, vp, window = layer_in
 
         h = _rms_norm_1p(x, lp["attn_norm"], eps)
         q = (h @ lp["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
         k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+        pool_dt = k_pages.dtype
+        if burst:
+            rows = jnp.arange(B, dtype=jnp.int32)
+            cnt = burst_counts
+            kwin = ka.at[rows, cnt].set(k[:, 0].astype(pool_dt))
+            vwin = va.at[rows, cnt].set(v[:, 0].astype(pool_dt))
         if not post_write:
             kp, vp = write_kv_pages(
                 kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), page_table, positions
@@ -220,18 +255,36 @@ def forward(
                 ragged_paged_attention_decode,
             )
 
+            if burst:
+                cur_kw = dict(
+                    k_cur=kwin, v_cur=vwin, cur_lens=burst_counts + 1
+                )
+            elif post_write:
+                cur_kw = dict(
+                    k_cur=k[:, 0].astype(pool_dt),
+                    v_cur=v[:, 0].astype(pool_dt),
+                )
+            else:
+                cur_kw = dict(k_cur=None, v_cur=None)
+            if stream_pools:
+                pool_args, layer_kw = (k_pages, v_pages), {"layer": li}
+            else:
+                pool_args, layer_kw = (kp, vp), {}
             attn = ragged_paged_attention_decode(
-                q[:, 0], kp, vp, page_table, kv_lens,
+                q[:, 0], *pool_args, page_table, kv_lens,
                 window=window, sm_scale=sm_scale,
                 logit_softcap=cfg.attn_logit_softcap,
                 interpret=cfg.attn_impl == "pallas_interpret",
-                k_cur=k[:, 0].astype(kp.dtype) if post_write else None,
-                v_cur=v[:, 0].astype(vp.dtype) if post_write else None,
+                **cur_kw, **layer_kw,
             )[:, None]
         elif post_write:
             kc, vc = gather_kv_pages(kp, vp, page_table)
-            kc = jnp.concatenate([kc, k.astype(kc.dtype)], axis=1)
-            vc = jnp.concatenate([vc, v.astype(vc.dtype)], axis=1)
+            if burst:
+                kc = jnp.concatenate([kc, kwin.astype(kc.dtype)], axis=1)
+                vc = jnp.concatenate([vc, vwin.astype(vc.dtype)], axis=1)
+            else:
+                kc = jnp.concatenate([kc, k.astype(kc.dtype)], axis=1)
+                vc = jnp.concatenate([vc, v.astype(vc.dtype)], axis=1)
             attn = flash_attention(
                 q, kc, vc, q_positions=positions, kv_lens=kv_lens,
                 sm_scale=sm_scale, window=window,
@@ -250,22 +303,32 @@ def forward(
         h = _rms_norm_1p(x, lp["mlp_norm"], eps)
         mlp = (jax.nn.gelu(h @ lp["w_gate"], approximate=True) * (h @ lp["w_up"])) @ lp["w_down"]
         x = x + _rms_norm_1p(mlp, lp["post_mlp_norm"], eps)
-        out_kv = (
-            (k.astype(kp.dtype), v.astype(vp.dtype)) if post_write else (kp, vp)
-        )
+        if burst:
+            out_kv = (kwin, vwin)
+        elif post_write:
+            out_kv = (k.astype(pool_dt), v.astype(pool_dt))
+        else:
+            out_kv = (kp, vp)
         return x, out_kv
 
-    if post_write:
-        x, (k_new, v_new) = lax.scan(
-            layer, x, (params["layers"], k_pages, v_pages, _layer_windows(cfg))
+    if stream_pools:
+        xs = (
+            params["layers"],
+            jnp.arange(cfg.num_layers, dtype=jnp.int32),
+            _layer_windows(cfg),
         )
+    else:
+        xs = (params["layers"], k_pages, v_pages, _layer_windows(cfg))
+    if burst:
+        x, (k_acc, v_acc) = lax.scan(layer, x, xs + (k_acc0, v_acc0))
+        # no pool write: the caller commits the burst once (deferred mode)
+    elif post_write:
+        x, (k_new, v_new) = lax.scan(layer, x, xs)
         k_pages, v_pages = write_kv_pages_all_layers(
             k_pages, v_pages, k_new, v_new, page_table, positions
         )
     else:
-        x, (k_pages, v_pages) = lax.scan(
-            layer, x, (params["layers"], k_pages, v_pages, _layer_windows(cfg))
-        )
+        x, (k_pages, v_pages) = lax.scan(layer, x, xs)
 
     x = _rms_norm_1p(x, params["final_norm"], eps)
     if not all_logits:
@@ -276,4 +339,6 @@ def forward(
     cap = cfg.final_logit_softcap
     if cap is not None:  # HF checkpoints may null the cap to disable it
         logits = cap * jnp.tanh(logits / cap)
+    if burst:
+        return logits, k_acc, v_acc
     return logits, k_pages, v_pages

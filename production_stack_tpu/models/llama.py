@@ -388,6 +388,7 @@ def forward(
     lora_ids: Optional[jnp.ndarray] = None,
     all_logits: bool = False,
     mesh=None,
+    kv_burst: Optional[tuple] = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
@@ -404,9 +405,19 @@ def forward(
       mesh:       serving mesh, passed by ModelRunner when it has sp>1 (ring-
                   attention prefill over the sequence axis) or pp>1 (layer
                   stack pipelined over stages); None = plain GSPMD tp/dp.
+      kv_burst:   deferred-scatter decode mode (T=1, kv_write_mode='post'
+                  only): (k_acc [L, B, C, KH, D], v_acc, counts [B]) — the
+                  burst's accumulated K/V windows plus how many entries are
+                  valid per row. The POOLS ARE NOT WRITTEN: attention reads
+                  pool slots < kv_lens - (counts+1) plus the window, and the
+                  return value is (logits, k_acc', v_acc') with the current
+                  token appended at slot ``counts``. The caller commits once
+                  per burst (runner._multi_step_fn) — this is what keeps the
+                  burst scan free of pool-sized copies.
 
     Returns (logits[B, V] for each sequence's last valid token — or [B, T, V]
-             when ``all_logits`` — and k_pages, v_pages updated).
+             when ``all_logits`` — and k_pages, v_pages updated; with
+             ``kv_burst``: (logits, k_acc', v_acc')).
     """
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
@@ -426,7 +437,22 @@ def forward(
     lora_scale = None if lora is None else lora["scale"][lora_ids].astype(cfg.dtype)
 
     post_write = cfg.kv_write_mode == "post"
-    if post_write:
+    burst = kv_burst is not None
+    if burst:
+        if not post_write or T != 1:
+            raise ValueError("kv_burst requires kv_write_mode='post' decode")
+        k_acc, v_acc, burst_counts = kv_burst
+        C = k_acc.shape[2]
+        # pool slots >= the stale boundary hold this burst's tokens, whose
+        # K/V live in the accumulator window instead (shared helper keeps
+        # the XLA fallback and the kernel's masking in lockstep)
+        from production_stack_tpu.ops.attention import burst_kv_positions
+
+        kv_pos = burst_kv_positions(
+            kv_lens, burst_counts + 1,
+            page_table.shape[1] * k_pages.shape[2], C,
+        )
+    elif post_write:
         # write-after-attend: the pool is stale for this chunk, so attention
         # runs over [gathered pages at positions < chunk start] ++ [current
         # chunk K/V in-register]; per-layer K/V stack as scan outputs and one
@@ -440,12 +466,30 @@ def forward(
         "cos": cos, "sin": sin, "positions": positions,
         "page_table": page_table, "kv_lens": kv_lens,
         "kv_pos": kv_pos if post_write else None,
+        "burst_counts": burst_counts if burst else None,
         "lora_ids": lora_ids, "lora_scale": lora_scale,
     }
 
+    # pallas decode streams pages straight from the STACKED pools (layer
+    # index in scalar prefetch): slicing k_pages[l] per layer at the call
+    # site would materialize a pool-sized copy every layer, since XLA cannot
+    # fuse a dynamic-slice into a pallas_call operand (~1.5 ms/step on v5e)
+    stream_pools = (
+        cfg.attn_impl.startswith("pallas") and T == 1 and pp == 1 and post_write
+    )
+
     def layer(x_aux, layer_in):
         x, aux = x_aux
-        lp, kp, vp, ll = layer_in  # per-layer params, page pools, LoRA slices
+        if stream_pools:
+            if burst:
+                lp, li, ll, ka, va = layer_in
+            else:
+                lp, li, ll = layer_in  # per-layer params + layer index
+            kp = vp = None
+        elif burst:
+            lp, kp, vp, ll, ka, va = layer_in
+        else:
+            lp, kp, vp, ll = layer_in  # per-layer params, pools, LoRA slices
         Bm, Tm = x.shape[:2]
 
         def proj(h, name):
@@ -462,6 +506,14 @@ def forward(
 
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(h, lp, cfg, Bm, Tm, aux["cos"], aux["sin"], proj)
+        if burst:
+            # append the current token into the burst window at slot
+            # ``counts`` (entries 0..counts-1 hold earlier burst tokens);
+            # the window, not the pool, carries this burst's K/V
+            rows = jnp.arange(Bm, dtype=jnp.int32)
+            cnt = aux["burst_counts"]
+            kwin = ka.at[rows, cnt].set(k[:, 0].astype(ka.dtype))
+            vwin = va.at[rows, cnt].set(v[:, 0].astype(va.dtype))
         if not post_write:
             kp, vp = write_kv_pages(
                 kp, vp, k.astype(kp.dtype), v.astype(vp.dtype),
@@ -477,25 +529,46 @@ def forward(
                 ragged_paged_attention_decode_sharded,
             )
 
+            pool_dt = k_pages.dtype
+            if burst:
+                cur_kw = dict(
+                    k_cur=kwin, v_cur=vwin,
+                    cur_lens=aux["burst_counts"] + 1,
+                )
+            elif post_write:
+                cur_kw = dict(
+                    k_cur=k[:, 0].astype(pool_dt),
+                    v_cur=v[:, 0].astype(pool_dt),
+                )
+            else:
+                cur_kw = dict(k_cur=None, v_cur=None)
             pallas_kw = dict(
                 window=cfg.sliding_window,
                 interpret=cfg.attn_impl == "pallas_interpret",
-                k_cur=k[:, 0].astype(kp.dtype) if post_write else None,
-                v_cur=v[:, 0].astype(vp.dtype) if post_write else None,
+                **cur_kw,
             )
+            if stream_pools:
+                pool_args = (k_pages, v_pages)
+                pallas_kw["layer"] = li
+            else:
+                pool_args = (kp, vp)
             if mesh is not None and mesh.devices.size > 1:
                 attn = ragged_paged_attention_decode_sharded(
-                    mesh, q[:, 0], kp, vp, aux["page_table"], aux["kv_lens"],
+                    mesh, q[:, 0], *pool_args,
+                    aux["page_table"], aux["kv_lens"],
                     **pallas_kw,
                 )[:, None]
             else:
                 attn = ragged_paged_attention_decode(
-                    q[:, 0], kp, vp, aux["page_table"], aux["kv_lens"],
+                    q[:, 0], *pool_args, aux["page_table"], aux["kv_lens"],
                     **pallas_kw,
                 )[:, None]
         else:
             kc, vc = gather_kv_pages(kp, vp, aux["page_table"])
-            if post_write:
+            if burst:
+                kc = jnp.concatenate([kc, kwin.astype(kc.dtype)], axis=1)
+                vc = jnp.concatenate([vc, vwin.astype(vc.dtype)], axis=1)
+            elif post_write:
                 kc = jnp.concatenate([kc, k.astype(kc.dtype)], axis=1)
                 vc = jnp.concatenate([vc, v.astype(vc.dtype)], axis=1)
             if sp > 1 and Tm > 1 and cfg.sliding_window is None:
@@ -523,17 +596,35 @@ def forward(
                     window=cfg.sliding_window,
                     kv_positions=aux["kv_pos"] if post_write else None,
                 )
-        out_kv = (
-            (k.astype(kp.dtype), v.astype(vp.dtype)) if post_write else (kp, vp)
-        )
+        if burst:
+            out_kv = (kwin, vwin)  # stacked by the scan -> [L, B, C, KH, D]
+        elif post_write:
+            out_kv = (
+                k.astype(k_pages.dtype), v.astype(v_pages.dtype)
+            )
+        else:
+            out_kv = (kp, vp)
         x = x + proj(attn.reshape(Bm, Tm, -1), "wo")
         return (_mlp_residual(x, lp, cfg, proj), aux), out_kv
 
-    scan_xs = (
-        params["layers"], k_pages, v_pages,
-        None if lora is None else lora["layers"],
-    )
-    if pp > 1:
+    lora_layers = None if lora is None else lora["layers"]
+    if stream_pools:
+        scan_xs = (
+            params["layers"],
+            jnp.arange(cfg.num_layers, dtype=jnp.int32),
+            lora_layers,
+        )
+    else:
+        scan_xs = (params["layers"], k_pages, v_pages, lora_layers)
+    if burst:
+        if pp > 1:
+            raise ValueError("kv_burst does not compose with pipeline stages")
+        (x, _), (k_acc, v_acc) = lax.scan(
+            layer, (x, aux), scan_xs + (kv_burst[0], kv_burst[1])
+        )
+        # NO pool write: the caller commits the accumulated windows once per
+        # burst — the pools stay loop constants through the burst scan
+    elif pp > 1:
         if not post_write:
             raise ValueError("pipeline parallelism requires kv_write_mode='post'")
         from production_stack_tpu.parallel.pipeline import serving_layer_pipeline
@@ -560,4 +651,6 @@ def forward(
     last_idx = jnp.maximum(jnp.sum(positions >= 0, axis=1) - 1, 0)  # [B]
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]  # [B, H]
     logits = (x_last @ head).astype(jnp.float32)
+    if burst:
+        return logits, k_acc, v_acc
     return logits, k_pages, v_pages

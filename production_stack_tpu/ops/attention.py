@@ -67,6 +67,27 @@ def stale_kv_positions(
     return jnp.concatenate([paged_pos, positions], axis=1)
 
 
+def burst_kv_positions(
+    kv_lens: jnp.ndarray,   # [B] total length incl. the current token
+    cur_lens: jnp.ndarray,  # [B] in-register window entries (1..C)
+    S: int,                 # paged slots (max_pages * page_size)
+    C: int,                 # window capacity
+) -> jnp.ndarray:
+    """KV-slot positions for deferred-burst attention, [B, S + C]: paged
+    slot j holds absolute position j while j < kv_lens - cur_lens (the
+    stale boundary — later slots' K/V live in the window instead), and
+    window entry j holds position ``kv_lens - cur_lens + j`` for
+    j < cur_lens. Shared by the XLA oracle (paged_attention_decode), the
+    model fallbacks, and mirrored by the Pallas kernel's masking — keep
+    them in lockstep."""
+    paged_end = kv_lens - cur_lens
+    slot = jnp.arange(S, dtype=jnp.int32)[None, :]
+    paged_pos = jnp.where(slot < paged_end[:, None], slot, -1)
+    j = jnp.arange(C, dtype=jnp.int32)[None, :]
+    win_pos = jnp.where(j < cur_lens[:, None], paged_end[:, None] + j, -1)
+    return jnp.concatenate([paged_pos, win_pos], axis=1)
+
+
 def write_kv_pages(
     k_pages: jnp.ndarray,
     v_pages: jnp.ndarray,
@@ -258,13 +279,32 @@ def paged_attention_decode(
     sm_scale: float | None = None,
     window=None,
     logit_softcap: float | None = None,
+    k_cur: jnp.ndarray | None = None,   # [B, C, KH, D] in-register burst K/V
+    v_cur: jnp.ndarray | None = None,
+    cur_lens: jnp.ndarray | None = None,  # [B] valid window entries (1..C)
 ) -> jnp.ndarray:
     """Decode-step attention: one query token per sequence against its pages.
 
     q: [B, NH, D]; returns [B, NH, D]. XLA reference path (gather + flash);
     the Pallas kernel streams pages directly and skips the gather.
+
+    With ``k_cur/v_cur`` (write-after-attend), pool slots at positions >=
+    seq_lens - cur_lens are stale; window entry j holds the token at
+    absolute position ``seq_lens - cur_lens + j`` (valid for j < cur_lens).
+    A fused decode burst defers ALL its KV scatters this way: the pool stays
+    read-only through the burst and the accumulated burst tokens ride in the
+    window (runner._multi_step_fn).
     """
     k, v = gather_kv_pages(k_pages, v_pages, page_table)
+    if k_cur is not None:
+        B, C = k_cur.shape[0], k_cur.shape[1]
+        if cur_lens is None:
+            cur_lens = jnp.ones((B,), jnp.int32)
+        kv_positions = burst_kv_positions(seq_lens, cur_lens, k.shape[1], C)
+        k = jnp.concatenate([k, k_cur.astype(k.dtype)], axis=1)
+        v = jnp.concatenate([v, v_cur.astype(v.dtype)], axis=1)
+    else:
+        kv_positions = None
     out = flash_attention(
         q[:, None],
         k,
@@ -274,5 +314,6 @@ def paged_attention_decode(
         sm_scale=sm_scale,
         window=window,
         logit_softcap=logit_softcap,
+        kv_positions=kv_positions,
     )
     return out[:, 0]

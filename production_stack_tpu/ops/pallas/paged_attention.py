@@ -45,10 +45,12 @@ def _decode_kernel(
     pt_ref,      # [B, max_pages] int32 page table
     lens_ref,    # [B] int32 kv lengths
     win_ref,     # [1] int32 window size (huge = full causal)
+    cl_ref,      # [B] int32 valid current-window entries (has_cur mode)
+    layer_ref,   # [1] int32 layer index into the stacked pools
     # blocks
     q_ref,       # [1, NH, D]
-    *refs,       # N x (k_ref, v_ref) [1, page_size, KH, D] each,
-                 # [k_cur_ref, v_cur_ref,] o_ref, m_ref, l_ref, acc_ref
+    *refs,       # N x (k_ref, v_ref) [1, 1, page_size, KH, D] each,
+                 # [k_cur_ref, v_cur_ref ([1, C, KH, D]),] o_ref, m/l/acc
     sm_scale: float,
     kv_heads: int,
     logit_softcap: float | None,
@@ -59,14 +61,15 @@ def _decode_kernel(
     kv_refs = refs[: 2 * N]  # k0, v0, k1, v1, ...
     rest = refs[2 * N:]
     if has_cur:
-        # write-after-attend mode: the current token's pool slot is stale;
-        # its K/V arrive in-register and fold in on the last grid step
+        # write-after-attend mode: the last cl_ref[b] tokens' pool slots are
+        # stale; their K/V arrive in-register (a fused burst accumulates up
+        # to C of them) and fold in on the last grid step
         k_cur_ref, v_cur_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
         o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     p = pl.program_id(1)
-    page_size = kv_refs[0].shape[1]
+    page_size = kv_refs[0].shape[2]
     NH, D = q_ref.shape[1], q_ref.shape[2]
     KH = kv_heads
     G = NH // KH
@@ -78,9 +81,9 @@ def _decode_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     kv_len = lens_ref[b]
-    # paged slots hold positions < paged_end; in has_cur mode the final slot
-    # (the current token, position kv_len - 1) is stale in the pool
-    paged_end = kv_len - 1 if has_cur else kv_len
+    # paged slots hold positions < paged_end; in has_cur mode the final
+    # cl_ref[b] slots (the in-register window) are stale in the pool
+    paged_end = kv_len - cl_ref[b] if has_cur else kv_len
     lo = jnp.maximum(kv_len - win_ref[0], 0)   # first visible KV slot
 
     # N pages per grid cell (unrolled): each page is its own input block with
@@ -95,8 +98,8 @@ def _decode_kernel(
         @pl.when(start < paged_end)
         def _(k_ref=kv_refs[2 * i], v_ref=kv_refs[2 * i + 1], start=start):
             q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
-            k = k_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [KH, page, D]
-            v = v_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+            k = k_ref[0, 0].astype(jnp.float32).transpose(1, 0, 2)  # [KH, page, D]
+            v = v_ref[0, 0].astype(jnp.float32).transpose(1, 0, 2)
             # batched over KH: [KH, G, D] x [KH, page, D] -> [KH, G, page]
             scores = lax.dot_general(
                 q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
@@ -124,19 +127,33 @@ def _decode_kernel(
     def _():
         m_prev, l_prev, acc = m_ref[...], l_ref[...], acc_ref[...]
         if has_cur:
-            # one extra online-softmax update for the current token (always
-            # visible: its position kv_len-1 satisfies causality and window)
+            # one extra online-softmax update over the in-register window
+            # (entries j < cl at positions paged_end + j; the final entry,
+            # the current token, is always causally visible)
             q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
-            kc = k_cur_ref[0].astype(jnp.float32)  # [KH, D]
-            vc = v_cur_ref[0].astype(jnp.float32)
-            s_cur = jnp.einsum("kgd,kd->kg", q, kc)  # [KH, G]
+            kc = k_cur_ref[0].astype(jnp.float32).transpose(1, 0, 2)  # [KH, C, D]
+            vc = v_cur_ref[0].astype(jnp.float32).transpose(1, 0, 2)
+            C = kc.shape[1]
+            s_cur = lax.dot_general(
+                q, kc, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )  # [KH, G, C]
             if logit_softcap is not None:
                 s_cur = logit_softcap * jnp.tanh(s_cur / logit_softcap)
-            m_new = jnp.maximum(m_prev, s_cur)
+            j = lax.broadcasted_iota(jnp.int32, (1, 1, C), 2)
+            pos_j = paged_end + j
+            vis = (j < cl_ref[b]) & (pos_j >= lo)
+            s_cur = jnp.where(vis, s_cur, NEG_INF)
+            m_new = jnp.maximum(m_prev, s_cur.max(axis=-1))
             alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-            p_cur = jnp.exp(s_cur - m_new)
-            l_prev = l_prev * alpha + p_cur
-            acc = acc * alpha[..., None] + p_cur[..., None] * vc[:, None, :]
+            p_cur = jnp.exp(s_cur - m_new[..., None])
+            p_cur = jnp.where(vis, p_cur, 0.0)
+            l_prev = l_prev * alpha + p_cur.sum(axis=-1)
+            pv = lax.dot_general(
+                p_cur, vc, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )
+            acc = acc * alpha[..., None] + pv
         out = acc / jnp.maximum(l_prev, 1e-30)[..., None]
         o_ref[0] = out.reshape(NH, D).astype(o_ref.dtype)
 
@@ -147,8 +164,8 @@ def _decode_kernel(
 )
 def ragged_paged_attention_decode(
     q: jnp.ndarray,          # [B, NH, D]
-    k_pages: jnp.ndarray,    # [P, page_size, KH, D]
-    v_pages: jnp.ndarray,    # [P, page_size, KH, D]
+    k_pages: jnp.ndarray,    # [P, page_size, KH, D] or [L, P, page, KH, D]
+    v_pages: jnp.ndarray,
     page_table: jnp.ndarray, # [B, max_pages] int32
     seq_lens: jnp.ndarray,   # [B] int32
     window=None,             # scalar int (static or traced); None = full causal
@@ -156,17 +173,30 @@ def ragged_paged_attention_decode(
     sm_scale: float | None = None,
     logit_softcap: float | None = None,
     interpret: bool = False,
-    k_cur: jnp.ndarray | None = None,  # [B, KH, D] current token's K (post-write)
+    k_cur: jnp.ndarray | None = None,  # [B, KH, D] or [B, C, KH, D]
     v_cur: jnp.ndarray | None = None,
+    cur_lens: jnp.ndarray | None = None,  # [B] valid window entries (1..C)
     pages_per_block: int | None = None,
+    layer: jnp.ndarray | int | None = None,  # index into stacked pools
 ) -> jnp.ndarray:
     """Decode attention over paged KV, streaming pages HBM->VMEM.
 
-    With ``k_cur/v_cur`` (write-after-attend mode), the pool slot at
-    ``seq_lens - 1`` is treated as stale and the current token's K/V fold in
-    from registers instead. Returns [B, NH, D] in q.dtype. Matches
+    With ``k_cur/v_cur`` (write-after-attend mode), pool slots at positions
+    >= ``seq_lens - cur_lens`` are treated as stale and the in-register
+    window folds in instead: entry j holds the token at absolute position
+    ``seq_lens - cur_lens + j`` (valid for j < cur_lens). A fused decode
+    burst defers all its KV scatters this way — the pool stays read-only
+    for the whole burst. [B, KH, D] k_cur means C=1 (single current token).
+    Returns [B, NH, D] in q.dtype. Matches
     ops/attention.paged_attention_decode (the XLA oracle) — tests assert
     equivalence.
+
+    Stacked pools + ``layer``: passing the whole [L, P, page, KH, D] pool
+    and a (traced) layer index lets the per-layer scan stream pages straight
+    out of the stacked array — a per-layer ``k_pages[l]`` at the call site
+    would materialize a pool-sized dynamic-slice copy every layer (profiled
+    at ~1.5 ms/step on v5e), because XLA cannot fuse a slice into a
+    pallas_call operand.
 
     ``pages_per_block``: pages fetched per grid cell, each as its own input
     block (auto: ~128 KV slots per cell). The per-cell pipeline overhead is
@@ -175,11 +205,18 @@ def ragged_paged_attention_decode(
     keeping page_size (the prefix-cache sharing granule) fine.
     """
     B, NH, D = q.shape
-    _, page_size, KH, _ = k_pages.shape
+    if k_pages.ndim == 4:  # single-layer pools: free leading-axis view
+        k_pages = k_pages[None]
+        v_pages = v_pages[None]
+        layer = 0
+    _, _, page_size, KH, _ = k_pages.shape
     max_pages = page_table.shape[1]
     G = NH // KH
     scale = sm_scale if sm_scale is not None else D**-0.5
     has_cur = k_cur is not None
+    if has_cur and k_cur.ndim == 3:
+        k_cur = k_cur[:, None]  # [B, KH, D] -> C=1 window
+        v_cur = v_cur[:, None]
     if pages_per_block is None:
         pages_per_block = max(1, min(128 // page_size, max_pages))
     N = max(1, min(pages_per_block, max_pages))
@@ -189,37 +226,46 @@ def ragged_paged_attention_decode(
         if window is None
         else jnp.asarray(window, jnp.int32).reshape(1)
     )
+    cl = (
+        jnp.ones((B,), jnp.int32)
+        if cur_lens is None
+        else jnp.asarray(cur_lens, jnp.int32)
+    )
+    lyr = jnp.asarray(layer, jnp.int32).reshape(1)
 
     def kv_index(i):
-        def index(b, p, pt, lens, w):
+        def index(b, p, pt, lens, w, _cl, l):
             # start fetching at the first page with a visible slot so
             # windowed layers stream ~window bytes regardless of context
             lo_page = jnp.maximum(lens[b] - w[0], 0) // page_size
             return (
+                l[0],
                 pt[b, jnp.minimum(lo_page + p * N + i, max_pages - 1)],
                 0, 0, 0,
             )
 
         return index
 
-    row = lambda b, p, pt, lens, w: (b, 0, 0)
+    row = lambda b, p, pt, lens, w, _cl, l: (b, 0, 0)
+    row4 = lambda b, p, pt, lens, w, _cl, l: (b, 0, 0, 0)
     in_specs = [pl.BlockSpec((1, NH, D), row)]
     operands = [q]
     for i in range(N):
         in_specs += [
-            pl.BlockSpec((1, page_size, KH, D), kv_index(i)),
-            pl.BlockSpec((1, page_size, KH, D), kv_index(i)),
+            pl.BlockSpec((1, 1, page_size, KH, D), kv_index(i)),
+            pl.BlockSpec((1, 1, page_size, KH, D), kv_index(i)),
         ]
         operands += [k_pages, v_pages]
     if has_cur:
+        C = k_cur.shape[1]
         in_specs += [
-            pl.BlockSpec((1, KH, D), row),
-            pl.BlockSpec((1, KH, D), row),
+            pl.BlockSpec((1, C, KH, D), row4),
+            pl.BlockSpec((1, C, KH, D), row4),
         ]
         operands += [k_cur, v_cur]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=5,
         grid=(B, n_blocks),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, NH, D), row),
@@ -245,7 +291,10 @@ def ragged_paged_attention_decode(
             ),
             transcendentals=B * NH * max_pages * page_size,
         ),
-    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), win, *operands)
+    )(
+        page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), win, cl,
+        lyr, *operands,
+    )
 
 
 def ragged_paged_attention_decode_sharded(
@@ -262,6 +311,8 @@ def ragged_paged_attention_decode_sharded(
     interpret: bool = False,
     k_cur: jnp.ndarray | None = None,
     v_cur: jnp.ndarray | None = None,
+    cur_lens: jnp.ndarray | None = None,
+    layer: jnp.ndarray | int | None = None,
 ) -> jnp.ndarray:
     """The decode kernel on a multi-device mesh via manual shard_map.
 
@@ -290,22 +341,33 @@ def ragged_paged_attention_decode_sharded(
     scale = sm_scale if sm_scale is not None else D**-0.5
 
     has_cur = k_cur is not None
+    if has_cur and k_cur.ndim == 3:
+        k_cur = k_cur[:, None]  # [B, KH, D] -> C=1 window
+        v_cur = v_cur[:, None]
+    if has_cur and cur_lens is None:
+        cur_lens = jnp.ones(q.shape[:1], jnp.int32)
+    if k_pages.ndim == 4:  # single-layer pools
+        k_pages = k_pages[None]
+        v_pages = v_pages[None]
+        layer = 0
+    lyr = jnp.asarray(layer, jnp.int32).reshape(1)
 
-    def body(q, kp, vp, pt, lens, *cur):
-        kc, vc = cur if has_cur else (None, None)
+    def body(q, kp, vp, pt, lens, l, *cur):
+        kc, vc, cl = cur if has_cur else (None, None, None)
         return ragged_paged_attention_decode(
             q, kp, vp, pt, lens, window,
             sm_scale=scale, logit_softcap=logit_softcap, interpret=interpret,
-            k_cur=kc, v_cur=vc,
+            k_cur=kc, v_cur=vc, cur_lens=cl, layer=l[0],
         )
 
     head = P("dp", "tp", None)
-    pool = P(None, None, "tp", None)
-    in_specs = [head, pool, pool, P("dp", None), P("dp")]
-    operands = [q, k_pages, v_pages, page_table, seq_lens]
+    pool = P(None, None, None, "tp", None)
+    in_specs = [head, pool, pool, P("dp", None), P("dp"), P()]
+    operands = [q, k_pages, v_pages, page_table, seq_lens, lyr]
     if has_cur:
-        in_specs += [head, head]
-        operands += [k_cur, v_cur]
+        # the window's KH axis shards over tp like the pool's
+        in_specs += [P("dp", None, "tp", None), P("dp", None, "tp", None), P("dp")]
+        operands += [k_cur, v_cur, cur_lens]
     # only axes the mesh actually has, and never an axis some caller already
     # made manual (the pp pipeline region). When called inside a manual
     # region the context mesh (with those axes marked Manual) must be the
