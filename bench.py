@@ -129,6 +129,44 @@ def main() -> None:
     dt = time.perf_counter() - t0
     decode_tps = B * k * bursts / dt
 
+    # --- long-context chunked prefill: one 8k-token sequence, engine-style
+    # 1k chunks (the serving path for long prompts; SURVEY long-context).
+    # Throughput counts the WHOLE sequence against wall time, chunks
+    # dispatched back-to-back with one final fetch (fetch-per-chunk would
+    # bill ~100 ms RTT x 8 to compute that runs async anyway).
+    long_ctx = min(8192, (cfg.max_model_len - 1) // page_size * page_size)
+    lc_metrics = {}
+    if on_tpu and long_ctx >= 4 * prefill_len and num_pages * page_size >= long_ctx:
+        chunk = prefill_len  # 1024: same chunk bucket phase 1 compiled
+        n_chunks = long_ctx // chunk
+        long_ctx = n_chunks * chunk  # bill exactly what runs
+        lc_pages = long_ctx // page_size
+        lc_ids = rng.randint(0, cfg.vocab_size, (1, long_ctx))
+        pt_lc = np.arange(lc_pages)[None, :]
+
+        def run_long_prefill():
+            for c in range(n_chunks):
+                ids, _ = runner.step(StepInput(
+                    input_ids=lc_ids[:, c * chunk:(c + 1) * chunk],
+                    positions=np.arange(c * chunk, (c + 1) * chunk)[None],
+                    page_table=pt_lc,
+                    kv_lens=np.full((1,), (c + 1) * chunk),
+                    temperature=np.zeros(1),
+                    top_k=np.zeros(1, int),
+                    top_p=np.ones(1),
+                ))
+            np.asarray(ids)
+
+        run_long_prefill()  # compile the (1, chunk, lc_pages) bucket
+        t0 = time.perf_counter()
+        run_long_prefill()
+        dt = time.perf_counter() - t0
+        lc_metrics = {
+            "prefill_long_context_tokens": long_ctx,
+            "prefill_long_ms": round(dt * 1000, 2),
+            "prefill_long_tokens_per_sec": round(long_ctx / dt, 1),
+        }
+
     # free phase-1 device buffers before the serving stack allocates its own
     del runner, dec, ttft_inp, ids, toks
     import gc
@@ -143,6 +181,7 @@ def main() -> None:
         "platform": platform,
         "model": model_desc,
     }
+    extras.update(lc_metrics)
     extras.update(http_stack_metrics(on_tpu, model_dir))
 
     print(
@@ -303,11 +342,24 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             return {f"{prefix}.{h}": qs for h, qs in out.items()}
 
         breakdown = {}
+        chained_ratio = None
         try:
             breakdown.update(
                 hop_gauges(f"http://127.0.0.1:{rport}/metrics", "router"))
             breakdown.update(
                 hop_gauges(f"http://127.0.0.1:{eport}/metrics", "engine"))
+            counters = {}
+            for line in requests.get(
+                f"http://127.0.0.1:{eport}/metrics", timeout=30
+            ).text.splitlines():
+                if line.startswith("vllm:decode_"):
+                    counters[line.split("{")[0]] = float(line.rsplit(" ", 1)[1])
+            total = counters.get("vllm:decode_dispatches_total", 0)
+            if total:
+                chained_ratio = round(
+                    counters.get("vllm:decode_chained_dispatches_total", 0)
+                    / total, 3,
+                )
         except Exception as e:  # noqa: BLE001
             breakdown["error"] = str(e)
 
@@ -320,6 +372,11 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             "http_stack_tokens_per_sec": round(stack_tps, 1),
             "http_decode_tokens_per_sec": round(http_decode_tps, 1),
             "http_decode_concurrency": dec_conc,
+            # fraction of decode dispatches that chained bursts: chaining
+            # only engages on a quiescent batch, and each unchained dispatch
+            # pays a fetch round trip — a low ratio explains a low decode
+            # rate through the stack
+            "http_decode_chained_dispatch_ratio": chained_ratio,
             "http_concurrency": conc,
             "http_prefill_tokens": plen,
             "ttft_breakdown_ms": breakdown,

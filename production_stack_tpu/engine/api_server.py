@@ -140,11 +140,33 @@ def _sampling_params(body: dict, default_max: int = 256) -> SamplingParams:
         top_p=float(body.get("top_p", 1.0)),
         stop=list(stop),
         ignore_eos=bool(body.get("ignore_eos", False)),
+        min_tokens=int(body.get("min_tokens", 0)),
         seed=body.get("seed"),
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         repetition_penalty=float(body.get("repetition_penalty", 1.0)),
+        logit_bias=_parse_logit_bias(body.get("logit_bias")),
     )
+
+
+def _parse_logit_bias(raw) -> "Optional[dict]":
+    """OpenAI logit_bias: {"<token_id>": bias in [-100, 100]}, <= 300 keys."""
+    if not raw:
+        return None
+    if not isinstance(raw, dict) or len(raw) > 300:
+        raise ValueError("logit_bias must be a dict of at most 300 entries")
+    out = {}
+    for k, v in raw.items():
+        try:
+            tid, bv = int(k), float(v)
+        except (TypeError, ValueError):
+            raise ValueError(f"invalid logit_bias entry {k!r}: {v!r}") from None
+        if tid < 0:
+            raise ValueError(f"logit_bias token id {tid} is negative")
+        if not -100.0 <= bv <= 100.0:
+            raise ValueError(f"logit_bias value {bv} outside [-100, 100]")
+        out[tid] = bv
+    return out
 
 
 def _usage(out) -> dict:
@@ -228,6 +250,9 @@ class EngineServer:
         emit("gpu_prefix_cache_queries_total", "counter", s["gpu_prefix_cache_queries_total"])
         emit("prompt_tokens_total", "counter", s["prompt_tokens_total"])
         emit("generation_tokens_total", "counter", s["generation_tokens_total"])
+        emit("decode_dispatches_total", "counter", s["decode_dispatches_total"])
+        emit("decode_chained_dispatches_total", "counter",
+             s["decode_chained_dispatches_total"])
         for k in sorted(s):  # kv offload / transfer / spec metrics, when wired
             if k.startswith(("kv_", "spec_decode_")):
                 kind = "counter" if k.endswith("_total") else "gauge"
@@ -337,7 +362,12 @@ class EngineServer:
                     status=404,
                 )
         req_id = request.headers.get("X-Request-Id") or f"req-{uuid.uuid4().hex[:16]}"
-        params = _sampling_params(body)
+        try:
+            params = _sampling_params(body)
+        except (ValueError, TypeError) as e:
+            return web.json_response(
+                {"error": {"message": f"invalid request: {e}"}}, status=400
+            )
         if not (-2.0 <= params.presence_penalty <= 2.0
                 and -2.0 <= params.frequency_penalty <= 2.0
                 and params.repetition_penalty > 0):
@@ -345,9 +375,9 @@ class EngineServer:
                 {"error": {"message": "penalties out of range: presence/frequency in [-2, 2], repetition > 0"}},
                 status=400,
             )
-        if params.wants_penalties and self.cfg.speculative_k:
+        if (params.wants_penalties or params.logit_bias) and self.cfg.speculative_k:
             return web.json_response(
-                {"error": {"message": "sampling penalties are not supported with speculative decoding"}},
+                {"error": {"message": "sampling penalties and logit_bias are not supported with speculative decoding"}},
                 status=400,
             )
         # logprobs: completions takes an int (top count), chat takes
@@ -943,8 +973,17 @@ def _init_multihost(cfg: EngineConfig) -> int:
     # resulting set_lora_slot/clear_lora_slot device writes are REPLICATED
     # dispatches — followers receive the weights over the step stream, so
     # adapters need no shared filesystem.
-    if cfg.kv_role != "none":
-        raise ValueError("disaggregated prefill is not supported in multi-host mode")
+    # Disaggregated prefill works multi-host on the TCP path: the producer's
+    # page fetches (get_page) and the consumer's restores (set_page) are
+    # REPLICATED SPMD dispatches, while the TCP sender/receiver and staging
+    # are leader-only (followers get kv_role stripped in serve()). The
+    # device-to-device channel is single-host-pair only for now: its
+    # transfer-service pulls address one process's buffers.
+    if cfg.kv_role != "none" and cfg.kv_transfer_device:
+        raise ValueError(
+            "--kv-transfer-device is not supported in multi-host mode; "
+            "the TCP KV transfer path works (omit the flag)"
+        )
     pid = _resolve_process_id(cfg)
     logger.info(
         "multi-host init: process %d/%d, coordinator %s",
@@ -980,6 +1019,7 @@ async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
             engine = LLMEngine(_dc.replace(
                 cfg, kv_offload_cpu_gb=0.0, kv_offload_dir=None,
                 kv_remote_url=None, kv_controller_url=None,
+                kv_role="none",
             ))
             leader_host = cfg.distributed_coordinator.rsplit(":", 1)[0]
             await asyncio.get_event_loop().run_in_executor(

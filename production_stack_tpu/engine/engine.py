@@ -191,6 +191,11 @@ class LLMEngine:
         # serving stats (scraped by /metrics)
         self.total_prompt_tokens = 0
         self.total_generation_tokens = 0
+        # dispatch-shape observability: chaining only engages on a quiescent
+        # batch, and whether it does dominates decode throughput on
+        # network-attached chips (each unchained dispatch pays a fetch RTT)
+        self.decode_dispatches_total = 0
+        self.decode_chained_dispatches_total = 0
         self.spec_draft_tokens = 0     # drafts proposed (rounds * spec_k)
         self.spec_accepted_tokens = 0  # drafts the target accepted
         self.num_preemptions = 0
@@ -404,6 +409,44 @@ class LLMEngine:
                         + [1.0] * (len(batch.kv_lens) - len(batch.seqs)),
                         np.float32,
                     )
+                # rows still under their min_tokens floor get EOS masked out
+                # of the sampled distribution (vLLM semantics — suppressing
+                # only the FINISH would feed a sampled EOS back into the
+                # context and derail the continuation). Conservative within
+                # a fused burst: the ban holds for the whole dispatch, so
+                # EOS may be suppressed up to burst-1 tokens past the floor;
+                # the scheduler's finish gate stays as the exact backstop.
+                eos = self.tokenizer.eos_token_id
+                def _eos_ban(s):
+                    return (
+                        not s.params.ignore_eos
+                        and len(s.output_ids) < s.params.min_tokens
+                    )
+                if any(s.params.logit_bias or _eos_ban(s) for s in batch.seqs):
+                    B = len(batch.kv_lens)
+                    # bucket the bias width so a batch's entry count doesn't
+                    # mint a fresh program variant per distinct size
+                    need = max(
+                        len(s.params.logit_bias or {}) + (1 if _eos_ban(s) else 0)
+                        for s in batch.seqs
+                    )
+                    K = 8
+                    while K < need:
+                        K *= 2
+                    V = self.model_cfg.vocab_size
+                    # out-of-range sentinel V drops unused slots on device
+                    bias_ids = np.full((B, K), V, np.int32)
+                    bias_vals = np.zeros((B, K), np.float32)
+                    for i, s in enumerate(batch.seqs):
+                        j = 0
+                        for tid, bv in (s.params.logit_bias or {}).items():
+                            bias_ids[i, j] = tid
+                            bias_vals[i, j] = bv
+                            j += 1
+                        if _eos_ban(s):
+                            bias_ids[i, j] = eos
+                            bias_vals[i, j] = -1e9
+                    inp.bias_ids, inp.bias_vals = bias_ids, bias_vals
                 if (
                     batch.kind == "decode"
                     and self.scheduler.spec_k
@@ -422,7 +465,9 @@ class LLMEngine:
                     self.spec_accepted_tokens += int(emitted.sum()) - rounds
                 elif batch.kind == "decode" and self.scheduler.decode_steps > 1:
                     wlp = batch.want_logprobs
+                    self.decode_dispatches_total += 1
                     if batch.bursts > 1:
+                        self.decode_chained_dispatches_total += 1
                         # chained bursts: all dispatches go out before any
                         # fetch, so the chain costs bursts*compute + 1 round
                         # trip. Fetch EVERY burst before applying any — apply
@@ -897,6 +942,8 @@ class LLMEngine:
             "gpu_prefix_cache_hit_rate": self.kv.hit_rate(),
             "prompt_tokens_total": self.total_prompt_tokens,
             "generation_tokens_total": self.total_generation_tokens,
+            "decode_dispatches_total": self.decode_dispatches_total,
+            "decode_chained_dispatches_total": self.decode_chained_dispatches_total,
         }
         if self.cfg.speculative_k:
             # read accepted before drafts: the engine thread increments drafts

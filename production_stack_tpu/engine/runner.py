@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from production_stack_tpu import models
 from production_stack_tpu.ops.attention import write_kv_pages_all_layers
 from production_stack_tpu.ops.sampling import (
+    apply_logit_bias,
     apply_penalties,
     sample,
     sample_with_logprobs,
@@ -51,6 +52,9 @@ class StepInput:
     presence: Any = None     # [B] f32
     frequency: Any = None    # [B] f32
     repetition: Any = None   # [B] f32
+    # OpenAI logit_bias (set together when any row has one):
+    bias_ids: Any = None     # [B, K] int32 token ids, >= vocab_size = unused
+    bias_vals: Any = None    # [B, K] f32 additive biases
 
 
 class ModelRunner:
@@ -239,6 +243,11 @@ class ModelRunner:
                 vec(inp.frequency, jnp.float32),
                 vec(inp.repetition, jnp.float32),
             )
+        if inp.bias_ids is not None:
+            staged["bias"] = (
+                row(inp.bias_ids, jnp.int32),
+                row(inp.bias_vals, jnp.float32),
+            )
         return staged
 
     def _get_step(self, want_lp: bool, want_pen: bool):
@@ -265,7 +274,7 @@ class ModelRunner:
             self.params, self.k_pages, self.v_pages,
             s["input_ids"], s["positions"], s["page_table"], s["kv_lens"],
             s["temperature"], s["top_k"], s["top_p"], s["key"],
-            self.lora, s["lora_ids"], s.get("pen"),
+            self.lora, s["lora_ids"], s.get("pen"), s.get("bias"),
         )
         if want_logprobs:
             ids, logits, lp, tids, tlp, self.k_pages, self.v_pages = (
@@ -323,7 +332,7 @@ class ModelRunner:
             self.params, self.k_pages, self.v_pages,
             s["input_ids"], s["positions"], s["page_table"], s["kv_lens"],
             s["kv_limits"], s["temperature"], s["top_k"], s["top_p"], s["key"],
-            self.lora, s["lora_ids"], s.get("pen"),
+            self.lora, s["lora_ids"], s.get("pen"), s.get("bias"),
         )
         if want_logprobs:
             toks, lp, tids, tlp, self.k_pages, self.v_pages = (
@@ -564,7 +573,7 @@ class ModelRunner:
 def _multi_step_fn(forward, cfg, k, want_lp, want_pen, params, k_pages,
                    v_pages, input_ids, positions, page_table, kv_lens,
                    kv_limits, temperature, top_k, top_p, key, lora=None,
-                   lora_ids=None, pen=None):
+                   lora_ids=None, pen=None, bias=None):
     """k fused decode steps; see ModelRunner.step_multi. input_ids/positions
     are [B, 1] (decode shape).
 
@@ -598,6 +607,10 @@ def _multi_step_fn(forward, cfg, k, want_lp, want_pen, params, k_pages,
         if want_pen:
             sample_from = apply_penalties(
                 logits.astype(jnp.float32), hist, lens, plens, pres, freq, rep
+            )
+        if bias is not None:
+            sample_from = apply_logit_bias(
+                sample_from.astype(jnp.float32), *bias
             )
         if want_lp:
             nxt, lp, tids, tlp = sample_with_logprobs(
@@ -647,7 +660,7 @@ def _multi_step_deferred_fn(forward, cfg, k, want_lp, want_pen, params,
                             k_pages, v_pages, input_ids, positions,
                             page_table, kv_lens, kv_limits, temperature,
                             top_k, top_p, key, lora=None, lora_ids=None,
-                            pen=None):
+                            pen=None, bias=None):
     """k fused decode steps with DEFERRED KV scatters (kv_burst mode).
 
     The classic _multi_step_fn gathers the batch's pages into a local block
@@ -684,6 +697,10 @@ def _multi_step_deferred_fn(forward, cfg, k, want_lp, want_pen, params,
         if want_pen:
             sample_from = apply_penalties(
                 logits.astype(jnp.float32), hist, lens, plens, pres, freq, rep
+            )
+        if bias is not None:
+            sample_from = apply_logit_bias(
+                sample_from.astype(jnp.float32), *bias
             )
         if want_lp:
             nxt, lp, tids, tlp = sample_with_logprobs(
@@ -839,7 +856,7 @@ def _spec_fn(forward, cfg, steps, k, n, params, k_pages, v_pages, history,
 
 def _step_fn(forward, cfg, want_lp, want_pen, params, k_pages, v_pages,
              input_ids, positions, page_table, kv_lens, temperature, top_k,
-             top_p, key, lora=None, lora_ids=None, pen=None):
+             top_p, key, lora=None, lora_ids=None, pen=None, bias=None):
     kw = {} if lora is None else {"lora": lora, "lora_ids": lora_ids}
     logits, k_pages, v_pages = forward(
         params, cfg, input_ids, positions, k_pages, v_pages, page_table, kv_lens,
@@ -850,6 +867,10 @@ def _step_fn(forward, cfg, want_lp, want_pen, params, k_pages, v_pages,
         hist, plens, pres, freq, rep = pen
         sample_from = apply_penalties(
             logits.astype(jnp.float32), hist, kv_lens, plens, pres, freq, rep
+        )
+    if bias is not None:
+        sample_from = apply_logit_bias(
+            sample_from.astype(jnp.float32), *bias
         )
     if want_lp:
         # logprobs report the RAW distribution; penalties shape the draw only

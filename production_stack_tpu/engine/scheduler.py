@@ -37,6 +37,9 @@ class SamplingParams:
     top_p: float = 1.0
     stop: list[str] = field(default_factory=list)
     ignore_eos: bool = False
+    # suppress EOS-driven finishes until this many tokens were generated
+    # (vLLM's min_tokens; stop strings and length limits still apply)
+    min_tokens: int = 0
     seed: Optional[int] = None
     # top-logprob count to report per token (None = off; device computes a
     # fixed TOP_LOGPROBS wide set, the host slices to this many)
@@ -46,6 +49,10 @@ class SamplingParams:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0
+    # OpenAI logit_bias: token id -> additive bias in [-100, 100], applied
+    # to the sampling distribution on device (reported logprobs stay raw,
+    # matching the penalties convention)
+    logit_bias: Optional[dict] = None
 
     @property
     def wants_penalties(self) -> bool:
@@ -487,7 +494,11 @@ class Scheduler:
         def consume(s, tok, i, j) -> None:
             s.output_ids.append(tok)
             events.append((s, tok, i, j))
-            if (not s.params.ignore_eos) and tok == eos_token_id:
+            if (
+                not s.params.ignore_eos
+                and tok == eos_token_id
+                and len(s.output_ids) >= s.params.min_tokens
+            ):
                 self._finish(s, "stop")
             elif len(s.output_ids) >= s.params.max_tokens:
                 self._finish(s, "length")

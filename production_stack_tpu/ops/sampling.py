@@ -139,3 +139,15 @@ def apply_penalties(
     logits = logits - frequency[:, None] * out_counts
     logits = logits - presence[:, None] * (out_counts > 0)
     return logits
+
+
+def apply_logit_bias(
+    logits: jnp.ndarray,     # [B, V] f32
+    bias_ids: jnp.ndarray,   # [B, K] int32 token ids; >= V = unused slot
+    bias_vals: jnp.ndarray,  # [B, K] f32 additive biases
+) -> jnp.ndarray:
+    """OpenAI logit_bias: add per-row sparse biases to the sampling
+    distribution. Unused slots carry an out-of-range id and drop."""
+    B = logits.shape[0]
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    return logits.at[rows, bias_ids].add(bias_vals, mode="drop")

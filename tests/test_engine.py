@@ -354,3 +354,36 @@ class TestHTTPServer:
             json={"prompt": "x", "max_tokens": 2, "ignore_eos": True},
         )
         assert r.status_code == 200 and r.json()["usage"]["completion_tokens"] == 2
+
+
+def test_logit_bias_forces_and_bans_tokens(engine):
+    """OpenAI logit_bias: +100 on one token makes greedy pick it every step;
+    -100 bans the otherwise-greedy token."""
+    base = _collect(engine, "bias me", max_tokens=4, temperature=0.0,
+                    ignore_eos=True)
+    base_toks = [t for o in base for t in o.token_ids]
+
+    forced = _collect(engine, "bias me", max_tokens=4, temperature=0.0,
+                      ignore_eos=True, logit_bias={123: 100.0})
+    assert [t for o in forced for t in o.token_ids] == [123] * 4
+
+    banned = _collect(engine, "bias me", max_tokens=4, temperature=0.0,
+                      ignore_eos=True, logit_bias={base_toks[0]: -100.0})
+    banned_toks = [t for o in banned for t in o.token_ids]
+    assert banned_toks[0] != base_toks[0]
+
+
+def test_min_tokens_suppresses_eos(engine):
+    """With EOS forced via logit_bias, min_tokens MASKS EOS from the
+    distribution until the floor (vLLM semantics — an EOS must never be
+    sampled into the context early), then EOS finishes the sequence. The
+    mask is per-dispatch, so the floor may round up to a burst boundary."""
+    eos = engine.tokenizer.eos_token_id
+    outs = _collect(engine, "stop early", max_tokens=32, temperature=0.0,
+                    logit_bias={eos: 100.0}, min_tokens=5)
+    last = outs[-1]
+    assert last.finished and last.finish_reason == "stop"
+    toks = [t for o in outs for t in o.token_ids]
+    assert 5 <= len(toks) <= 32
+    assert toks[-1] == eos          # the forced EOS lands once allowed
+    assert eos not in toks[:4]      # and NEVER below the floor

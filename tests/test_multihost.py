@@ -449,3 +449,130 @@ def _sleep_wake_roundtrip(http_port: int) -> None:
         "max_tokens": 3, "temperature": 0.0,
     })
     assert body["usage"]["completion_tokens"] == 3
+
+
+_PD_CONSUMER = """
+import sys, asyncio
+sys.path.insert(0, {root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine import api_server
+
+cfg = EngineConfig(
+    model="llama-debug", host="127.0.0.1", port={http_port},
+    max_model_len=128, max_num_seqs=4, num_pages=64, page_size=8,
+    prefill_chunk=32, decode_steps=2, kv_cache_memory_gb=0.01,
+    tensor_parallel_size=2, data_parallel_size=4,
+    distributed_coordinator="127.0.0.1:{coord_port}",
+    distributed_num_processes=2, distributed_process_id={pid},
+    worker_sync_port={sync_port},
+    kv_role="consumer", kv_transfer_port={kv_port},
+)
+
+async def run():
+    await api_server.serve(cfg)
+    while True:
+        await asyncio.sleep(3600)
+
+asyncio.run(run())
+"""
+
+
+@pytest.mark.slow
+def test_multihost_consumer_disaggregated_prefill():
+    """Disaggregated prefill with a MULTI-HOST decode pool: a single-host
+    producer prefills, KV ships over TCP to the 2-process consumer cluster
+    (whose restores are REPLICATED set_page SPMD dispatches), and the router
+    streams the decode from the consumer's leader. The reference's analogue
+    is NIXL-linked P/D pools under multi-node vLLM."""
+    from production_stack_tpu.testing.procs import start_proc, stop_proc, wait_healthy
+
+    coord, sync, chttp, phttp, rport, kvport = (
+        _free_port() for _ in range(6)
+    )
+    env = dict(
+        os.environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        JAX_PLATFORMS="",
+    )
+    procs, named = [], {}
+    try:
+        for pid in (0, 1):
+            code = _PD_CONSUMER.format(
+                root=os.path.abspath(ROOT), http_port=chttp,
+                coord_port=coord, pid=pid, sync_port=sync, kv_port=kvport,
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-u", "-c", code],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            ))
+        producer = start_proc([
+            "-m", "production_stack_tpu.engine.api_server",
+            "--model", "llama-debug", "--port", str(phttp),
+            "--max-model-len", "128", "--num-pages", "64", "--page-size", "8",
+            "--prefill-chunk", "32",
+            "--kv-role", "producer",
+            "--kv-peer-url", f"http://127.0.0.1:{kvport}",
+        ])
+        named["producer"] = producer
+        import urllib.request
+
+        deadline = time.time() + 540
+        while time.time() < deadline:
+            if any(p.poll() is not None for p in procs):
+                for p in procs:  # kill survivors or communicate() blocks
+                    p.kill()
+                outs = [p.communicate()[0].decode(errors="replace") for p in procs]
+                pytest.fail(f"consumer process exited early:\n{outs[0][-4000:]}\n---\n{outs[1][-4000:]}")
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{chttp}/health", timeout=2
+                )
+                break
+            except Exception:
+                time.sleep(2.0)
+        else:
+            pytest.fail("consumer leader never became healthy")
+        wait_healthy(f"http://127.0.0.1:{phttp}/health", producer, timeout=180)
+
+        router = start_proc([
+            "-m", "production_stack_tpu.router.app",
+            "--port", str(rport), "--service-discovery", "static",
+            "--static-backends",
+            f"http://127.0.0.1:{phttp},http://127.0.0.1:{chttp}",
+            "--static-models", "llama-debug,llama-debug",
+            "--static-model-labels", "prefill,decode",
+            "--routing-logic", "disaggregated_prefill",
+            "--prefill-model-labels", "prefill",
+            "--decode-model-labels", "decode",
+        ])
+        named["router"] = router
+        wait_healthy(f"http://127.0.0.1:{rport}/health", router, timeout=60)
+
+        body = _post_json(rport, "/v1/completions", {
+            "model": "llama-debug",
+            "prompt": "ship this kv across hosts please and thank you",
+            "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+        })
+        assert body["usage"]["completion_tokens"] == 6
+        assert body["choices"][0]["text"]
+
+        # the consumer actually RECEIVED and restored shipped KV (its own
+        # prefill would leave these counters at zero)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{chttp}/metrics", timeout=30
+        ) as r:
+            metrics = r.read().decode()
+        loaded = [
+            float(l.rsplit(" ", 1)[1]) for l in metrics.splitlines()
+            if l.startswith("vllm:kv_offload_loaded_pages_total{")
+        ]
+        assert loaded and loaded[0] > 0, metrics[:2000]
+    finally:
+        for p in named.values():
+            stop_proc(p)
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=30)

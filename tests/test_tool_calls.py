@@ -192,6 +192,8 @@ class _ScriptedEngine:
             "gpu_prefix_cache_hits_total": 0,
             "gpu_prefix_cache_queries_total": 0,
             "prompt_tokens_total": 0, "generation_tokens_total": 0,
+            "decode_dispatches_total": 0,
+            "decode_chained_dispatches_total": 0,
         }
 
     async def generate(self, seq_id, prompt_token_ids, params, lora_name=None):
@@ -451,3 +453,14 @@ class TestValidation:
         type_lines = [l for l in text.splitlines() if l.startswith("# TYPE")]
         assert len(type_lines) == len(set(type_lines)), type_lines
         assert any("ttft_hop_submit_to_first_token" in l for l in type_lines)
+
+    def test_bad_logit_bias_400(self, scripted_server):
+        base, _ = scripted_server(["x"])
+        for bad in ({"not_an_int": 1.0}, {"5": 500.0}, {"-3": 1.0}):
+            r = requests.post(
+                f"{base}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "logit_bias": bad},
+                timeout=30,
+            )
+            assert r.status_code == 400, bad
