@@ -242,7 +242,10 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         cfg = EngineConfig(
             model=model, host="127.0.0.1", port=eport, max_model_len=2048,
             max_num_seqs=16, kv_cache_memory_gb=1.0, prefill_chunk=1024,
-            decode_pipeline=4 if on_tpu else 1,
+            decode_pipeline=(
+                int(os.environ.get("PSTPU_BENCH_DECODE_PIPELINE", "4"))
+                if on_tpu else 1
+            ),
             # CPU jit ignores buffer donation, so pool updates copy the whole
             # pool per step — keep it small there; TPU updates are in-place
             num_pages=None if on_tpu else 2048,
@@ -330,9 +333,9 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         # per-hop TTFT breakdown (made of the instrumentation the servers
         # expose on /metrics): router receive->route->backend-headers->first
         # chunk, engine accept->submit->first token->first SSE write
-        def hop_gauges(metrics_url: str, prefix: str) -> dict:
+        def hop_gauges(metrics_text: str, prefix: str) -> dict:
             out = {}
-            for line in requests.get(metrics_url, timeout=30).text.splitlines():
+            for line in metrics_text.splitlines():
                 if "ttft_hop_" not in line or line.startswith("#"):
                     continue
                 name_part, val = line.rsplit(" ", 1)
@@ -344,14 +347,12 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         breakdown = {}
         chained_ratio = None
         try:
-            breakdown.update(
-                hop_gauges(f"http://127.0.0.1:{rport}/metrics", "router"))
-            breakdown.update(
-                hop_gauges(f"http://127.0.0.1:{eport}/metrics", "engine"))
+            rtext = requests.get(f"http://127.0.0.1:{rport}/metrics", timeout=30).text
+            etext = requests.get(f"http://127.0.0.1:{eport}/metrics", timeout=30).text
+            breakdown.update(hop_gauges(rtext, "router"))
+            breakdown.update(hop_gauges(etext, "engine"))
             counters = {}
-            for line in requests.get(
-                f"http://127.0.0.1:{eport}/metrics", timeout=30
-            ).text.splitlines():
+            for line in etext.splitlines():
                 if line.startswith("vllm:decode_"):
                     counters[line.split("{")[0]] = float(line.rsplit(" ", 1)[1])
             total = counters.get("vllm:decode_dispatches_total", 0)

@@ -375,9 +375,13 @@ class EngineServer:
                 {"error": {"message": "penalties out of range: presence/frequency in [-2, 2], repetition > 0"}},
                 status=400,
             )
-        if (params.wants_penalties or params.logit_bias) and self.cfg.speculative_k:
+        if (
+            params.wants_penalties or params.logit_bias or params.min_tokens > 0
+        ) and self.cfg.speculative_k:
             return web.json_response(
-                {"error": {"message": "sampling penalties and logit_bias are not supported with speculative decoding"}},
+                {"error": {"message": "sampling penalties, logit_bias, and "
+                                      "min_tokens are not supported with "
+                                      "speculative decoding"}},
                 status=400,
             )
         # logprobs: completions takes an int (top count), chat takes

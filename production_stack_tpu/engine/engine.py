@@ -413,9 +413,11 @@ class LLMEngine:
                 # of the sampled distribution (vLLM semantics — suppressing
                 # only the FINISH would feed a sampled EOS back into the
                 # context and derail the continuation). Conservative within
-                # a fused burst: the ban holds for the whole dispatch, so
-                # EOS may be suppressed up to burst-1 tokens past the floor;
-                # the scheduler's finish gate stays as the exact backstop.
+                # a dispatch: the ban holds for ALL the tokens one dispatch
+                # covers (bursts * decode_steps - 1 past the floor in the
+                # worst chained case — the seam forwards the same bias), so
+                # EOS resumes at the next scheduling decision; the
+                # scheduler's finish gate stays as the exact backstop.
                 eos = self.tokenizer.eos_token_id
                 def _eos_ban(s):
                     return (

@@ -188,6 +188,7 @@ class ModelRunner:
         self._steps: dict[bool, Any] = {}  # want_logprobs -> jitted step
         self._set_page_fn = None  # built lazily in set_page
         self._get_page_fn = None  # built lazily in get_page (multi-host)
+        self._last_hist = None    # device history after a burst (chaining)
         self._encode = None       # built lazily in encode (pooled embeddings)
         self._multi_steps: dict[tuple, Any] = {}  # (k, want_lp) -> jitted decode
         self._spec_fns: dict[tuple, Any] = {}   # (steps, k, n) -> jitted spec decode
@@ -317,7 +318,9 @@ class ModelRunner:
         if sig not in self._multi_steps:
             rep, n = self._rep, None
             outs = (
-                (rep, rep, rep, rep, n, n) if want_logprobs else (rep, n, n)
+                (rep, rep, rep, rep, rep, n, n)
+                if want_logprobs
+                else (rep, rep, n, n)
             )
             fn = _multi_step_deferred_fn if self._kv_burst_ok else _multi_step_fn
             self._multi_steps[sig] = jax.jit(
@@ -335,11 +338,13 @@ class ModelRunner:
             self.lora, s["lora_ids"], s.get("pen"), s.get("bias"),
         )
         if want_logprobs:
-            toks, lp, tids, tlp, self.k_pages, self.v_pages = (
+            toks, lp, tids, tlp, hist_f, self.k_pages, self.v_pages = (
                 self._multi_steps[sig](*args)
             )
+            self._last_hist = hist_f if want_pen else None
             return toks, (lp, tids, tlp)
-        toks, self.k_pages, self.v_pages = self._multi_steps[sig](*args)
+        toks, hist_f, self.k_pages, self.v_pages = self._multi_steps[sig](*args)
+        self._last_hist = hist_f if want_pen else None
         return toks
 
     def step_multi_pipelined(
@@ -384,6 +389,12 @@ class ModelRunner:
                 input_ids=toks[:, -1:],
                 positions=pos[:, None].astype(np.int32),
                 kv_lens=lens.astype(np.int32),
+                # penalties: the DEVICE history (with this burst's tokens
+                # already recorded) feeds the next burst — the host copy
+                # staged at chain start is stale past the seam
+                history=(
+                    self._last_hist if inp.history is not None else None
+                ),
             )
         return outs
 
@@ -633,7 +644,7 @@ def _multi_step_fn(forward, cfg, k, want_lp, want_pen, params, k_pages,
         ids = jnp.where(active, nxt, 0)[:, None]
         return (ids, pos, lens, kp, vp, hist), emit
 
-    (_, _, lens_f, k_blk, v_blk, _), emitted = jax.lax.scan(
+    (_, _, lens_f, k_blk, v_blk, hist_f), emitted = jax.lax.scan(
         body, (input_ids, positions, kv_lens, k_blk, v_blk, hist0), keys
     )
     toks = emitted[0] if want_lp else emitted
@@ -649,11 +660,13 @@ def _multi_step_fn(forward, cfg, k, want_lp, want_pen, params, k_pages,
     safe = jnp.where(written, page_table, pool_pages).reshape(-1)
     k_pages = k_pages.at[:, safe].set(k_blk, mode="drop")
     v_pages = v_pages.at[:, safe].set(v_blk, mode="drop")
+    # hist_f returns so chained bursts can feed it forward device-side
+    # (penalty counts must include THIS burst's tokens at the next seam)
     if want_lp:
         _, lp, tids, tlp = emitted  # [k, B], [k, B, K]
         return (toks.T, lp.T, jnp.swapaxes(tids, 0, 1),
-                jnp.swapaxes(tlp, 0, 1), k_pages, v_pages)
-    return toks.T, k_pages, v_pages  # [B, k]
+                jnp.swapaxes(tlp, 0, 1), hist_f, k_pages, v_pages)
+    return toks.T, hist_f, k_pages, v_pages  # [B, k]
 
 
 def _multi_step_deferred_fn(forward, cfg, k, want_lp, want_pen, params,
@@ -727,7 +740,7 @@ def _multi_step_deferred_fn(forward, cfg, k, want_lp, want_pen, params,
         ids = jnp.where(active, nxt, 0)[:, None]
         return (ids, pos, lens, counts, ka, va, hist), emit
 
-    (_, _, _, counts_f, k_acc, v_acc, _), emitted = jax.lax.scan(
+    (_, _, _, counts_f, k_acc, v_acc, hist_f), emitted = jax.lax.scan(
         body, (input_ids, positions, kv_lens, counts, k_acc, v_acc, hist0),
         keys,
     )
@@ -746,8 +759,8 @@ def _multi_step_deferred_fn(forward, cfg, k, want_lp, want_pen, params,
     if want_lp:
         _, lp, tids, tlp = emitted
         return (toks.T, lp.T, jnp.swapaxes(tids, 0, 1),
-                jnp.swapaxes(tlp, 0, 1), k_pages, v_pages)
-    return toks.T, k_pages, v_pages  # [B, k]
+                jnp.swapaxes(tlp, 0, 1), hist_f, k_pages, v_pages)
+    return toks.T, hist_f, k_pages, v_pages  # [B, k]
 
 
 def _ngram_draft(buf, pos, n, k):

@@ -295,9 +295,9 @@ class Scheduler:
                     not self.waiting
                     and not self.spec_k
                     and self.decode_steps > 1
-                    # chained bursts stage history once up front, so penalty
-                    # counts would go stale across the seam — no chaining
-                    and not any(s.params.wants_penalties for s in self.running)
+                    # penalties chain fine: the device history (updated
+                    # in-scan) feeds the next burst at the seam
+                    # (runner.step_multi_pipelined), so counts never go stale
                 )
                 else 1
             )

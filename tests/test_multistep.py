@@ -286,3 +286,22 @@ def test_step_multi_frequency_penalty_no_repeats():
     toks = np.asarray(r.step_multi(inp, k))
     for b in range(B):
         assert len(set(toks[b].tolist())) == k, toks[b]
+
+
+def test_engine_decode_pipeline_with_penalties_matches_unpipelined():
+    """Chained bursts now carry the device history across the seam, so
+    penalties compose with chaining: greedy outputs must match the
+    unchained engine token-for-token (stale seam counts would diverge)."""
+    kw = dict(max_tokens=14, temperature=0.0, ignore_eos=True,
+              frequency_penalty=1.5, presence_penalty=0.4)
+    e1 = LLMEngine(_cfg(decode_steps=3, decode_pipeline=1))
+    e3 = LLMEngine(_cfg(decode_steps=3, decode_pipeline=3))
+    e1.start(), e3.start()
+    try:
+        t1, n1, r1 = _gen_text_and_count(e1, "penalize me please", **kw)
+        t3, n3, r3 = _gen_text_and_count(e3, "penalize me please", **kw)
+        assert n1 == n3 == 14
+        assert t1 == t3
+        assert r1 == r3 == "length"
+    finally:
+        e1.stop(), e3.stop()
