@@ -183,11 +183,30 @@ class EngineServer:
         self.cfg = cfg
         self.engine = engine or LLMEngine(cfg)
         self.start_time = time.time()
+        # graceful drain (SIGTERM): /health flips to 503 so readiness
+        # probes / router health checks pull the pod from rotation, new
+        # generation requests are refused, and in-flight ones finish
+        self.draining = False
 
     # -- handlers -----------------------------------------------------------
 
     async def health(self, request: web.Request) -> web.Response:
+        if self.draining:
+            return web.Response(status=503, text="draining")
         return web.Response(text="")
+
+    async def drain(self, timeout: float = 30.0) -> None:
+        """Stop accepting generation work and wait for the engine to go
+        idle (in-flight requests complete) or ``timeout`` to pass."""
+        self.draining = True
+        logger.info("draining: refusing new requests, waiting for %d in flight",
+                    self.engine.scheduler.num_running())
+        deadline = time.time() + timeout
+        while time.time() < deadline and self.engine.scheduler.has_work():
+            await asyncio.sleep(0.2)
+        if self.engine.scheduler.has_work():
+            logger.warning("drain timeout: %d request(s) still running",
+                           self.engine.scheduler.num_running())
 
     async def version(self, request: web.Request) -> web.Response:
         return web.json_response({"version": __version__})
@@ -348,6 +367,11 @@ class EngineServer:
         tool_style: Optional[str] = None,
     ) -> web.StreamResponse:
         t_accept = time.perf_counter()
+        if self.draining:
+            return web.json_response(
+                {"error": {"message": "engine is draining for shutdown"}},
+                status=503,
+            )
         if self.engine.is_sleeping:
             return web.json_response({"error": "engine is sleeping"}, status=503)
         model = body.get("model", self.cfg.name)
@@ -733,6 +757,11 @@ class EngineServer:
         """OpenAI-compatible /v1/embeddings: mean-pooled, L2-normalized last
         hidden states (surface parity with the router passthrough endpoint,
         routers/main_router.py in /root/reference)."""
+        if self.draining:
+            return web.json_response(
+                {"error": {"message": "engine is draining for shutdown"}},
+                status=503,
+            )
         try:
             body = await request.json()
             inputs = self._tokenize_inputs(body.get("input", []))
@@ -762,6 +791,11 @@ class EngineServer:
 
     async def rerank(self, request: web.Request) -> web.Response:
         """/v1/rerank: order documents by cosine relevance to the query."""
+        if self.draining:
+            return web.json_response(
+                {"error": {"message": "engine is draining for shutdown"}},
+                status=503,
+            )
         try:
             body = await request.json()
             query = body["query"]
@@ -800,6 +834,11 @@ class EngineServer:
 
     async def score(self, request: web.Request) -> web.Response:
         """/v1/score: cosine similarity for (text_1, text_2) pairs."""
+        if self.draining:
+            return web.json_response(
+                {"error": {"message": "engine is draining for shutdown"}},
+                status=503,
+            )
         try:
             body = await request.json()
             t1, t2 = body["text_1"], body["text_2"]
@@ -1061,15 +1100,46 @@ async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
 
 
 def main():
+    import os as os_mod
+    import signal
+
     p = argparse.ArgumentParser("tpu-engine")
     add_engine_args(p)
     args = p.parse_args()
     cfg = config_from_args(args)
 
     async def _run():
-        await serve(cfg)
-        while True:
-            await asyncio.sleep(3600)
+        server, runner = await serve(cfg)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+
+        def on_signal():
+            # first signal: graceful drain. Remove the handlers so a SECOND
+            # Ctrl-C/SIGTERM gets default handling (force quit) instead of
+            # re-setting an already-set event.
+            stop.set()
+            for s in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    loop.remove_signal_handler(s)
+                except (NotImplementedError, ValueError):
+                    pass
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, on_signal)
+            except NotImplementedError:  # non-unix
+                pass
+        await stop.wait()
+        # K8s pod rotation: SIGTERM -> refuse new work + flip /health to 503
+        # (readiness pulls the pod from rotation) -> let in-flight requests
+        # finish -> clean shutdown, all inside terminationGracePeriodSeconds
+        await server.drain(float(os_mod.environ.get("PSTPU_DRAIN_TIMEOUT", "30")))
+        try:
+            await asyncio.wait_for(runner.cleanup(), 15)
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
+        server.engine.stop()
+        logger.info("engine shut down cleanly")
 
     asyncio.run(_run())
 

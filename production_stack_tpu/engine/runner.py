@@ -88,12 +88,20 @@ class ModelRunner:
         fwd_takes_mesh = (
             "mesh" in inspect.signature(self.module.forward).parameters
         )
+        # which mesh axes the family's forward actually implements (a mesh
+        # kwarg alone doesn't imply ring attention / pipeline support)
+        mesh_axes = getattr(
+            self.module, "MESH_AXES",
+            ("dp", "tp") if fwd_takes_mesh else (),
+        )
+        if (self._sp > 1 and "sp" not in mesh_axes) or (
+            self._pp > 1 and "pp" not in mesh_axes
+        ):
+            raise ValueError(
+                f"model family {self.module.__name__.rsplit('.', 1)[-1]!r} "
+                "does not support sequence/pipeline parallelism"
+            )
         if self._sp > 1 or self._pp > 1:
-            if not fwd_takes_mesh:
-                raise ValueError(
-                    f"model family {self.module.__name__.rsplit('.', 1)[-1]!r} "
-                    "does not support sequence/pipeline parallelism"
-                )
             if self._pp > 1 and cfg.num_layers % self._pp:
                 raise ValueError(
                     f"pipeline_parallel_size={self._pp} must divide "

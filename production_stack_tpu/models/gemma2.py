@@ -169,6 +169,11 @@ def _layer_windows(cfg: Gemma2Config) -> jnp.ndarray:
     )
 
 
+# dp/tp only: ring-attention prefill and pipeline stages are llama-family
+# features; the runner gates sp/pp on this declaration
+MESH_AXES = ("dp", "tp")
+
+
 def forward(
     params: dict,
     cfg: Gemma2Config,
@@ -180,6 +185,7 @@ def forward(
     kv_lens: jnp.ndarray,
     all_logits: bool = False,
     kv_burst=None,
+    mesh=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
@@ -253,6 +259,7 @@ def forward(
             # scan as a traced scalar-prefetch operand
             from production_stack_tpu.ops.pallas.paged_attention import (
                 ragged_paged_attention_decode,
+                ragged_paged_attention_decode_sharded,
             )
 
             if burst:
@@ -270,13 +277,20 @@ def forward(
                 pool_args, layer_kw = (k_pages, v_pages), {"layer": li}
             else:
                 pool_args, layer_kw = (kp, vp), {}
-            attn = ragged_paged_attention_decode(
-                q[:, 0], *pool_args, page_table, kv_lens,
+            common = dict(
                 window=window, sm_scale=sm_scale,
                 logit_softcap=cfg.attn_logit_softcap,
                 interpret=cfg.attn_impl == "pallas_interpret",
                 **cur_kw, **layer_kw,
-            )[:, None]
+            )
+            if mesh is not None and mesh.devices.size > 1:
+                attn = ragged_paged_attention_decode_sharded(
+                    mesh, q[:, 0], *pool_args, page_table, kv_lens, **common
+                )[:, None]
+            else:
+                attn = ragged_paged_attention_decode(
+                    q[:, 0], *pool_args, page_table, kv_lens, **common
+                )[:, None]
         elif post_write:
             kc, vc = gather_kv_pages(kp, vp, page_table)
             if burst:

@@ -375,6 +375,11 @@ def _moe_block(h: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
     return jnp.einsum("btei,eih->bth", y, lp["moe_down"])
 
 
+# mesh axes this family's forward actually implements (runner gates sp/pp
+# on this — a mesh kwarg alone doesn't imply ring attention or pipelining)
+MESH_AXES = ("dp", "tp", "sp", "ep", "pp")
+
+
 def forward(
     params: dict,
     cfg: LlamaConfig,

@@ -54,6 +54,9 @@ class RouterApp:
         self._bg: list = []
         self.semantic_cache = None
         self.pii_analyzer = None
+        # graceful drain: health flips 503 (LB pulls the pod) while aiohttp's
+        # shutdown drains in-flight streaming proxies
+        self.draining = False
 
     # -- bootstrap (parity app.py:initialize_all) ---------------------------
 
@@ -287,6 +290,8 @@ class RouterApp:
         return web.json_response({"object": "list", "data": list(seen.values())})
 
     async def health(self, request: web.Request) -> web.Response:
+        if self.draining:
+            return web.json_response({"status": "draining"}, status=503)
         sd = get_service_discovery()
         scraper = get_engine_stats_scraper()
         if not sd.get_health():
@@ -516,13 +521,48 @@ async def serve(args):
 
 
 def main():
+    import os
+    import signal
+
     args = parse_args()
     set_ulimit()
 
     async def _run():
-        await serve(args)
-        while True:
-            await asyncio.sleep(3600)
+        router, runner = await serve(args)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+
+        def on_signal():
+            # first signal drains; removing the handlers restores default
+            # behavior so a second Ctrl-C/SIGTERM force-quits
+            stop.set()
+            for s in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    loop.remove_signal_handler(s)
+                except (NotImplementedError, ValueError):
+                    pass
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, on_signal)
+            except NotImplementedError:  # non-unix
+                pass
+        await stop.wait()
+        # SIGTERM: flip /health to 503 so the LB/readiness pulls this pod,
+        # give the fleet a beat to notice, then let AppRunner.cleanup drain
+        # in-flight streaming proxies (its shutdown waits on live handlers).
+        # PSTPU_DRAIN_TIMEOUT should sit inside the pod's
+        # terminationGracePeriodSeconds (helm routerSpec).
+        router.draining = True
+        await asyncio.sleep(float(os.environ.get("PSTPU_DRAIN_NOTICE", "2")))
+        try:
+            await asyncio.wait_for(
+                runner.cleanup(),
+                float(os.environ.get("PSTPU_DRAIN_TIMEOUT", "60")),
+            )
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
+        logger.info("router shut down cleanly")
 
     asyncio.run(_run())
 

@@ -1,0 +1,99 @@
+"""Graceful drain on SIGTERM (K8s pod rotation): /health flips to 503 so
+readiness pulls the pod, new generation requests are refused, in-flight
+streams run to completion, and the process exits cleanly — all inside
+terminationGracePeriodSeconds. The reference gets this behavior from vLLM's
+shutdown handling + probes; we own the engine, so it is first-party."""
+
+import json
+import signal
+import threading
+
+import pytest
+import requests
+
+from production_stack_tpu.testing.procs import free_port, start_proc, wait_healthy
+
+pytestmark = pytest.mark.slow
+
+
+def test_engine_drains_in_flight_stream_on_sigterm():
+    port = free_port()
+    proc = start_proc([
+        "-m", "production_stack_tpu.engine.api_server",
+        "--model", "llama-debug", "--port", str(port),
+        "--max-model-len", "256", "--num-pages", "64", "--page-size", "8",
+        # slow the stream down enough that SIGTERM lands mid-generation
+        "--decode-steps", "1",
+    ])
+    base = f"http://127.0.0.1:{port}"
+    try:
+        wait_healthy(f"{base}/health", proc, timeout=180)
+
+        got: dict = {}
+
+        def stream():
+            chunks = []
+            with requests.post(
+                f"{base}/v1/completions",
+                json={"model": "llama-debug", "prompt": "drain me gently",
+                      "max_tokens": 48, "temperature": 0.0,
+                      "ignore_eos": True, "stream": True},
+                stream=True, timeout=120,
+            ) as r:
+                got["status"] = r.status_code
+                for line in r.iter_lines():
+                    if line.startswith(b"data:") and b"[DONE]" not in line:
+                        chunks.append(json.loads(line[5:]))
+                    if b"[DONE]" in line:
+                        got["done"] = True
+            got["tokens"] = sum(
+                1 for c in chunks for ch in c.get("choices", [])
+                if ch.get("text")
+            )
+            got["finish"] = next(
+                (ch["finish_reason"] for c in reversed(chunks)
+                 for ch in c.get("choices", []) if ch.get("finish_reason")),
+                None,
+            )
+
+        t = threading.Thread(target=stream)
+        t.start()
+        # wait for the stream to actually start producing
+        import time
+
+        deadline = time.time() + 60
+        while "status" not in got and time.time() < deadline:
+            time.sleep(0.2)
+        assert got.get("status") == 200
+
+        proc.send_signal(signal.SIGTERM)
+
+        # health flips to 503 while the in-flight stream keeps going
+        deadline = time.time() + 30
+        health = None
+        while time.time() < deadline:
+            try:
+                health = requests.get(f"{base}/health", timeout=2).status_code
+                if health == 503:
+                    break
+            except requests.RequestException:
+                break  # server may finish fast; the stream assertions decide
+            time.sleep(0.2)
+        # new work is refused during the drain (only assert if we caught it)
+        if health == 503:
+            r = requests.post(
+                f"{base}/v1/completions",
+                json={"model": "llama-debug", "prompt": "too late",
+                      "max_tokens": 4},
+                timeout=10,
+            )
+            assert r.status_code == 503
+
+        t.join(timeout=120)
+        assert not t.is_alive(), "in-flight stream never completed"
+        assert got.get("done"), "stream was cut before [DONE]"
+        assert got.get("finish") == "length"
+
+        assert proc.wait(timeout=60) == 0, "engine did not exit cleanly"
+    finally:
+        proc.kill()
