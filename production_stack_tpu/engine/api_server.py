@@ -902,7 +902,7 @@ class EngineServer:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.engine.sleep, level
             )
-        except ValueError as e:  # bad level param / level 2 in multi-host
+        except ValueError as e:  # bad level param
             return web.json_response({"error": str(e)}, status=400)
         return web.Response(text="")
 
@@ -1101,7 +1101,8 @@ async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
 
 def main():
     import os as os_mod
-    import signal
+
+    from production_stack_tpu.utils.signals import wait_for_termination
 
     p = argparse.ArgumentParser("tpu-engine")
     add_engine_args(p)
@@ -1110,26 +1111,7 @@ def main():
 
     async def _run():
         server, runner = await serve(cfg)
-        stop = asyncio.Event()
-        loop = asyncio.get_running_loop()
-
-        def on_signal():
-            # first signal: graceful drain. Remove the handlers so a SECOND
-            # Ctrl-C/SIGTERM gets default handling (force quit) instead of
-            # re-setting an already-set event.
-            stop.set()
-            for s in (signal.SIGTERM, signal.SIGINT):
-                try:
-                    loop.remove_signal_handler(s)
-                except (NotImplementedError, ValueError):
-                    pass
-
-        for sig in (signal.SIGTERM, signal.SIGINT):
-            try:
-                loop.add_signal_handler(sig, on_signal)
-            except NotImplementedError:  # non-unix
-                pass
-        await stop.wait()
+        await wait_for_termination()
         # K8s pod rotation: SIGTERM -> refuse new work + flip /health to 503
         # (readiness pulls the pod from rotation) -> let in-flight requests
         # finish -> clean shutdown, all inside terminationGracePeriodSeconds

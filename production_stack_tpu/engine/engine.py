@@ -186,7 +186,6 @@ class LLMEngine:
         self._stop = threading.Event()
         self._sleeping = False
         self._sleep_level = 0
-        self._saved_params = None
         self._lock = threading.Lock()
         # serving stats (scraped by /metrics)
         self.total_prompt_tokens = 0
@@ -874,11 +873,6 @@ class LLMEngine:
         part #5). Runs on the device thread, serialized with steps."""
         if self._sleeping:
             return
-        if level >= 2 and self.cfg.distributed_num_processes > 1:
-            raise ValueError(
-                "sleep level 2 is not supported in multi-host mode (each "
-                "process can only fetch its own param shards); use level 1"
-            )
 
         def do_sleep():
             if self._sleeping:
@@ -892,10 +886,9 @@ class LLMEngine:
             # replicated in multi-host: followers drop their pool shards too
             self.runner.drop_kv_pools()
             if level >= 2:
-                import jax
-
-                self._saved_params = jax.device_get(self.runner.params)
-                self.runner.params = None
+                # REPLICATED: every process offloads its own param shards to
+                # its own host RAM, so level 2 works multi-host too
+                self.runner.offload_params()
             import gc
 
             gc.collect()
@@ -909,16 +902,10 @@ class LLMEngine:
         def do_wake():
             if not self._sleeping:
                 return  # raced with a concurrent wake
-            if self._sleep_level >= 2 and self._saved_params is not None:
-                from production_stack_tpu.parallel import shardings
-
-                pspecs = shardings.param_specs_for(
-                    self._saved_params, pp=self.runner._pp > 1
-                )
-                self.runner.params = shardings.shard_tree(
-                    self._saved_params, pspecs, self.runner.mesh
-                )
-                self._saved_params = None
+            if self._sleep_level >= 2:
+                # REPLICATED: each process re-materializes its shards from
+                # its own host copy (offload_params saved them)
+                self.runner.restore_params()
             self.runner.reset_kv()  # replicated in multi-host
             self.kv = KVPageManager(
                 self.kv.num_pages, self.kv.page_size, offload=self._offload

@@ -581,6 +581,34 @@ class ModelRunner:
         self.k_pages = None
         self.v_pages = None
 
+    def offload_params(self) -> None:
+        """Move params to host RAM (sleep level 2). Each process fetches its
+        own addressable shards, so this works on multi-host meshes as a
+        REPLICATED dispatch — vLLM's sleep level 2 equivalent, per process."""
+        def off(arr):
+            shards = [
+                (s.device, np.asarray(s.data)) for s in arr.addressable_shards
+            ]
+            return (arr.shape, arr.sharding, shards)
+
+        self._params_host = jax.tree.map(off, self.params)
+        self.params = None
+
+    def restore_params(self) -> None:
+        """Re-materialize params on device from the per-process host shards
+        saved by offload_params (sleep level 2 wake)."""
+        def back(saved):
+            shape, sharding, shards = saved
+            locals_ = [jax.device_put(data, dev) for dev, data in shards]
+            return jax.make_array_from_single_device_arrays(
+                shape, sharding, locals_
+            )
+
+        self.params = jax.tree.map(
+            back, self._params_host, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        self._params_host = None
+
     def reset_kv(self) -> None:
         """Zero the page pools (sleep/wake support frees and re-creates them)."""
         kp, vp = self.module.init_kv_pages(self.cfg, self.num_pages, self.page_size)

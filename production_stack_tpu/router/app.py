@@ -522,32 +522,15 @@ async def serve(args):
 
 def main():
     import os
-    import signal
+
+    from production_stack_tpu.utils.signals import wait_for_termination
 
     args = parse_args()
     set_ulimit()
 
     async def _run():
         router, runner = await serve(args)
-        stop = asyncio.Event()
-        loop = asyncio.get_running_loop()
-
-        def on_signal():
-            # first signal drains; removing the handlers restores default
-            # behavior so a second Ctrl-C/SIGTERM force-quits
-            stop.set()
-            for s in (signal.SIGTERM, signal.SIGINT):
-                try:
-                    loop.remove_signal_handler(s)
-                except (NotImplementedError, ValueError):
-                    pass
-
-        for sig in (signal.SIGTERM, signal.SIGINT):
-            try:
-                loop.add_signal_handler(sig, on_signal)
-            except NotImplementedError:  # non-unix
-                pass
-        await stop.wait()
+        await wait_for_termination()
         # SIGTERM: flip /health to 503 so the LB/readiness pulls this pod,
         # give the fleet a beat to notice, then let AppRunner.cleanup drain
         # in-flight streaming proxies (its shutdown waits on live handlers).

@@ -355,6 +355,19 @@ class TestHTTPServer:
         )
         assert r.status_code == 200 and r.json()["usage"]["completion_tokens"] == 2
 
+    def test_sleep_level2_restores_params_exactly(self, server):
+        """Level 2 offloads the weights to host RAM; after wake the SAME
+        greedy continuation must come back — a corrupted restore would
+        serve plausible-looking garbage."""
+        body = {"prompt": "weights roundtrip", "max_tokens": 6,
+                "temperature": 0.0, "ignore_eos": True}
+        before = requests.post(f"{server}/v1/completions", json=body).json()
+        assert requests.post(f"{server}/sleep?level=2").status_code == 200
+        assert requests.get(f"{server}/is_sleeping").json()["is_sleeping"] is True
+        assert requests.post(f"{server}/wake_up").status_code == 200
+        after = requests.post(f"{server}/v1/completions", json=body).json()
+        assert after["choices"][0]["text"] == before["choices"][0]["text"]
+
 
 def test_logit_bias_forces_and_bans_tokens(engine):
     """OpenAI logit_bias: +100 on one token makes greedy pick it every step;

@@ -305,6 +305,8 @@ def test_two_process_serving_e2e():
         follower_out = procs[1].communicate()[0].decode(errors="replace")
         for marker in ("follower replayed set_lora_slot",
                        "follower replayed drop_kv_pools",
+                       "follower replayed offload_params",
+                       "follower replayed restore_params",
                        "follower replayed reset_kv",
                        # offload spill fetched a page via the replicated
                        # SPMD gather on BOTH processes
@@ -433,9 +435,10 @@ def _kvaware_roundtrip(http_port: int, ctl_port: int) -> None:
 
 
 def _sleep_wake_roundtrip(http_port: int) -> None:
-    """Multi-host sleep/wake at level 1: drop_kv_pools/reset_kv are
-    replicated, so followers free and re-create their pool shards in
-    lockstep, and serving resumes after wake."""
+    """Multi-host sleep/wake: level 1 (drop_kv_pools/reset_kv replicated)
+    and level 2 (offload_params/restore_params — each process offloads its
+    OWN param shards to its own host RAM and re-materializes them). The
+    level-2 greedy equivalence proves followers restored real weights."""
     import urllib.request
 
     _post_json(http_port, "/sleep?level=1", {})
@@ -444,11 +447,19 @@ def _sleep_wake_roundtrip(http_port: int) -> None:
     ) as r:
         assert json.loads(r.read())["is_sleeping"] is True
     _post_json(http_port, "/wake_up", {})
-    body = _post_json(http_port, "/v1/completions", {
+    probe = {
         "model": "llama-debug", "prompt": "awake again",
         "max_tokens": 3, "temperature": 0.0,
-    })
+    }
+    body = _post_json(http_port, "/v1/completions", probe)
     assert body["usage"]["completion_tokens"] == 3
+    before = body["choices"][0]["text"]
+
+    _post_json(http_port, "/sleep?level=2", {})
+    _post_json(http_port, "/wake_up", {})
+    body = _post_json(http_port, "/v1/completions", probe)
+    assert body["usage"]["completion_tokens"] == 3
+    assert body["choices"][0]["text"] == before  # weights survived level 2
 
 
 _PD_CONSUMER = """
