@@ -1008,10 +1008,10 @@ def _init_multihost(cfg: EngineConfig) -> int:
     # fetch sees the whole page; set_page restores broadcast the bytes back.
     # The tiers/controller/cache-server connections are leader-only
     # (followers get them disabled in serve()).
-    # sleep mode works multi-host at level 1: drop_kv_pools/reset_kv are
-    # replicated dispatches, so followers free and re-create their pool
-    # shards in lockstep (level 2 is rejected at request time: each process
-    # can only fetch its own param shards).
+    # sleep mode works multi-host at BOTH levels: drop_kv_pools/reset_kv
+    # and offload_params/restore_params are replicated dispatches — each
+    # process offloads its own param shards to its own host RAM and
+    # re-materializes them on wake.
     # LoRA works multi-host: the leader parses adapter checkpoints and the
     # resulting set_lora_slot/clear_lora_slot device writes are REPLICATED
     # dispatches — followers receive the weights over the step stream, so

@@ -197,6 +197,7 @@ class ModelRunner:
         self._set_page_fn = None  # built lazily in set_page
         self._get_page_fn = None  # built lazily in get_page (multi-host)
         self._last_hist = None    # device history after a burst (chaining)
+        self._params_host = None  # host copy during sleep level 2
         self._encode = None       # built lazily in encode (pooled embeddings)
         self._multi_steps: dict[tuple, Any] = {}  # (k, want_lp) -> jitted decode
         self._spec_fns: dict[tuple, Any] = {}   # (steps, k, n) -> jitted spec decode
@@ -584,22 +585,39 @@ class ModelRunner:
     def offload_params(self) -> None:
         """Move params to host RAM (sleep level 2). Each process fetches its
         own addressable shards, so this works on multi-host meshes as a
-        REPLICATED dispatch — vLLM's sleep level 2 equivalent, per process."""
-        def off(arr):
-            shards = [
-                (s.device, np.asarray(s.data)) for s in arr.addressable_shards
-            ]
-            return (arr.shape, arr.sharding, shards)
+        REPLICATED dispatch — vLLM's sleep level 2 equivalent, per process.
 
-        self._params_host = jax.tree.map(off, self.params)
+        Shards replicated across local devices (dp/sp axes, or wholly
+        replicated leaves) are fetched and stored ONCE, keyed by shard
+        index — saving host RAM is the entire point of level 2."""
+        def off(arr):
+            bufs: dict = {}
+            placements = []
+            for s in arr.addressable_shards:
+                key = repr(s.index)
+                if key not in bufs:
+                    bufs[key] = np.asarray(s.data)
+                placements.append((s.device, key))
+            return (arr.shape, arr.sharding, placements, bufs)
+
+        # build the full host tree BEFORE dropping the device refs: a
+        # mid-tree failure (host OOM is the at-risk case) must leave the
+        # engine wakeable with its device params intact
+        host = jax.tree.map(off, self.params)
+        self._params_host = host
         self.params = None
 
     def restore_params(self) -> None:
         """Re-materialize params on device from the per-process host shards
         saved by offload_params (sleep level 2 wake)."""
+        if self._params_host is None:
+            return  # offload never completed; device params are still live
+
         def back(saved):
-            shape, sharding, shards = saved
-            locals_ = [jax.device_put(data, dev) for dev, data in shards]
+            shape, sharding, placements, bufs = saved
+            locals_ = [
+                jax.device_put(bufs[key], dev) for dev, key in placements
+            ]
             return jax.make_array_from_single_device_arrays(
                 shape, sharding, locals_
             )

@@ -284,16 +284,23 @@ class Scheduler:
         self._try_admit()
         prefilling = [s for s in self.running if s.in_prefill]
         decoding = [s for s in self.running if not s.in_prefill]
-        if prefilling and not (decoding and self._last_kind == "prefill"):
-            # alternate with decode bursts when both kinds of work exist:
-            # strict prefill priority starves decodes under a steady arrival
-            # stream (measured 64-token answers taking ~40 s under the
-            # multi-round-qa workload) — the whole point of chunked prefill
-            # is that decode latency survives long prompts. One decode burst
-            # (decode_steps tokens/row) per prefill chunk bounds both sides.
-            self._last_kind = "prefill"
-            prefilling.sort(key=lambda s: len(s.prompt_ids) - s.num_computed)
-            return self._plan_prefill(prefilling[: self.prefill_batch])
+        # Alternate prefill chunks with decode bursts when a REAL prefill
+        # backlog coexists with decoding rows: strict prefill priority
+        # starves decodes under a steady long-prompt arrival stream
+        # (measured 64-token answers taking ~40 s under the multi-round-qa
+        # workload) — the whole point of chunked prefill is that decode
+        # latency survives long prompts. The backlog threshold keeps SHORT
+        # prefill flurries on the fast strict-priority path: they clear in
+        # a dispatch or two, and alternating through them would pay a fetch
+        # round trip per interleaved (unchained) decode burst.
+        backlog = sum(len(s.prompt_ids) - s.num_computed for s in prefilling)
+        alternate = (
+            decoding
+            and backlog >= 2 * self.prefill_chunk
+            and self._last_kind == "prefill"
+        )
+        if prefilling and not alternate:
+            return self._take_prefill(prefilling)
         self._last_kind = "decode"
         if self.running:
             # chain bursts only when nothing is waiting to join the batch:
@@ -322,13 +329,16 @@ class Scheduler:
                 # — a page another live sequence owns.
                 prefilling = [s for s in self.running if s.in_prefill]
                 if prefilling:
-                    self._last_kind = "prefill"
-                    prefilling.sort(
-                        key=lambda s: len(s.prompt_ids) - s.num_computed
-                    )
-                    return self._plan_prefill(prefilling[: self.prefill_batch])
+                    return self._take_prefill(prefilling)
             return batch
         return None
+
+    def _take_prefill(self, prefilling: list[Sequence]) -> ScheduledBatch:
+        """Plan the next prefill dispatch: shortest remaining prompts first
+        (they finish and start decoding soonest)."""
+        self._last_kind = "prefill"
+        prefilling.sort(key=lambda s: len(s.prompt_ids) - s.num_computed)
+        return self._plan_prefill(prefilling[: self.prefill_batch])
 
     def _plan_prefill(self, seqs: list[Sequence]) -> ScheduledBatch:
         chunks = [
