@@ -1,12 +1,17 @@
 """Benchmark entry point (driver-run, real TPU).
 
-Primary metric, round 1: p50 TTFT for a 1024-token prefill on the flagship
-Llama-3.2-1B-class model, single chip. The north star (BASELINE.json) is
-Llama-3-8B < 200 ms p50 TTFT on v5e-8 (8 chips); 1B on 1 chip carries the same
-per-chip FLOP/byte load, so 200 ms is the comparable target and
-``vs_baseline = 200 / p50_ttft_ms`` (>1.0 beats the target). The JSON line also
-reports decode throughput (tokens/sec/chip) as a secondary metric. Later rounds
-switch this to the full multi-round-qa run through the HTTP stack.
+Primary metric (round 4+): p50 TTFT of the multi-round-qa workload driven
+through the FULL serving stack — streaming HTTP client -> router -> engine
+API server -> LLMEngine — the reference's canonical benchmark
+(/root/reference/benchmarks/multi-round-qa/run.sh, multi-round-qa.py), scaled
+to one chip (32 users x 5 rounds, ~1k-token shared system prompt, 100-token
+answers). The north star (BASELINE.json) is Llama-3-8B < 200 ms p50 TTFT on
+v5e-8 (8 chips) via the router; 1B on 1 chip carries the same per-chip
+FLOP/byte load, so ``vs_baseline = 200 / qa_p50_ttft_ms`` (>1.0 beats the
+target). Extras carry the rest of BASELINE.json's metric triple (QA
+tokens/sec/chip, KV-cache hit rate) plus the engine-level micro benches
+(prefill TTFT, decode tok/s/chip, 16k/32k long-context) and per-phase TTFT
+hop breakdowns.
 """
 
 from __future__ import annotations
@@ -42,6 +47,7 @@ def main() -> None:
     # flagship preset with random weights (hermetic environments)
     model_dir = os.environ.get("PSTPU_BENCH_MODEL_DIR")
     runner_kw = {}
+    long_targets = []
     if model_dir:
         from production_stack_tpu.engine.model_loader import load_model
 
@@ -55,16 +61,30 @@ def main() -> None:
         # position table clamp silently and would bench garbage
         prefill_len = min(prefill_len, (cfg.max_model_len - 1) // page_size * page_size)
         ctx_pages = min(ctx_pages, (cfg.max_model_len - 1) // page_size)
+        long_targets = [
+            t for t in (16384, 32768) if t + 1 <= cfg.max_model_len
+        ]
     elif on_tpu:
-        cfg = llama.PRESETS["llama-3.2-1b"]
+        # max_model_len=32768 (values-17-kv-aware parity): the long-context
+        # phase proves 16k/32k chunked prefill + decode on the real chip
+        cfg = dataclasses.replace(
+            llama.PRESETS["llama-3.2-1b"], max_model_len=32768
+        )
         model_desc = "llama-3.2-1b-class (random weights)"
         prefill_len, decode_batch, ctx_pages = 1024, 16, 16  # 1024-token contexts
         page_size = 64
+        long_targets = [16384, 32768]
     else:  # tiny fallback so the benchmark is runnable anywhere
         cfg = dataclasses.replace(llama.PRESETS["llama-debug"])
         model_desc = "llama-debug (random weights)"
         prefill_len, decode_batch, ctx_pages, page_size = 64, 4, 8, 8
-    num_pages = decode_batch * ctx_pages + ctx_pages
+    # pool sized for BOTH the decode phase (decode_batch rows of ctx_pages)
+    # and the long-context phase (one sequence of up to 32k tokens + a
+    # decode-step page of headroom)
+    lc_pages_max = max(
+        [ctx_pages] + [t // page_size + 2 for t in long_targets]
+    )
+    num_pages = decode_batch * ctx_pages + lc_pages_max
 
     runner = ModelRunner(
         cfg, num_pages=num_pages, page_size=page_size, seed=0, **runner_kw
@@ -129,20 +149,23 @@ def main() -> None:
     dt = time.perf_counter() - t0
     decode_tps = B * k * bursts / dt
 
-    # --- long-context chunked prefill: one 8k-token sequence, engine-style
-    # 1k chunks (the serving path for long prompts; SURVEY long-context).
+    # --- long context (values-17 parity, 32k max_model_len): chunked prefill
+    # of one 16k then 32k sequence in engine-style 1k chunks, plus a decode
+    # burst at >=16k context (the "multi-round turn on a long history" shape).
     # Throughput counts the WHOLE sequence against wall time, chunks
     # dispatched back-to-back with one final fetch (fetch-per-chunk would
-    # bill ~100 ms RTT x 8 to compute that runs async anyway).
-    long_ctx = min(8192, (cfg.max_model_len - 1) // page_size * page_size)
+    # bill ~100 ms RTT per chunk for compute that runs async anyway).
     lc_metrics = {}
-    if on_tpu and long_ctx >= 4 * prefill_len and num_pages * page_size >= long_ctx:
+    lc_base = decode_batch * ctx_pages  # pool region after the decode rows
+    for long_ctx in long_targets:
+        if num_pages * page_size < long_ctx + page_size:
+            continue
         chunk = prefill_len  # 1024: same chunk bucket phase 1 compiled
         n_chunks = long_ctx // chunk
         long_ctx = n_chunks * chunk  # bill exactly what runs
-        lc_pages = long_ctx // page_size
+        lc_pages = long_ctx // page_size + 1
         lc_ids = rng.randint(0, cfg.vocab_size, (1, long_ctx))
-        pt_lc = np.arange(lc_pages)[None, :]
+        pt_lc = (np.arange(lc_pages) + lc_base)[None, :]
 
         def run_long_prefill():
             for c in range(n_chunks):
@@ -157,15 +180,38 @@ def main() -> None:
                 ))
             np.asarray(ids)
 
-        run_long_prefill()  # compile the (1, chunk, lc_pages) bucket
+        run_long_prefill()  # compile the (1, chunk, pages-bucket) variant
         t0 = time.perf_counter()
         run_long_prefill()
         dt = time.perf_counter() - t0
-        lc_metrics = {
-            "prefill_long_context_tokens": long_ctx,
-            "prefill_long_ms": round(dt * 1000, 2),
-            "prefill_long_tokens_per_sec": round(long_ctx / dt, 1),
-        }
+        tag = f"{long_ctx // 1024}k"
+        lc_metrics[f"prefill_{tag}_ms"] = round(dt * 1000, 2)
+        lc_metrics[f"prefill_{tag}_tokens_per_sec"] = round(long_ctx / dt, 1)
+
+        # decode burst on the fresh long history: one user's next turn
+        # (skipped when the burst would step past the rope table, e.g. a
+        # full-32k prefill at max_model_len=32768)
+        if long_ctx + k >= cfg.max_model_len:
+            continue
+        lc_dec = StepInput(
+            input_ids=rng.randint(0, cfg.vocab_size, (1, 1)),
+            positions=np.full((1, 1), long_ctx),
+            page_table=pt_lc,
+            kv_lens=np.full((1,), long_ctx + 1),
+            temperature=np.full(1, 0.7),
+            top_k=np.full(1, 40),
+            top_p=np.full(1, 0.95),
+        )
+        for _ in range(2):
+            np.asarray(runner.step_multi(lc_dec, k))
+        reps = 4
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            lc_toks = runner.step_multi(lc_dec, k)
+        np.asarray(lc_toks)
+        lc_metrics[f"decode_at_{tag}_tokens_per_sec"] = round(
+            k * reps / (time.perf_counter() - t0), 1
+        )
 
     # free phase-1 device buffers before the serving stack allocates its own
     del runner, dec, ttft_inp, ids, toks
@@ -174,7 +220,8 @@ def main() -> None:
     gc.collect()
 
     extras = {
-        "p99_ttft_ms": round(p99_ttft, 2),
+        "p50_ttft_ms_1k_prefill": round(p50_ttft, 2),
+        "p99_ttft_ms_1k_prefill": round(p99_ttft, 2),
         "decode_tokens_per_sec_per_chip": round(decode_tps, 1),
         "decode_batch": B,
         "decode_context": ctx + 1,
@@ -184,27 +231,42 @@ def main() -> None:
     extras.update(lc_metrics)
     extras.update(http_stack_metrics(on_tpu, model_dir))
 
-    print(
-        json.dumps(
-            {
-                "metric": "p50_ttft_ms_1k_prefill_flagship_1chip",
-                "value": round(p50_ttft, 2),
-                "unit": "ms",
-                "vs_baseline": round(200.0 / p50_ttft, 3),
-                "extras": extras,
-            }
-        ),
-        flush=True,
-    )
+    qa_p50 = extras.get("qa_p50_ttft_ms")
+    if qa_p50:
+        primary = {
+            "metric": "multi_round_qa_p50_ttft_ms_via_router_1chip",
+            "value": qa_p50,
+            "unit": "ms",
+            "vs_baseline": round(200.0 / qa_p50, 3),
+            "extras": extras,
+        }
+    else:
+        # fail-soft: the QA phase could not run (error recorded in extras);
+        # fall back to the engine-level prefill TTFT so the line still prints
+        primary = {
+            "metric": "p50_ttft_ms_1k_prefill_flagship_1chip",
+            "value": round(p50_ttft, 2),
+            "unit": "ms",
+            "vs_baseline": round(200.0 / p50_ttft, 3),
+            "extras": extras,
+        }
+    print(json.dumps(primary), flush=True)
 
 
 def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
-    """Phase 2: TTFT/throughput through the FULL serving stack — streaming
-    HTTP client -> router (round-robin, static discovery) -> engine API
-    server -> LLMEngine — matching the north star's shape ("p50 TTFT … via
-    router", BASELINE.json). Both servers run in-process on one asyncio loop
-    (the axon tunnel allows a single TPU client process). Fail-soft: returns
-    {} if anything breaks so the primary metric line always prints."""
+    """Serving-stack phases — everything below runs through the FULL stack:
+    streaming HTTP client -> router (round-robin, static discovery) -> engine
+    API server -> LLMEngine — matching the north star's shape ("p50 TTFT …
+    via router", BASELINE.json). Both servers run in-process on one asyncio
+    loop (the axon tunnel allows a single TPU client process).
+
+    Sub-phases, each with its own TTFT hop window (POST /metrics/reset
+    between phases so quantiles describe the phase they ship with):
+      1. sequential TTFT through the router (+ engine-direct contrast)
+      2. saturated throughput + steady-state decode through the stack
+      3. multi-round-qa — THE PRIMARY PHASE (qa_* metrics)
+    Fail-soft: returns partial metrics if a phase breaks so the primary
+    metric line always prints."""
     import asyncio
     import threading
 
@@ -213,6 +275,7 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
     router_runner = None
     loop = None
     loop_thread = None
+    out: dict = {}
     try:
         import concurrent.futures as cf
 
@@ -235,13 +298,12 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         loop_thread = threading.Thread(target=loop.run_forever, daemon=True)
         loop_thread.start()
         # decode_pipeline=4: burst chaining pays one fetch round trip per 4
-        # bursts instead of 1 — the flagship round-1 optimization. Affordable
-        # in the short measured window now that the persistent compilation
-        # cache (enabled in main()) serves the extra chained program variants
-        # from disk after the first-ever run on a machine.
+        # bursts instead of 1. The scheduler's adaptive chain cap
+        # (scheduler.py) shortens chains under a live arrival stream, so
+        # TTFT no longer pays for the chaining that decode throughput earns.
         cfg = EngineConfig(
-            model=model, host="127.0.0.1", port=eport, max_model_len=2048,
-            max_num_seqs=16, kv_cache_memory_gb=1.0, prefill_chunk=1024,
+            model=model, host="127.0.0.1", port=eport, max_model_len=4096,
+            max_num_seqs=32, kv_cache_memory_gb=4.0, prefill_chunk=1024,
             decode_pipeline=(
                 int(os.environ.get("PSTPU_BENCH_DECODE_PIPELINE", "4"))
                 if on_tpu else 1
@@ -268,6 +330,45 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         engine_url = f"http://127.0.0.1:{eport}/v1/completions"
         rng = np.random.RandomState(7)
 
+        def reset_hop_windows():
+            for port in (rport, eport):
+                requests.post(
+                    f"http://127.0.0.1:{port}/metrics/reset", timeout=30
+                ).raise_for_status()
+
+        def hop_gauges(metrics_text: str, prefix: str) -> dict:
+            out_h = {}
+            for line in metrics_text.splitlines():
+                if "ttft_hop_" not in line or line.startswith("#"):
+                    continue
+                name_part, val = line.rsplit(" ", 1)
+                hop = name_part.split("ttft_hop_")[1].split("_ms")[0]
+                q = name_part.split('quantile="')[1].split('"')[0]
+                out_h.setdefault(hop, {})[q] = float(val)
+            return {f"{prefix}.{h}": qs for h, qs in out_h.items()}
+
+        def scrape_hops() -> dict:
+            breakdown = {}
+            rtext = requests.get(
+                f"http://127.0.0.1:{rport}/metrics", timeout=30
+            ).text
+            etext = requests.get(
+                f"http://127.0.0.1:{eport}/metrics", timeout=30
+            ).text
+            breakdown.update(hop_gauges(rtext, "router"))
+            breakdown.update(hop_gauges(etext, "engine"))
+            return breakdown
+
+        def engine_counters() -> dict:
+            etext = requests.get(
+                f"http://127.0.0.1:{eport}/metrics", timeout=30
+            ).text
+            c = {}
+            for line in etext.splitlines():
+                if line.startswith("vllm:") and "_total{" in line:
+                    c[line.split("{")[0]] = float(line.rsplit(" ", 1)[1])
+            return c
+
         def one_request(max_tokens: int, target: str = None,
                         prompt_len: int = None) -> tuple[float, float, int]:
             # unique prompt every call so the prefix cache can't shortcut TTFT
@@ -292,12 +393,34 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
                         ttft = time.perf_counter() - t0
             return ttft, time.perf_counter() - t0, chunks
 
+        # ---- sub-phase 1: sequential TTFT (own hop window) ----------------
         for _ in range(2):
             one_request(16)  # compile prefill chunk + decode burst shapes
+        reset_hop_windows()
         ttfts = [one_request(16)[0] * 1000 for _ in range(n_reqs)]
-        # same request direct to the engine server: isolates the router hop
+        # scrape BEFORE the engine-direct contrast requests so the hop
+        # quantiles describe exactly the routed requests measured above
+        ttft_breakdown = scrape_hops()
         eng_ttfts = [one_request(16, engine_url)[0] * 1000 for _ in range(n_reqs)]
+        out.update({
+            "http_p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 2),
+            "http_p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
+            # engine-server-direct TTFT baseline; router overhead is
+            # http_p50_ttft_ms minus this
+            "http_engine_direct_p50_ttft_ms": round(
+                float(np.percentile(eng_ttfts, 50)), 2
+            ),
+            # hops from THIS phase only; router hop p50s sum to ~the client
+            # p50 (client-side connect/read overhead is the remainder)
+            "ttft_breakdown_ms": ttft_breakdown,
+            "ttft_breakdown_router_p50_sum_ms": round(sum(
+                qs.get("p50", 0.0) for h, qs in ttft_breakdown.items()
+                if h.startswith("router.")
+            ), 2),
+            "http_prefill_tokens": plen,
+        })
 
+        # ---- sub-phase 2: saturated throughput + steady-state decode ------
         # concurrent batch shapes (decode batch bucket, multi-seq prefill)
         # compile on first use — warm them up outside the measured window.
         # Two rounds: ramp-up/down crosses several (batch, pages) buckets,
@@ -318,72 +441,161 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         # on top of the engine's decode rate
         dec_gen = 256 if on_tpu else 16
         dec_conc = 16 if on_tpu else conc
-        def decode_request(_i):
-            ttft, total, chunks = one_request(dec_gen, prompt_len=64)
+        def decode_request(_i, target=None):
+            ttft, total, chunks = one_request(dec_gen, target=target, prompt_len=64)
             return ttft, total, chunks
         with cf.ThreadPoolExecutor(dec_conc) as ex:  # warm the bucket
             list(ex.map(decode_request, range(dec_conc)))
+        c0 = engine_counters()
         with cf.ThreadPoolExecutor(dec_conc) as ex:
             res = list(ex.map(decode_request, range(dec_conc)))
+        c1 = engine_counters()
         decode_rates = [
             (dec_gen - 1) / (total - ttft) for ttft, total, _ in res if total > ttft
         ]
-        http_decode_tps = float(sum(decode_rates))
-
-        # per-hop TTFT breakdown (made of the instrumentation the servers
-        # expose on /metrics): router receive->route->backend-headers->first
-        # chunk, engine accept->submit->first token->first SSE write
-        def hop_gauges(metrics_text: str, prefix: str) -> dict:
-            out = {}
-            for line in metrics_text.splitlines():
-                if "ttft_hop_" not in line or line.startswith("#"):
-                    continue
-                name_part, val = line.rsplit(" ", 1)
-                hop = name_part.split("ttft_hop_")[1].split("_ms")[0]
-                q = name_part.split('quantile="')[1].split('"')[0]
-                out.setdefault(hop, {})[q] = float(val)
-            return {f"{prefix}.{h}": qs for h, qs in out.items()}
-
-        breakdown = {}
-        chained_ratio = None
-        try:
-            rtext = requests.get(f"http://127.0.0.1:{rport}/metrics", timeout=30).text
-            etext = requests.get(f"http://127.0.0.1:{eport}/metrics", timeout=30).text
-            breakdown.update(hop_gauges(rtext, "router"))
-            breakdown.update(hop_gauges(etext, "engine"))
-            counters = {}
-            for line in etext.splitlines():
-                if line.startswith("vllm:decode_"):
-                    counters[line.split("{")[0]] = float(line.rsplit(" ", 1)[1])
-            total = counters.get("vllm:decode_dispatches_total", 0)
-            if total:
-                chained_ratio = round(
-                    counters.get("vllm:decode_chained_dispatches_total", 0)
-                    / total, 3,
-                )
-        except Exception as e:  # noqa: BLE001
-            breakdown["error"] = str(e)
-
-        return {
-            "http_p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 2),
-            "http_p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
-            # engine-server-direct TTFT baseline; router overhead is
-            # http_p50_ttft_ms minus this
-            "http_engine_direct_p50_ttft_ms": round(float(np.percentile(eng_ttfts, 50)), 2),
+        # same phase direct against the engine server: splits the gap to the
+        # runner-loop rate into (engine serving loop + SSE) vs (router proxy)
+        with cf.ThreadPoolExecutor(dec_conc) as ex:
+            dres = list(ex.map(
+                lambda i: decode_request(i, target=engine_url), range(dec_conc)
+            ))
+        direct_rates = [
+            (dec_gen - 1) / (total - ttft) for ttft, total, _ in dres if total > ttft
+        ]
+        total_disp = (
+            c1.get("vllm:decode_dispatches_total", 0)
+            - c0.get("vllm:decode_dispatches_total", 0)
+        )
+        chained = (
+            c1.get("vllm:decode_chained_dispatches_total", 0)
+            - c0.get("vllm:decode_chained_dispatches_total", 0)
+        )
+        out.update({
             "http_stack_tokens_per_sec": round(stack_tps, 1),
-            "http_decode_tokens_per_sec": round(http_decode_tps, 1),
+            "http_decode_tokens_per_sec": round(float(sum(decode_rates)), 1),
+            "http_decode_engine_direct_tokens_per_sec": round(
+                float(sum(direct_rates)), 1
+            ),
             "http_decode_concurrency": dec_conc,
-            # fraction of decode dispatches that chained bursts: chaining
-            # only engages on a quiescent batch, and each unchained dispatch
-            # pays a fetch round trip — a low ratio explains a low decode
-            # rate through the stack
-            "http_decode_chained_dispatch_ratio": chained_ratio,
+            # fraction of decode dispatches that chained bursts IN THIS
+            # PHASE: chaining only engages on a quiescent batch, and each
+            # unchained dispatch pays a fetch round trip — a low ratio
+            # explains a low decode rate through the stack
+            "http_decode_chained_dispatch_ratio": (
+                round(chained / total_disp, 3) if total_disp else None
+            ),
             "http_concurrency": conc,
-            "http_prefill_tokens": plen,
-            "ttft_breakdown_ms": breakdown,
-        }
+        })
+
+        # ---- sub-phase 3 (PRIMARY): multi-round-qa through the router -----
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "benchmarks"
+        ))
+        from multi_round_qa import UserSessionManager
+        from multi_round_qa import parse_args as qa_parse_args
+
+        qa_points = []
+        qa_err = None
+        users, rounds, answer_len = (32, 5, 100) if on_tpu else (4, 2, 8)
+        shared_words, hist_words = (150, 100) if on_tpu else (20, 10)
+
+        def run_qa(qps, n_users, n_rounds, ans):
+            qa_args = qa_parse_args([
+                "--base-url", f"http://127.0.0.1:{rport}/v1",
+                "--model", model,
+                "--qps", str(qps),
+                "--num-users", str(n_users),
+                "--num-rounds", str(n_rounds),
+                "--answer-len", str(ans),
+                "--shared-prefix-len", str(shared_words),
+                "--user-history-len", str(hist_words),
+                "--round-gap", "1.0",
+                "--log-interval", "0",
+            ])
+            mgr = UserSessionManager(qa_args)
+            return asyncio.run_coroutine_threadsafe(mgr.run(), loop).result(1800)
+
+        # warmup: the QA workload reaches context lengths (and so page-table
+        # width buckets) and batch shapes the earlier phases never touched;
+        # any bucket left cold would compile (~20-40 s over the axon tunnel)
+        # inside a measured point. Full user count at half rounds covers the
+        # deepest decode batch; the persistent compile cache makes this
+        # near-free on every run after a machine's first.
+        try:
+            run_qa(8.0, users, max(1, rounds // 2), answer_len)
+        except Exception:  # noqa: BLE001 - warmup is best-effort
+            pass
+        for qps in ([1.0, 2.0] if on_tpu else [4.0]):
+            try:
+                reset_hop_windows()
+                c0 = engine_counters()
+                t0 = time.perf_counter()
+                summary = run_qa(qps, users, rounds, answer_len)
+                elapsed = time.perf_counter() - t0
+                if summary.completed == 0 or summary.p50_ttft != summary.p50_ttft:
+                    raise RuntimeError(
+                        f"qa run at qps={qps}: no successful requests "
+                        f"({summary.failed} failed)"
+                    )
+                c1 = engine_counters()
+                hits = (
+                    c1.get("vllm:gpu_prefix_cache_hits_total", 0)
+                    - c0.get("vllm:gpu_prefix_cache_hits_total", 0)
+                )
+                queries = (
+                    c1.get("vllm:gpu_prefix_cache_queries_total", 0)
+                    - c0.get("vllm:gpu_prefix_cache_queries_total", 0)
+                )
+                qa_points.append({
+                    "qps": qps,
+                    "p50_ttft_ms": round(summary.p50_ttft * 1000, 2),
+                    "p90_ttft_ms": round(summary.p90_ttft * 1000, 2),
+                    "avg_ttft_ms": round(summary.avg_ttft * 1000, 2),
+                    "gen_tokens_per_sec": round(
+                        summary.avg_generation_throughput, 1
+                    ),
+                    "prompt_tokens_per_sec": round(
+                        summary.avg_prompt_throughput, 1
+                    ),
+                    "kv_hit_rate": (
+                        round(hits / queries, 4) if queries else None
+                    ),
+                    "completed": summary.completed,
+                    "failed": summary.failed,
+                    "elapsed_s": round(elapsed, 1),
+                    "ttft_breakdown_ms": scrape_hops(),
+                })
+            except Exception as e:  # noqa: BLE001 - record, keep other points
+                qa_err = f"{type(e).__name__}: {e}"
+        if qa_points:
+            # headline point: the highest-QPS run that completed cleanly,
+            # else the least-failing one (NOT the highest-qps failing run —
+            # a mostly-failed sweep point would flatter the headline)
+            clean = [p for p in qa_points if not p["failed"]]
+            head = (
+                max(clean, key=lambda p: p["qps"])
+                if clean
+                else min(qa_points, key=lambda p: p["failed"])
+            )
+            out.update({
+                "qa_p50_ttft_ms": head["p50_ttft_ms"],
+                "qa_p90_ttft_ms": head["p90_ttft_ms"],
+                "qa_tokens_per_sec_per_chip": head["gen_tokens_per_sec"],
+                "qa_kv_hit_rate": head["kv_hit_rate"],
+                "qa_qps": head["qps"],
+                "qa_users": users,
+                "qa_rounds": rounds,
+                "qa_answer_len": answer_len,
+                "qa_points": qa_points,
+            })
+        if qa_err:
+            out["qa_error"] = qa_err
+        return out
     except Exception as e:  # noqa: BLE001 - fail-soft by design
-        return {"http_stack_error": f"{type(e).__name__}: {e}"}
+        out["http_stack_error"] = f"{type(e).__name__}: {e}"
+        return out
     finally:
         # Graceful teardown so no "Task was destroyed but it is pending!"
         # noise lands near the final metric line: cleanup() both aiohttp

@@ -141,6 +141,7 @@ class UserSession:
         rec = RequestRecord(self.user_id, round_idx, launch_time=time.monotonic())
         self.records.append(rec)
         answer: list[str] = []
+        first_chunk = float("nan")  # first streamed chunk (any choice)
         try:
             async with session.post(
                 f"{self.base_url}/chat/completions",
@@ -168,11 +169,16 @@ class UserSession:
                         break
                     chunk = json.loads(payload)
                     for choice in chunk.get("choices", []):
+                        if first_chunk != first_chunk:  # nan check
+                            first_chunk = time.monotonic() - rec.launch_time
                         delta = (choice.get("delta") or {}).get("content") or choice.get(
                             "text"
                         )
                         if delta:
-                            if rec.ttft != rec.ttft:  # first token (nan check)
+                            # TTFT = first content delta (correct against
+                            # any OpenAI-compatible server, which may emit a
+                            # role-only chunk before generation)
+                            if rec.ttft != rec.ttft:
                                 rec.ttft = time.monotonic() - rec.launch_time
                             answer.append(delta)
                             rec.generation_tokens += 1
@@ -184,6 +190,11 @@ class UserSession:
                         )
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             rec.status = f"error: {type(e).__name__}"
+        if rec.ttft != rec.ttft and first_chunk == first_chunk:
+            # no content delta ever arrived (random-weight bench models emit
+            # held-back/empty deltas); fall back to the first streamed chunk,
+            # which the in-repo server defers to the first engine output
+            rec.ttft = first_chunk
         rec.finish_time = time.monotonic()
         self.messages.append({"role": "assistant", "content": "".join(answer) or "..."})
 

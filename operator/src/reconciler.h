@@ -14,13 +14,84 @@
 // the CR.
 #pragma once
 
+#include <sys/stat.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdlib>
+#include <fstream>
 #include <functional>
 #include <string>
+#include <vector>
 
 #include "json.h"
 #include "k8s.h"
 
 namespace op {
+
+// base64 decode (K8s Secret .data values); returns "" on malformed input
+inline std::string b64_decode(const std::string& in) {
+  static const char* tbl =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  int idx[256];
+  for (int i = 0; i < 256; i++) idx[i] = -1;
+  for (int i = 0; i < 64; i++) idx[static_cast<unsigned char>(tbl[i])] = i;
+  std::string out;
+  int val = 0, bits = -8;
+  for (unsigned char c : in) {
+    if (c == '=' || c == '\n' || c == '\r') continue;
+    if (idx[c] == -1) return "";
+    val = (val << 6) + idx[c];
+    bits += 6;
+    if (bits >= 0) {
+      out.push_back(static_cast<char>((val >> bits) & 0xFF));
+      bits -= 8;
+    }
+  }
+  return out;
+}
+
+// run argv without a shell (no quoting/injection surface); extra_env entries
+// are set only in the child, so secrets never appear in /proc/*/cmdline.
+// Returns exit code, -1 on spawn failure.
+inline int run_cmd(const std::vector<std::string>& argv,
+                   const std::vector<std::pair<std::string, std::string>>&
+                       extra_env = {}) {
+  std::vector<char*> cargv;
+  cargv.reserve(argv.size() + 1);
+  for (const auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
+  cargv.push_back(nullptr);
+  pid_t pid = fork();
+  if (pid < 0) return -1;
+  if (pid == 0) {
+    for (const auto& kv : extra_env)
+      setenv(kv.first.c_str(), kv.second.c_str(), 1);
+    execvp(cargv[0], cargv.data());
+    _exit(127);
+  }
+  int status = 0;
+  if (waitpid(pid, &status, 0) < 0) return -1;
+  return WIFEXITED(status) ? WEXITSTATUS(status) : -1;
+}
+
+inline bool dir_exists(const std::string& path) {
+  struct stat st{};
+  return stat(path.c_str(), &st) == 0 && S_ISDIR(st.st_mode);
+}
+
+inline bool mkdir_p(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i < path.size(); i++) {
+    cur.push_back(path[i]);
+    if (path[i] == '/' || i + 1 == path.size()) {
+      if (cur == "/" || cur.empty()) continue;
+      if (mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST) return false;
+    }
+  }
+  return true;
+}
 
 inline std::string spec_hash(const json::Value& v) {
   // FNV-1a over the canonical dump
@@ -465,42 +536,278 @@ class Reconciler {
     }
   }
 
-  // LoRA: POST load_lora_adapter to every ready pod matching the selector
-  // (reference loraadapter_controller.go:403-616, simplified placement: all
-  // matching pods).
-  void reconcile_lora(const json::Value& cr) {
-    const auto& spec = cr["spec"];
+  // LoraAdapter (reference loraadapter_controller.go:76-871): source
+  // discovery (local path / HuggingFace download to shared storage; s3
+  // matches the reference's own "not implemented"), ready-pod placement
+  // capped at deployment.replicas (:403-457), load on placed pods + unload
+  // from pods that should no longer hold the adapter (:855-870), and a
+  // finalizer that unloads everywhere before the CR goes away (:586-616).
+  static constexpr const char* kLoraFinalizer =
+      "production-stack.tpu.ai/lora-finalizer";
+
+  static bool pod_ready(const json::Value& pod) {
+    // the reference checks conditions[type==Ready] (:417-423); engines also
+    // surface containerStatuses[].ready — accept either signal
+    for (const auto& c : pod.at_path("status.conditions").as_array())
+      if (c["type"].as_string() == "Ready")
+        return c["status"].as_string() == "True";
+    for (const auto& c : pod.at_path("status.containerStatuses").as_array())
+      if (c["ready"].as_bool()) return true;
+    return false;
+  }
+
+  static std::string lora_name_of(const json::Value& cr) {
+    const std::string n = cr.at_path("spec.source.adapterName").as_string();
+    return n.empty() ? cr.at_path("metadata.name").as_string() : n;
+  }
+
+  // POST load/unload to one pod; true on HTTP 200
+  bool lora_post(const json::Value& pod, const json::Value& spec,
+                 const std::string& path, const json::Value& body) {
+    const std::string ip = pod.at_path("status.podIP").as_string();
+    if (ip.empty()) return false;
+    int port = static_cast<int>(spec["enginePort"].as_int(8100));
+    try {
+      return k8s::Client::post_url(ip, port, path, body.dump()) == 200;
+    } catch (const std::exception&) {
+      return false;
+    }
+  }
+
+  json::Value list_lora_pods(const json::Value& spec) {
     const std::string selector =
         spec["podLabelSelector"].as_string().empty()
             ? "model=" + spec.at_path("baseModel").as_string()
             : spec["podLabelSelector"].as_string();
-    auto pods = kc_.list("", "v1", ns_, "pods", selector);
-    json::Value body;
-    body.set("lora_name", cr.at_path("metadata.name").as_string());
-    body.set("lora_path", spec.at_path("source.path").as_string());
-    json::Array loaded;
-    for (const auto& pod : pods["items"].as_array()) {
-      const std::string ip = pod.at_path("status.podIP").as_string();
-      if (ip.empty()) continue;
-      int port = static_cast<int>(spec["enginePort"].as_int(8100));
-      try {
-        int code =
-            k8s::Client::post_url(ip, port, "/v1/load_lora_adapter", body.dump());
-        if (code == 200)
-          loaded.push_back(pod.at_path("metadata.name").as_string());
-      } catch (const std::exception&) {
-      }
+    return kc_.list("", "v1", ns_, "pods", selector);
+  }
+
+  // resolve the adapter weights path, downloading remote sources to shared
+  // storage first (reference discoverAdapter :311-334 + HF download :337-402)
+  std::string discover_lora(const json::Value& cr, std::string& err) {
+    const auto& src = cr.at_path("spec.source");
+    std::string type = src["type"].as_string().empty()
+                           ? "local"
+                           : src["type"].as_string();
+    std::string path = src["path"].as_string();
+    if (!path.empty() && (type == "local" || dir_exists(path))) return path;
+    if (type == "local") {
+      err = "local adapter source requires source.path";
+      return "";
     }
+    if (type == "s3") {
+      // parity: the reference returns the same error (:324-325)
+      err = "S3 adapter discovery not implemented yet";
+      return "";
+    }
+    const char* root = std::getenv("PSTPU_LORA_STORAGE");
+    std::string dest =
+        std::string(root ? root : "/data/shared-pvc-storage/lora-adapters") +
+        "/" + lora_name_of(cr);
+    if (type == "http") {
+      // plain-http single-artifact fetch via the operator's own client (the
+      // zero-dependency analogue; the reference leaves http unimplemented)
+      const std::string url = src["repository"].as_string();
+      if (url.rfind("http://", 0) != 0) {
+        err = "http adapter source requires a plain http:// repository URL";
+        return "";
+      }
+      std::string rest = url.substr(7);
+      size_t slash = rest.find('/');
+      std::string hostport = rest.substr(0, slash);
+      std::string upath = slash == std::string::npos ? "/" : rest.substr(slash);
+      size_t colon = hostport.find(':');
+      std::string host = hostport.substr(0, colon);
+      int port = colon == std::string::npos
+                     ? 80
+                     : std::atoi(hostport.c_str() + colon + 1);
+      if (!mkdir_p(dest)) {
+        err = "cannot create " + dest;
+        return "";
+      }
+      try {
+        http::Client hc(host, port, 60);
+        auto r = hc.request("GET", upath);
+        if (r.status != 200) {
+          err = "http download failed: " + std::to_string(r.status);
+          return "";
+        }
+        size_t base = upath.find_last_of('/');
+        std::string fname = upath.substr(base + 1);
+        std::ofstream f(dest + "/" + (fname.empty() ? "adapter.bin" : fname),
+                        std::ios::binary);
+        f.write(r.body.data(), static_cast<std::streamsize>(r.body.size()));
+      } catch (const std::exception& e) {
+        err = std::string("http download failed: ") + e.what();
+        return "";
+      }
+      persist_lora_path(cr, dest);
+      return dest;
+    }
+    if (type == "huggingface") {
+      if (dir_exists(dest)) return dest;  // already downloaded (:346-357)
+      const std::string repo = src["repository"].as_string();
+      if (repo.empty()) {
+        err = "repository is required for huggingface adapter source";
+        return "";
+      }
+      std::vector<std::string> cmd = {"huggingface-cli", "download", repo,
+                                      "--local-dir", dest};
+      // the token travels via the child's environment (HF_TOKEN, which
+      // huggingface-cli honors) — argv is world-readable in /proc
+      std::vector<std::pair<std::string, std::string>> env;
+      const auto& sref = src["credentialsSecretRef"];
+      if (!sref["name"].as_string().empty()) {
+        try {
+          auto secret =
+              kc_.get("", "v1", ns_, "secrets", sref["name"].as_string());
+          if (secret) {
+            std::string tok = b64_decode(
+                (*secret)["data"][sref["key"].as_string()].as_string());
+            if (tok.empty()) {
+              err = "secret does not contain key " + sref["key"].as_string();
+              return "";
+            }
+            env.emplace_back("HF_TOKEN", tok);
+          }
+        } catch (const std::exception& e) {
+          err = std::string("failed to get secret: ") + e.what();
+          return "";
+        }
+      }
+      if (!mkdir_p(dest)) {
+        err = "cannot create " + dest;
+        return "";
+      }
+      if (run_cmd(cmd, env) != 0) {
+        err = "huggingface-cli download failed for " + repo;
+        return "";
+      }
+      persist_lora_path(cr, dest);  // reference updates spec (:394-397)
+      return dest;
+    }
+    err = "unsupported adapter source type: " + type;
+    return "";
+  }
+
+  void persist_lora_path(const json::Value& cr, const std::string& dest) {
+    json::Value crcopy = cr;
+    crcopy.as_object_mut()["spec"].as_object_mut()["source"].set("path", dest);
+    try {
+      kc_.update(k8s::kGroup, k8s::kVersion, ns_, "loraadapters",
+                 cr.at_path("metadata.name").as_string(), crcopy);
+    } catch (const std::exception&) {
+    }
+  }
+
+  void set_lora_status(const json::Value& cr, const std::string& phase,
+                       json::Array loaded, const std::string& path,
+                       const std::string& message) {
     json::Value crcopy = cr;
     json::Value status;
-    status.set("loadedPods", loaded);
-    status.set("phase", loaded.empty() ? "Pending" : "Loaded");
+    status.set("loadedPods", std::move(loaded));
+    status.set("phase", phase);
+    if (!path.empty()) status.set("adapterPath", path);
+    if (!message.empty()) status.set("message", message);
     crcopy.set("status", status);
     try {
       kc_.update_status(k8s::kGroup, k8s::kVersion, ns_, "loraadapters",
                         cr.at_path("metadata.name").as_string(), crcopy);
     } catch (const std::exception&) {
     }
+  }
+
+  void reconcile_lora(const json::Value& cr) {
+    const auto& spec = cr["spec"];
+    const std::string cr_name = cr.at_path("metadata.name").as_string();
+    const std::string adapter = lora_name_of(cr);
+
+    // deletion: unload everywhere the status says we loaded, then clear the
+    // finalizer so the apiserver completes the delete (:586-616, :872)
+    if (!cr.at_path("metadata.deletionTimestamp").as_string().empty()) {
+      json::Value body;
+      body.set("lora_name", adapter);
+      auto pods = list_lora_pods(spec);
+      for (const auto& pod : pods["items"].as_array()) {
+        bool was_loaded = false;
+        for (const auto& lp : cr.at_path("status.loadedPods").as_array())
+          if (lp.as_string() == pod.at_path("metadata.name").as_string())
+            was_loaded = true;
+        if (was_loaded)
+          lora_post(pod, spec, "/v1/unload_lora_adapter", body);
+      }
+      json::Value crcopy = cr;
+      json::Array keep;
+      for (const auto& f : cr.at_path("metadata.finalizers").as_array())
+        if (f.as_string() != kLoraFinalizer) keep.push_back(f);
+      crcopy.as_object_mut()["metadata"].set("finalizers", std::move(keep));
+      kc_.update(k8s::kGroup, k8s::kVersion, ns_, "loraadapters", cr_name,
+                 crcopy);
+      return;
+    }
+
+    // ensure our finalizer before any pod holds the adapter
+    bool has_fin = false;
+    for (const auto& f : cr.at_path("metadata.finalizers").as_array())
+      if (f.as_string() == kLoraFinalizer) has_fin = true;
+    json::Value live = cr;
+    if (!has_fin) {
+      json::Array fins = cr.at_path("metadata.finalizers").as_array();
+      fins.push_back(json::Value(kLoraFinalizer));
+      live.as_object_mut()["metadata"].set("finalizers", std::move(fins));
+      live = kc_.update(k8s::kGroup, k8s::kVersion, ns_, "loraadapters",
+                        cr_name, live);
+    }
+
+    std::string err;
+    const std::string path = discover_lora(live, err);
+    if (path.empty()) {
+      set_lora_status(live, "Error", {}, "", err);
+      return;
+    }
+
+    // placement: ready pods, name-ordered for determinism, capped at
+    // deployment.replicas when set (:403-457; the reference's "default"
+    // algorithm takes the first N valid pods)
+    auto pods = list_lora_pods(spec);
+    std::vector<json::Value> ready;
+    for (const auto& pod : pods["items"].as_array())
+      if (pod_ready(pod)) ready.push_back(pod);
+    std::sort(ready.begin(), ready.end(),
+              [](const json::Value& a, const json::Value& b) {
+                return a.at_path("metadata.name").as_string() <
+                       b.at_path("metadata.name").as_string();
+              });
+    size_t want = ready.size();
+    if (spec.at_path("deployment.replicas").is_number())
+      want = std::min<size_t>(
+          want,
+          static_cast<size_t>(spec.at_path("deployment.replicas").as_int(0)));
+
+    json::Value body;
+    body.set("lora_name", adapter);
+    body.set("lora_path", path);
+    json::Array loaded;
+    for (size_t i = 0; i < want; i++)
+      if (lora_post(ready[i], spec, "/v1/load_lora_adapter", body))
+        loaded.push_back(ready[i].at_path("metadata.name").as_string());
+
+    // unload from pods that previously held the adapter but fell out of the
+    // placement (:855-870)
+    json::Value unload_body;
+    unload_body.set("lora_name", adapter);
+    for (const auto& lp : cr.at_path("status.loadedPods").as_array()) {
+      bool still = false;
+      for (const auto& l : loaded)
+        if (l.as_string() == lp.as_string()) still = true;
+      if (still) continue;
+      for (const auto& pod : pods["items"].as_array())
+        if (pod.at_path("metadata.name").as_string() == lp.as_string())
+          lora_post(pod, spec, "/v1/unload_lora_adapter", unload_body);
+    }
+
+    const std::string phase = loaded.empty() ? "Pending" : "Loaded";
+    set_lora_status(live, phase, std::move(loaded), path, "");
   }
 
   k8s::Client& kc_;

@@ -129,7 +129,9 @@ def _completion_lp(tok, token_ids, entries, offset0):
     )
 
 
-def _sampling_params(body: dict, default_max: int = 256) -> SamplingParams:
+def _sampling_params(
+    body: dict, default_max: int = 256, vocab_size: "Optional[int]" = None
+) -> SamplingParams:
     stop = body.get("stop") or []
     if isinstance(stop, str):
         stop = [stop]
@@ -145,11 +147,11 @@ def _sampling_params(body: dict, default_max: int = 256) -> SamplingParams:
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         repetition_penalty=float(body.get("repetition_penalty", 1.0)),
-        logit_bias=_parse_logit_bias(body.get("logit_bias")),
+        logit_bias=_parse_logit_bias(body.get("logit_bias"), vocab_size),
     )
 
 
-def _parse_logit_bias(raw) -> "Optional[dict]":
+def _parse_logit_bias(raw, vocab_size: "Optional[int]" = None) -> "Optional[dict]":
     """OpenAI logit_bias: {"<token_id>": bias in [-100, 100]}, <= 300 keys."""
     if not raw:
         return None
@@ -163,6 +165,12 @@ def _parse_logit_bias(raw) -> "Optional[dict]":
             raise ValueError(f"invalid logit_bias entry {k!r}: {v!r}") from None
         if tid < 0:
             raise ValueError(f"logit_bias token id {tid} is negative")
+        if vocab_size is not None and tid >= vocab_size:
+            # OpenAI rejects out-of-vocab keys with a 400; silently dropping
+            # them on device (scatter mode='drop') would hide client bugs
+            raise ValueError(
+                f"logit_bias token id {tid} out of range for vocab size {vocab_size}"
+            )
         if not -100.0 <= bv <= 100.0:
             raise ValueError(f"logit_bias value {bv} outside [-100, 100]")
         out[tid] = bv
@@ -179,6 +187,12 @@ def _usage(out) -> dict:
 
 
 class EngineServer:
+    def _vocab_size(self) -> "Optional[int]":
+        """Model vocab size for request validation, when the engine knows it
+        (fake/test engines may not carry a model config)."""
+        model_cfg = getattr(self.engine, "model_cfg", None)
+        return getattr(model_cfg, "vocab_size", None)
+
     def __init__(self, cfg: EngineConfig, engine: Optional[LLMEngine] = None):
         self.cfg = cfg
         self.engine = engine or LLMEngine(cfg)
@@ -272,15 +286,35 @@ class EngineServer:
         emit("decode_dispatches_total", "counter", s["decode_dispatches_total"])
         emit("decode_chained_dispatches_total", "counter",
              s["decode_chained_dispatches_total"])
-        for k in sorted(s):  # kv offload / transfer / spec metrics, when wired
-            if k.startswith(("kv_", "spec_decode_")):
+        for k in sorted(s):  # kv offload / transfer / spec / loop metrics
+            if k.startswith(("kv_", "spec_decode_", "engine_loop_")):
                 kind = "counter" if k.endswith("_total") else "gauge"
                 emit(k, kind, s[k])
         # TTFT hop breakdown for streaming requests (accept->submit->first
         # token->first SSE write), p50/p99 over the sample window. ONE TYPE
         # line per metric name — a duplicate would fail the whole Prometheus
         # scrape
-        for hop, qs in _ttft_hop_quantiles().items():
+        hops = _ttft_hop_quantiles()
+        # engine-side admission wait (arrival -> first prefill dispatch):
+        # the slice of submit_to_first_token a chained decode dispatch can
+        # inflate; exposed so the bench can prove the adaptive chain cap
+        waits = getattr(self.engine, "admission_wait_ms", None)
+        if waits:
+            # the engine thread appends concurrently; iterating a mutating
+            # deque raises RuntimeError — snapshot with a bounded retry
+            s_w = None
+            for _ in range(3):
+                try:
+                    s_w = sorted(waits)
+                    break
+                except RuntimeError:
+                    continue
+            if s_w:
+                hops["admission_wait"] = {
+                    "p50": s_w[len(s_w) // 2],
+                    "p99": s_w[min(len(s_w) - 1, int(len(s_w) * 0.99))],
+                }
+        for hop, qs in hops.items():
             lines.append(f"# TYPE vllm:ttft_hop_{hop}_ms gauge")
             for q, v in qs.items():
                 lines.append(
@@ -288,6 +322,17 @@ class EngineServer:
                     f"{round(v, 3)}"
                 )
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def metrics_reset(self, request: web.Request) -> web.Response:
+        """Clear the TTFT hop sample windows (debug/bench endpoint): per-phase
+        quantiles require each phase to start from an empty window, else the
+        gauges pool samples from differently-loaded phases. Counters and
+        serving stats are untouched."""
+        _ttft_hops.clear()
+        waits = getattr(self.engine, "admission_wait_ms", None)
+        if waits is not None:
+            waits.clear()
+        return web.json_response({"status": "ok"})
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -387,7 +432,7 @@ class EngineServer:
                 )
         req_id = request.headers.get("X-Request-Id") or f"req-{uuid.uuid4().hex[:16]}"
         try:
-            params = _sampling_params(body)
+            params = _sampling_params(body, vocab_size=self._vocab_size())
         except (ValueError, TypeError) as e:
             return web.json_response(
                 {"error": {"message": f"invalid request: {e}"}}, status=400
@@ -957,6 +1002,7 @@ class EngineServer:
         r.add_get("/version", self.version)
         r.add_get("/v1/models", self.models)
         r.add_get("/metrics", self.metrics)
+        r.add_post("/metrics/reset", self.metrics_reset)
         r.add_post("/tokenize", self.tokenize)
         r.add_post("/detokenize", self.detokenize)
         r.add_post("/v1/chat/completions", self.chat_completions)
@@ -1016,17 +1062,13 @@ def _init_multihost(cfg: EngineConfig) -> int:
     # resulting set_lora_slot/clear_lora_slot device writes are REPLICATED
     # dispatches — followers receive the weights over the step stream, so
     # adapters need no shared filesystem.
-    # Disaggregated prefill works multi-host on the TCP path: the producer's
-    # page fetches (get_page) and the consumer's restores (set_page) are
-    # REPLICATED SPMD dispatches, while the TCP sender/receiver and staging
-    # are leader-only (followers get kv_role stripped in serve()). The
-    # device-to-device channel is single-host-pair only for now: its
-    # transfer-service pulls address one process's buffers.
-    if cfg.kv_role != "none" and cfg.kv_transfer_device:
-        raise ValueError(
-            "--kv-transfer-device is not supported in multi-host mode; "
-            "the TCP KV transfer path works (omit the flag)"
-        )
+    # Disaggregated prefill works multi-host on BOTH paths: the TCP path's
+    # page fetches (get_page) and restores (set_page) are REPLICATED SPMD
+    # dispatches with the sender/receiver leader-only; the device-to-device
+    # path runs a transfer endpoint per process (runner.kv_endpoint_start,
+    # armed by engine.enable_multihost_device_kv after the broadcaster is
+    # wired) so pages move shard-cluster to shard-cluster over DCN with no
+    # host serde — the NIXL GPU-direct analogue.
     pid = _resolve_process_id(cfg)
     logger.info(
         "multi-host init: process %d/%d, coordinator %s",
@@ -1088,6 +1130,11 @@ async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
             # must go through the broadcaster or followers desync on the
             # SPMD page-gather program
             engine._offload.runner = engine.runner
+        if cfg.kv_role != "none" and cfg.kv_transfer_device:
+            # device-to-device KV across hosts: per-process endpoints +
+            # replicated offer/pull/restore dispatches (must come after the
+            # BroadcastingRunner wrap so followers mirror every step)
+            engine.enable_multihost_device_kv()
     server = EngineServer(cfg, engine)
     server.engine.start()
     app = server.build_app()

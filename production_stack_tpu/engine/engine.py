@@ -143,10 +143,26 @@ class LLMEngine:
                 raise ValueError("kv_role=producer requires --kv-peer-url")
             from production_stack_tpu.kvoffload.transfer import KVTransferSender
 
-            endpoint = self._make_device_endpoint(cfg)
-            self._kv_sender = KVTransferSender(
-                cfg.kv_peer_url, device_endpoint=endpoint
-            )
+            self._kv_sender = KVTransferSender(cfg.kv_peer_url)
+            if cfg.kv_transfer_device and cfg.distributed_num_processes <= 1:
+                # single-host producer: same assignment protocol as the
+                # multi-host path with P=1 — one endpoint, direct offers
+                # (multi-host arming happens in serve() after the
+                # BroadcastingRunner wrap: enable_multihost_device_kv)
+                try:
+                    self.runner.kv_endpoint_host = cfg.kv_transfer_device_host
+                    self.runner.kv_endpoint_start()
+                    self._kv_sender.enable_multihost(
+                        [self.runner.kv_endpoint.address],
+                        lambda pid, base, pullers: self.runner.kv_offer_page(
+                            pid, base, pullers
+                        ),
+                    )
+                except Exception as e:  # noqa: BLE001 - platform w/o transfer svc
+                    logger.warning(
+                        "device kv transfer unavailable (%s); using TCP blobs",
+                        e,
+                    )
         elif cfg.kv_role == "consumer":
             from production_stack_tpu.kvoffload.transfer import (
                 DeviceStaging,
@@ -198,6 +214,38 @@ class LLMEngine:
         self.spec_draft_tokens = 0     # drafts proposed (rounds * spec_k)
         self.spec_accepted_tokens = 0  # drafts the target accepted
         self.num_preemptions = 0
+        # admission instrumentation: arrival -> first prefill dispatch, in ms
+        # (the piece of TTFT a chained decode dispatch can inflate — an
+        # arrival mid-chain waits for the whole chain before its prefill).
+        # /metrics exposes p50/p99 as the ttft_hop_admission_wait gauge.
+        import collections
+
+        self.admission_wait_ms: collections.deque = collections.deque(maxlen=2048)
+        # recent arrival timestamps, feeding the adaptive chain-depth bound
+        # (scheduler.arrival_rate): chaining pays off only on a quiescent
+        # batch, so expected arrivals during a chain cap its depth
+        self._arrival_times: collections.deque = collections.deque(maxlen=64)
+        # per-burst wall-time EMA feeding the same bound; seeded at a
+        # typical network-attached-chip burst cost until measured
+        self._burst_seconds = 0.05
+        # engine-loop section time accounting (seconds, cumulative), scraped
+        # via /metrics: attributes serving-loop overhead between the device
+        # program (step = stage+dispatch+fetch) and the host-side bookkeeping
+        # (apply = scheduler state, emit = detokenize+queue put)
+        self.loop_seconds = {
+            "wait": 0.0, "schedule": 0.0, "step": 0.0, "apply": 0.0,
+            "emit": 0.0, "chain_dispatch": 0.0, "chain_fetch": 0.0,
+        }
+
+    def _recent_arrival_rate(self, window: float = 1.0) -> float:
+        """Arrivals/sec over the trailing ``window`` seconds."""
+        now = time.monotonic()
+        n = 0
+        for t in reversed(self._arrival_times):
+            if now - t > window:
+                break
+            n += 1
+        return n / window
 
 
     def _make_device_endpoint(self, cfg: EngineConfig):
@@ -205,6 +253,11 @@ class LLMEngine:
         TCP blob path serves everything when the transfer service cannot
         start on this platform)."""
         if not cfg.kv_transfer_device:
+            return None
+        if cfg.distributed_num_processes > 1:
+            # multi-host: endpoints are per-process and REPLICATED through
+            # the step stream (runner.kv_endpoint_start); serve() arms them
+            # via enable_multihost_device_kv after the broadcaster is wired
             return None
         from production_stack_tpu.kvoffload.transfer import DeviceKVEndpoint
 
@@ -275,14 +328,99 @@ class LLMEngine:
             self._offload.stop()
         if self._kv_sender is not None:
             self._kv_sender.close()
-            if self._kv_sender.device_endpoint is not None:
-                self._kv_sender.device_endpoint.close()
+        ep = getattr(self.runner, "kv_endpoint", None)
+        if ep is not None:
+            ep.close()
         if self._kv_receiver is not None:
             self._kv_receiver.stop()
             if self._kv_receiver.device_endpoint is not None:
                 self._kv_receiver.device_endpoint.close()
             if self._kv_receiver.staging is not None:
                 self._kv_receiver.staging.clear()
+
+    def _run_on_device_thread(self, fn, timeout: float = 120.0):
+        """Run ``fn`` on the engine device thread (serialized with steps via
+        the device_cmd inbox) and return its result. Replicated runner
+        dispatches MUST go through here from any other thread, or the
+        leader's local dispatch order could diverge from the broadcast
+        order the followers replay.
+
+        Re-entrant: called ON the device thread (e.g. a staging-TTL expiry
+        firing inside a prefix-cache probe during scheduling) it runs ``fn``
+        directly — queueing would deadlock waiting on ourselves."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        done = threading.Event()
+        box: dict = {}
+
+        def run():
+            try:
+                box["r"] = fn()
+            except Exception as e:  # noqa: BLE001 - re-raised on the caller
+                box["e"] = e
+            finally:
+                done.set()
+
+        self._inbox.put(("device_cmd", run))
+        if not done.wait(timeout):
+            raise TimeoutError("device thread did not service the command")
+        if "e" in box:
+            raise box["e"]
+        return box.get("r")
+
+    def enable_multihost_device_kv(self) -> None:
+        """Arm the multi-host device-to-device KV path (called by serve() on
+        the leader AFTER the BroadcastingRunner wrap): every process starts a
+        transfer endpoint (replicated kv_endpoint_start, addresses exchanged
+        through the JAX coordination KV store), the producer's sender learns
+        the per-process addresses, and the consumer's receiver gets the
+        replicated pull/unstage dispatchers. KV pages then move
+        device->device over DCN between the prefill and decode clusters —
+        the reference's NIXL GPU-direct analogue
+        (deployment-vllm-multi.yaml:256-296) — with TCP blobs as the
+        per-page fallback."""
+        self.runner.kv_endpoint_start()  # replicated -> all processes
+        n = self.cfg.distributed_num_processes
+        if self._kv_sender is not None:
+            from jax._src import distributed as jdist
+
+            client = jdist.global_state.client
+            addrs = [
+                client.blocking_key_value_get(f"pstpu/kv_ep/{i}", 300_000)
+                for i in range(n)
+            ]
+            self._kv_sender.enable_multihost(
+                addrs,
+                lambda pid, base, pullers: self.runner.kv_offer_page(
+                    pid, base, pullers
+                ),
+            )
+        if self._kv_receiver is not None:
+            from production_stack_tpu.kvoffload.transfer import DeviceStaging
+
+            staging = DeviceStaging(
+                self.cfg.kv_transfer_stage_mb << 20,
+                on_expire=self._mh_unstage,
+            )
+            if self._offload is not None:
+                self._offload.device_staging = staging
+            self._kv_receiver.staging = staging
+            self._kv_receiver.procs = n
+            self._kv_receiver.pull_fn = self._mh_pull
+            self._kv_receiver.unstage_fn = self._mh_unstage
+
+    def _mh_pull(self, assignments, shape, dtype, key: str) -> int:
+        return int(self._run_on_device_thread(
+            lambda: self.runner.kv_pull_page(assignments, shape, dtype, key)
+        ) or 0)
+
+    def _mh_unstage(self, key: str) -> None:
+        try:
+            self._run_on_device_thread(
+                lambda: self.runner.kv_unstage_page(key)
+            )
+        except Exception:  # noqa: BLE001 - cleanup is best-effort
+            logger.exception("multi-host kv unstage(%s) failed", key)
 
     # -- request api (asyncio side) -----------------------------------------
 
@@ -362,6 +500,7 @@ class LLMEngine:
                 self._inbox_accept(item)
 
     def _inbox_accept(self, seq: Sequence) -> None:
+        self._arrival_times.append(time.monotonic())
         if self._sleeping:
             # a request can pass generate()'s sleeping check on the event loop
             # just as sleep flips the flag on the device thread; it must be
@@ -378,12 +517,30 @@ class LLMEngine:
                 time.sleep(0.05)
                 self._drain_inbox(block=False)
                 continue
+            t_sec = time.perf_counter()
             self._drain_inbox(block=not self.scheduler.has_work())
+            # adaptive chain depth inputs: the scheduler caps chained bursts
+            # so the expected number of arrivals stuck waiting behind a chain
+            # stays below ~half a request (scheduler.schedule)
+            self.scheduler.arrival_rate = self._recent_arrival_rate()
+            self.scheduler.burst_seconds = self._burst_seconds
+            t0 = time.perf_counter()
+            self.loop_seconds["wait"] += t0 - t_sec
             batch = self.scheduler.schedule()
+            self.loop_seconds["schedule"] += time.perf_counter() - t0
             if batch is None:
                 continue
+            if batch.kind == "prefill":
+                now = time.monotonic()
+                for s in batch.seqs:
+                    if s.first_dispatch_time is None:
+                        s.first_dispatch_time = now
+                        self.admission_wait_ms.append(
+                            (now - s.arrival_time) * 1000
+                        )
             fetched = True
             lp_data = None  # (chosen [B, cols], top_ids, top_lp [B, cols, K])
+            t_step = time.perf_counter()
             try:
                 inp = StepInput(
                     batch.input_ids, batch.positions, batch.page_table,
@@ -413,9 +570,9 @@ class LLMEngine:
                 # only the FINISH would feed a sampled EOS back into the
                 # context and derail the continuation). Conservative within
                 # a dispatch: the ban holds for ALL the tokens one dispatch
-                # covers (bursts * decode_steps - 1 past the floor in the
-                # worst chained case — the seam forwards the same bias), so
-                # EOS resumes at the next scheduling decision; the
+                # covers, and the scheduler caps chaining for rows near the
+                # floor (scheduler.schedule), so the overshoot stays
+                # < decode_steps regardless of pipeline depth; the
                 # scheduler's finish gate stays as the exact backstop.
                 eos = self.tokenizer.eos_token_id
                 def _eos_ban(s):
@@ -469,6 +626,7 @@ class LLMEngine:
                     self.decode_dispatches_total += 1
                     if batch.bursts > 1:
                         self.decode_chained_dispatches_total += 1
+                        t_chain = time.perf_counter()
                         # chained bursts: all dispatches go out before any
                         # fetch, so the chain costs bursts*compute + 1 round
                         # trip. Fetch EVERY burst before applying any — apply
@@ -478,6 +636,8 @@ class LLMEngine:
                         devs = self.runner.step_multi_pipelined(
                             inp, self.scheduler.decode_steps, batch.bursts, wlp
                         )
+                        t_disp = time.perf_counter()
+                        self.loop_seconds["chain_dispatch"] += t_disp - t_chain
                         # concatenate ON DEVICE and fetch once: each
                         # np.asarray is a full host<->device round trip
                         # (~100 ms on a network-attached chip), so per-burst
@@ -501,6 +661,16 @@ class LLMEngine:
                             tokens = np.asarray(
                                 jnp.concatenate(devs, axis=1)
                             )  # [B, bursts*k]
+                        self.loop_seconds["chain_fetch"] += (
+                            time.perf_counter() - t_disp
+                        )
+                        # per-burst wall time EMA (includes the fetch RTT
+                        # amortized over the chain — a mild overestimate,
+                        # which errs toward shorter chains / better TTFT)
+                        dt = (time.perf_counter() - t_chain) / batch.bursts
+                        self._burst_seconds = (
+                            0.7 * self._burst_seconds + 0.3 * dt
+                        )
                     elif wlp:
                         toks, lps = self.runner.step_multi(
                             inp, self.scheduler.decode_steps, True
@@ -559,6 +729,8 @@ class LLMEngine:
                         self.scheduler._finish(s, "error")
                         self._emit(s, "", error=True)
                 continue
+            t_apply = time.perf_counter()
+            self.loop_seconds["step"] += t_apply - t_step
             if fetched:
                 self._unfetched.clear()  # a real fetch retires prior dispatches
             events = self.scheduler.apply_step(
@@ -575,6 +747,8 @@ class LLMEngine:
                     if s.finished and s.seq_id not in pushed:
                         pushed.add(s.seq_id)
                         self._push_finished_kv(s)
+            t_emit = time.perf_counter()
+            self.loop_seconds["apply"] += t_emit - t_apply
             # group burst events per sequence: one RequestOutput per seq per
             # device step, carrying every new token (finished only on the
             # last, so consumers never drop trailing burst tokens)
@@ -592,6 +766,7 @@ class LLMEngine:
             for s, toks, lps in grouped.values():
                 self.total_generation_tokens += len(toks)
                 self._process_token(s, toks, lps or None)
+            self.loop_seconds["emit"] += time.perf_counter() - t_emit
         logger.info("engine loop exited")
 
     def _push_finished_kv(self, seq: Sequence) -> None:
@@ -607,19 +782,17 @@ class LLMEngine:
             if pid is None:
                 continue
             key = h.hex()
-            if self._kv_sender.device_endpoint is not None:
-                # device->device: nbytes from pool metadata only; the
-                # single-device gather (ICI; pools may be tp-sharded) runs
-                # inside push_device AFTER the consumer accepts — refusals
-                # cost no device work
+            if self._kv_sender._mh_addrs is not None:
+                # device path (assignment protocol, single- or multi-host):
+                # REPLICATED offer on every producer process, one pull
+                # assignment per consumer process; nbytes from pool metadata
+                # only — the page gather runs inside kv_offer_page AFTER the
+                # consumer accepts, so refusals cost no device work. A
+                # refused/failed page falls through to the TCP blob push.
                 kp = self.runner.k_pages
                 page_nbytes = 2 * (kp.nbytes // kp.shape[1])
-                if self._kv_sender.push_device(
-                    key, page_nbytes,
-                    lambda pid=pid: self.runner.get_page_device(pid),
-                ):
+                if self._kv_sender.push_device_multihost(key, page_nbytes, pid):
                     continue
-                # refused (staging full / pull failed): TCP blob fallback
             blob = None
             if self._offload is not None:
                 blob = self._offload.store.get(key)
@@ -934,6 +1107,8 @@ class LLMEngine:
             "decode_dispatches_total": self.decode_dispatches_total,
             "decode_chained_dispatches_total": self.decode_chained_dispatches_total,
         }
+        for section, secs in self.loop_seconds.items():
+            out[f"engine_loop_{section}_seconds_total"] = round(secs, 3)
         if self.cfg.speculative_k:
             # read accepted before drafts: the engine thread increments drafts
             # first, so this order keeps any unsynchronized snapshot at
@@ -948,9 +1123,15 @@ class LLMEngine:
         if self._kv_sender is not None:
             out["kv_transfer_sent_chunks_total"] = self._kv_sender.sent_chunks
             out["kv_transfer_sent_bytes_total"] = self._kv_sender.sent_bytes
+            out["kv_transfer_device_pages_total"] = self._kv_sender.device_pages
         if self._kv_receiver is not None:
             out["kv_transfer_received_chunks_total"] = self._kv_receiver.received_chunks
             out["kv_transfer_received_bytes_total"] = self._kv_receiver.received_bytes
+            out["kv_transfer_device_pages_total"] = self._kv_receiver.device_pages
+        if self._offload is not None and self._offload.device_staging is not None:
+            out["kv_offload_device_loaded_pages_total"] = (
+                self._offload.device_loaded_pages
+            )
         if self._offload is not None:
             o = self._offload.stats()
             out["kv_offload_hit_pages_total"] = self.kv.offload_hits

@@ -564,6 +564,165 @@ class ModelRunner:
             self.k_pages, self.v_pages, jnp.int32(pid), k, v,
         )
 
+    # -- multi-host device-to-device KV (disaggregated prefill over DCN) ------
+    # Every method here is REPLICATED (distributed.py): the leader broadcasts
+    # it over the step stream and each process acts on ITS shard/copy, so KV
+    # bytes move device->device over the XLA transfer service — never through
+    # the host or the (host-byte) step stream. Reference analogue: NIXL
+    # GPU-direct between prefill and decode pods
+    # (/root/reference helm/templates/deployment-vllm-multi.yaml:256-296).
+
+    def _local_mesh_devices(self) -> list:
+        return [
+            d for d in self.mesh.devices.flat
+            if d.process_index == jax.process_index()
+        ]
+
+    def _replicate_page(self, pid: int):
+        """SPMD program laying one page out fully-replicated (the all-gather
+        rides ICI/DCN); every process ends up with the whole page on each of
+        its local devices."""
+        if self._get_page_fn is None:
+            rep = NamedSharding(self.mesh, P())
+            self._get_page_fn = jax.jit(
+                lambda kp, vp, i: (kp[:, i], vp[:, i]),
+                out_shardings=(rep, rep),
+            )
+        return self._get_page_fn(self.k_pages, self.v_pages, jnp.int32(pid))
+
+    def kv_endpoint_start(self) -> None:
+        """Start this process's transfer-service endpoint and publish its
+        address through the JAX coordination KV store (the same trust domain
+        as the step-sync secret, distributed.py:resolve_sync_secret)."""
+        if getattr(self, "kv_endpoint", None) is not None:
+            return
+        from production_stack_tpu.kvoffload.transfer import DeviceKVEndpoint
+
+        # bind/advertise host is per-process (each pod has its own IP):
+        # PSTPU_KV_EP_HOST is set per pod (fieldRef status.podIP in the
+        # helm chart); loopback covers single-machine tests
+        import os as os_mod
+
+        host = (
+            os_mod.environ.get("PSTPU_KV_EP_HOST")
+            or getattr(self, "kv_endpoint_host", None)
+            or "127.0.0.1"
+        )
+        self.kv_endpoint = DeviceKVEndpoint(self, host=host)
+        self.kv_staged: dict[str, tuple] = {}
+        try:
+            from jax._src import distributed as jdist
+
+            client = jdist.global_state.client
+            if client is not None:
+                client.key_value_set(
+                    f"pstpu/kv_ep/{jax.process_index()}",
+                    self.kv_endpoint.address,
+                )
+        except Exception:  # noqa: BLE001 - single-process: no coordination svc
+            pass
+
+    def kv_offer_page(self, pid: int, uuid_base: int, pullers: int) -> tuple:
+        """Replicate one page, then offer this process's local copy for every
+        consumer process assigned to it: consumer c pulls from producer
+        c % P under uuid ``uuid_base + c``, so process i offers exactly
+        {uuid_base + c : c % P == i}. Returns (shape, dtype) from the local
+        copy (the leader's caller needs them for page_ready)."""
+        self.kv_endpoint_start()
+        k, v = self._replicate_page(pid)
+        k_l = k.addressable_shards[0].data
+        v_l = v.addressable_shards[0].data
+        i, nproc = jax.process_index(), jax.process_count()
+        for c in range(i, int(pullers), nproc):
+            self.kv_endpoint.offer_fixed(int(uuid_base) + c, k_l, v_l)
+        return list(k_l.shape), str(k_l.dtype)
+
+    def kv_pull_page(
+        self, assignments: list, shape, dtype, key: str
+    ) -> int:
+        """Pull this process's copy of a page from its assigned producer
+        endpoint and stage it locally; returns the staged byte count (0 on
+        failure — the leader's staging accounting needs the real size even
+        when its budget reservation TTL'd out mid-pull). ``assignments`` has
+        one (addr, uuid) per consumer process. A pull failure stages nothing
+        but does NOT raise — the leader notices its own failure (or a later
+        restore mismatch) and replicates kv_unstage_page so every process
+        converges, then the producer falls back to TCP blobs for the page."""
+        self.kv_endpoint_start()
+        addr, uuid = assignments[jax.process_index() % len(assignments)]
+        self._kv_staged_sweep()
+        try:
+            k_l, v_l = self.kv_endpoint.pull(addr, int(uuid), shape, dtype)
+        except Exception as e:  # noqa: BLE001
+            import logging
+
+            logging.getLogger(__name__).warning("device kv pull failed: %s", e)
+            return 0
+        import time as time_mod
+
+        # TTL is 2x the leader-side DeviceStaging ttl: the leader must always
+        # give up on a page (and replicate kv_unstage_page) before any
+        # follower's local sweep could drop it — else a leader restore would
+        # find follower staging gone (fatal desync by design)
+        self.kv_staged[key] = (k_l, v_l, time_mod.monotonic() + 240.0)
+        return int(k_l.nbytes) * 2
+
+    def kv_restore_page(self, key: str, pid: int) -> None:
+        """Write a staged page into this process's pool shards. The device
+        program is identical on every process (SPMD set_page); the staged
+        copy is local, so no bytes cross the step stream. Missing staged
+        state here is a desync bug — fatal by design (distributed.py
+        failure model)."""
+        entry = self.kv_staged.pop(key, None)
+        if entry is None:
+            raise RuntimeError(
+                f"kv_restore_page: page {key!r} not staged on process "
+                f"{jax.process_index()} — staging diverged from the leader"
+            )
+        k_l, v_l, _ = entry
+        if self._set_page_fn is None:
+            self._set_page_fn = jax.jit(
+                lambda kp, vp, i, k, v: (kp.at[:, i].set(k), vp.at[:, i].set(v)),
+                donate_argnums=(0, 1),
+            )
+        dt = self.k_pages.dtype
+        k_l = jnp.asarray(k_l, dt)
+        v_l = jnp.asarray(v_l, dt)
+        if self.k_pages.is_fully_addressable:
+            k_rep = jax.device_put(k_l, self._rep)
+            v_rep = jax.device_put(v_l, self._rep)
+        else:
+            # assemble the replicated global operand from per-process local
+            # copies: one single-device copy per local mesh device
+            local = self._local_mesh_devices()
+            k_rep = jax.make_array_from_single_device_arrays(
+                k_l.shape, self._rep,
+                [jax.device_put(k_l, d) for d in local],
+            )
+            v_rep = jax.make_array_from_single_device_arrays(
+                v_l.shape, self._rep,
+                [jax.device_put(v_l, d) for d in local],
+            )
+        self.k_pages, self.v_pages = self._set_page_fn(
+            self.k_pages, self.v_pages, jnp.int32(pid), k_rep, v_rep,
+        )
+
+    def kv_unstage_page(self, key: str) -> None:
+        """Drop a staged page on every process (leader-side staging expiry or
+        a failed/partial pull). Host-side only — always symmetric-safe."""
+        self.kv_staged.pop(key, None)
+
+    def _kv_staged_sweep(self) -> None:
+        """TTL cleanup for never-restored staged pages. Host-side dict work:
+        divergent timing across processes cannot desync device state (the
+        authoritative drop is the leader's replicated kv_unstage_page; this
+        sweep only bounds worst-case device memory if that never arrives)."""
+        import time as time_mod
+
+        now = time_mod.monotonic()
+        for k in [k for k, (_, _, d) in self.kv_staged.items() if d < now]:
+            self.kv_staged.pop(k, None)
+
     def _kv_sharding(self) -> NamedSharding:
         """Pool sharding for this mesh (pp shards the layer axis).
 

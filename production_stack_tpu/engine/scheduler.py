@@ -76,6 +76,7 @@ class Sequence:
     finished: bool = False
     finish_reason: Optional[str] = None
     first_token_time: Optional[float] = None
+    first_dispatch_time: Optional[float] = None  # admission-wait instrumentation
     lora_slot: int = 0             # adapter slot (0 = base model)
     cache_salt: bytes = b""        # prefix-cache salt (adapter identity)
 
@@ -167,6 +168,22 @@ class Scheduler:
         self.waiting: list[Sequence] = []
         self.running: list[Sequence] = []
         self._last_kind = "decode"  # prefill/decode alternation state
+        # adaptive chain-depth inputs, refreshed by the engine loop each
+        # iteration: recent request arrivals/sec and the measured per-burst
+        # wall time. A chained dispatch delays the next scheduling decision
+        # by (bursts-1) * burst_seconds, during which an arrival cannot start
+        # its prefill — exactly the TTFT admission-wait tradeoff.
+        self.arrival_rate = 0.0
+        self.burst_seconds = 0.05
+        # streak-based chain growth: each chained dispatch pays exactly one
+        # fetch round trip, so depth sets the RTT share of decode time on
+        # network-attached chips. Sustained quiescence (consecutive chained
+        # decode dispatches with nothing else runnable) doubles the depth up
+        # to decode_pipeline_cap; any prefill, arrival, or idle pass resets.
+        self._chain_streak = 0
+        self.decode_pipeline_cap = (
+            min(16, self.decode_pipeline * 4) if self.decode_pipeline > 1 else 1
+        )
 
     # -- api ----------------------------------------------------------------
 
@@ -303,13 +320,19 @@ class Scheduler:
             return self._take_prefill(prefilling)
         self._last_kind = "decode"
         if self.running:
-            # chain bursts only when nothing is waiting to join the batch:
-            # a chained dispatch delays the next scheduling decision by
-            # (bursts-1) * burst compute, which would hurt arrivals' TTFT
+            # chain bursts when nothing admissible is waiting to join the
+            # batch: a chained dispatch delays the next scheduling decision
+            # by (bursts-1) * burst compute, which would hurt arrivals' TTFT.
+            # When every seat is taken (running == max_num_seqs), waiting
+            # requests CANNOT start regardless — chaining costs them nothing
+            # and drains the running set (and so the queue) ~bursts-fold
+            # faster on fetch-RTT-bound hosts, which is what decides TTFT
+            # under oversubscription (the multi-round-qa shape).
+            admission_blocked = len(self.running) >= self.max_num_seqs
             bursts = (
                 self.decode_pipeline
                 if (
-                    not self.waiting
+                    (not self.waiting or admission_blocked)
                     and not prefilling  # a chain would delay the next chunk
                     and not self.spec_k
                     and self.decode_steps > 1
@@ -319,7 +342,54 @@ class Scheduler:
                 )
                 else 1
             )
+            if bursts > 1 and self._chain_streak > 0:
+                # sustained quiescence: double the chain depth per
+                # consecutive fully-chained dispatch, up to the cap — depth
+                # sets the fetch-RTT share of decode time, and a continuing
+                # streak is evidence nothing else wants the device
+                bursts = min(
+                    bursts << min(self._chain_streak, 4),
+                    self.decode_pipeline_cap,
+                )
+                # don't over-chain past every row's remaining budget: a row
+                # at its max_tokens cap is masked for the rest of the chain
+                most_left = max(
+                    (s.params.max_tokens - len(s.output_ids) for s in decoding),
+                    default=1,
+                )
+                bursts = max(1, min(bursts, -(-most_left // self.decode_steps)))
+            # adaptive depth: cap the chain so the EXPECTED number of
+            # arrivals stuck waiting behind it stays under ~half a request
+            # ((bursts-1) * burst_time * arrival_rate <= 0.5). Quiescent
+            # traffic (rate ~ 0) keeps full chaining and its fetch-RTT
+            # amortization; under a steady arrival stream chains shorten so
+            # a new request's prefill starts within ~a burst of arriving.
+            # Irrelevant while admission is blocked: an arrival cannot start
+            # until a seat frees, which chaining accelerates.
+            if not admission_blocked:
+                while (
+                    bursts > 1
+                    and (bursts - 1) * self.burst_seconds * self.arrival_rate
+                    > 0.5
+                ):
+                    bursts -= 1
+            if bursts > 1:
+                # min_tokens: the EOS ban is fixed for everything one dispatch
+                # covers, so a chained dispatch could overshoot the floor by
+                # bursts*decode_steps-1 tokens. Cap the chain so rows near
+                # their floor get a fresh scheduling decision within one
+                # burst of crossing it — the overshoot window stays at the
+                # unchained bound (< decode_steps) regardless of pipeline depth.
+                for s in decoding:
+                    rem = s.params.min_tokens - len(s.output_ids)
+                    if rem > 0:
+                        bursts = min(bursts, max(1, -(-rem // self.decode_steps)))
             batch = self._plan_decode(decoding, bursts)
+            self._chain_streak = (
+                self._chain_streak + 1
+                if batch is not None and batch.bursts > 1
+                else 0
+            )
             if batch is None:
                 # nothing decodable this pass — fall back to prefill work.
                 # RE-DERIVE the prefill set: _plan_decode's page-pressure
@@ -337,6 +407,7 @@ class Scheduler:
         """Plan the next prefill dispatch: shortest remaining prompts first
         (they finish and start decoding soonest)."""
         self._last_kind = "prefill"
+        self._chain_streak = 0  # prefill work ends the quiescence streak
         prefilling.sort(key=lambda s: len(s.prompt_ids) - s.num_computed)
         return self._plan_prefill(prefilling[: self.prefill_batch])
 

@@ -178,6 +178,15 @@ class KVOffloadConnector:
         try:
             if self.device_staging is not None:
                 staged = self.device_staging.pop(h.hex())
+                if staged == "replicated":
+                    # multi-host: every process holds its pulled copy in
+                    # runner.kv_staged; the REPLICATED restore writes each
+                    # process's pool shards — no bytes cross the host or
+                    # the step stream
+                    self.runner.kv_restore_page(h.hex(), pid)
+                    self.device_loaded_pages += 1
+                    self.loaded_pages += 1
+                    return True
                 if staged is not None:
                     # device->device injection: no host serde round trip
                     self.runner.set_page(pid, *staged)

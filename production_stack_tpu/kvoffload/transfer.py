@@ -52,6 +52,14 @@ class KVTransferReceiver:
         # announce pages via "page_ready" and we pull them device->device
         self.device_endpoint = device_endpoint
         self.staging = staging
+        # multi-host consumer mode (engine.enable_multihost_device_kv): the
+        # number of mesh processes (page_query advertises it so the producer
+        # can build one pull assignment per process) and a pull_fn/unstage_fn
+        # pair that run the REPLICATED kv_pull_page / kv_unstage_page
+        # dispatches on the engine device thread
+        self.procs = 1
+        self.pull_fn = None
+        self.unstage_fn = None
         self.received_chunks = 0
         self.received_bytes = 0
         self.device_pages = 0
@@ -78,7 +86,10 @@ class KVTransferReceiver:
                     # device path phase 1: atomically reserve staging budget
                     # so the producer registers the page with its transfer
                     # server only once a pull is guaranteed to be attempted
-                    if self.device_endpoint is None or self.staging is None:
+                    device_on = self.pull_fn is not None or (
+                        self.device_endpoint is not None
+                    )
+                    if not device_on or self.staging is None:
                         await write_frame(writer, {"ok": False})
                     else:
                         verdict = self.staging.reserve(
@@ -87,23 +98,51 @@ class KVTransferReceiver:
                         await write_frame(writer, {
                             "ok": verdict == "reserved",
                             "have": verdict == "have",
+                            "procs": self.procs,
                         })
-                elif op == "page_ready":
-                    # device path phase 2: pull the registered page
-                    # device->device and stage it for admission
+                elif op == "page_ready" and "assignments" in hdr:
+                    # device path phase 2, assignment form (producer armed
+                    # via enable_multihost — also the P=1 single-host case):
+                    # a multi-host consumer pulls one copy per process
+                    # (REPLICATED kv_pull_page via the engine device
+                    # thread); a single-host consumer pulls assignment 0
+                    # with its own endpoint
                     ok = False
-                    if self.device_endpoint is not None and self.staging is not None:
+                    key = hdr["key"]
+                    if self.pull_fn is not None and self.staging is not None:
+                        nbytes = 0
+                        try:
+                            nbytes = int(await asyncio.to_thread(
+                                self.pull_fn,
+                                hdr["assignments"], hdr["shape"],
+                                hdr["dtype"], key,
+                            ) or 0)
+                        except Exception as e:  # noqa: BLE001
+                            logger.warning(
+                                "multi-host device kv pull failed: %s", e
+                            )
+                        ok = nbytes > 0
+                        if ok:
+                            self.staging.promote(key, nbytes)
+                            self.device_pages += 1
+                        else:
+                            self.staging.unreserve(key)
+                            if self.unstage_fn is not None:
+                                # a partial pull may have staged copies on
+                                # some processes; converge everyone to empty
+                                await asyncio.to_thread(self.unstage_fn, key)
+                    elif self.device_endpoint is not None and self.staging is not None:
+                        addr, uuid = hdr["assignments"][0]
                         try:
                             k_dev, v_dev = await asyncio.to_thread(
                                 self.device_endpoint.pull,
-                                hdr["addr"], hdr["uuid"],
-                                hdr["shape"], hdr["dtype"],
+                                addr, int(uuid), hdr["shape"], hdr["dtype"],
                             )
-                            self.staging.put(hdr["key"], k_dev, v_dev)
+                            self.staging.put(key, k_dev, v_dev)
                             self.device_pages += 1
                             ok = True
                         except Exception as e:  # noqa: BLE001
-                            self.staging.unreserve(hdr["key"])
+                            self.staging.unreserve(key)
                             logger.warning("device kv pull failed: %s", e)
                     await write_frame(writer, {"ok": ok})
                 elif op == "ping":
@@ -156,65 +195,74 @@ class KVTransferSender:
     before the decode peer holds the KV (the reference gets the same ordering
     from the NIXL blocking handshake)."""
 
-    def __init__(self, peer_url: str, timeout: float = 30.0, device_endpoint=None):
+    def __init__(self, peer_url: str, timeout: float = 30.0):
         host, port = parse_hostport(peer_url, default_port=55555)
         self._client = BlockingClient(host, port, timeout=timeout)
         self._lock = threading.Lock()
-        self.device_endpoint = device_endpoint
+        # device path (engine-armed, single- OR multi-host producer):
+        # per-process transfer-server addresses and the REPLICATED offer
+        # dispatch (runner.kv_offer_page via the broadcasting runner)
+        self._mh_addrs: Optional[list] = None
+        self._mh_offer = None
+        self._mh_uuid = 1 << 20  # clear of any endpoint-self-assigned ids
         self.sent_chunks = 0
         self.sent_bytes = 0
         self.device_pages = 0
         self.skipped_pages = 0
         self.errors = 0
 
-    def push_device(self, key: str, nbytes: int, make_arrays) -> bool:
-        """Ship a page device->device; the final ACK doubles as the
-        NIXL-style completion handshake (the prefill HTTP response must not
-        return before the consumer holds the KV).
+    def enable_multihost(self, addrs: list, offer_fn) -> None:
+        """Arm the multi-host device path: ``addrs`` lists every producer
+        process's transfer-server address (index == jax process id);
+        ``offer_fn(pid, uuid_base, pullers) -> (shape, dtype)`` performs the
+        replicated page offer on every producer process."""
+        self._mh_addrs = addrs
+        self._mh_offer = offer_fn
 
-        Two phases: "page_query" asks the consumer to reserve staging budget
-        BEFORE anything is gathered or registered — the XLA API has no cancel
-        for await_pull, so a refused offer must never register (a
-        registered-then-unpulled page would pin its device buffers), and
-        ``make_arrays()`` (the producer's single-device page gather) only
-        runs once the consumer has said yes.
-        Returns False so the caller can fall back to a TCP blob push."""
-        if self.device_endpoint is None:
+    def push_device_multihost(self, key: str, nbytes: int, pid: int) -> bool:
+        """Multi-host NIXL analogue: one page moves shard-cluster to
+        shard-cluster with no host serde. Phase 1 reserves consumer staging
+        (and learns the consumer process count C); phase 2 offers the
+        replicated page on every producer process (P of them) and announces
+        one (addr, uuid) pull assignment per consumer process — consumer c
+        pulls from producer c % P under uuid = base + (c // P) * P + (c % P).
+        Returns False for per-page TCP-blob fallback."""
+        if self._mh_addrs is None or self._mh_offer is None:
             return False
-        uuid = None
         try:
             with self._lock:
                 hdr, _ = self._client.request(
                     {"op": "page_query", "key": key, "nbytes": nbytes}
                 )
                 if hdr.get("have"):
-                    # consumer already STAGED this page (shared prefix) —
-                    # nothing to ship, and no TCP fallback either
                     self.skipped_pages += 1
                     return True
                 if not hdr.get("ok"):
-                    return False  # staging full / device mode off on peer
-                k_dev, v_dev = make_arrays()
-                uuid, shape, dtype = self.device_endpoint.offer(k_dev, v_dev)
+                    return False
+                procs = max(1, int(hdr.get("procs", 1)))
+                n_prod = len(self._mh_addrs)
+                base = self._mh_uuid
+                self._mh_uuid += procs  # disjoint uuid range per page
+                shape, dtype = self._mh_offer(pid, base, procs)
+                # consumer c pulls from producer c % P under uuid base + c;
+                # producer process i offered exactly {base+c : c % P == i}
+                assignments = [
+                    [self._mh_addrs[c % n_prod], base + c]
+                    for c in range(procs)
+                ]
                 hdr, _ = self._client.request({
-                    "op": "page_ready", "key": key, "uuid": uuid,
+                    "op": "page_ready", "key": key,
+                    "assignments": assignments,
                     "shape": shape, "dtype": dtype,
-                    "addr": self.device_endpoint.address,
                 })
-            ok = bool(hdr.get("ok"))
-            self.device_endpoint.release(uuid, pulled=ok)
-            uuid = None
-            if ok:
+            if bool(hdr.get("ok")):
                 self.device_pages += 1
                 return True
             return False
         except Exception as e:  # noqa: BLE001
             self.errors += 1
-            logger.warning("device kv offer failed: %s", e)
+            logger.warning("multi-host device kv offer failed: %s", e)
             return False
-        finally:
-            if uuid is not None:
-                self.device_endpoint.release(uuid, pulled=False)
 
     def push(self, key: str, blob: bytes) -> bool:
         with self._lock:
@@ -240,13 +288,14 @@ class KVTransferSender:
 class DeviceKVEndpoint:
     """One engine's side of the jax device-to-device KV fabric.
 
-    Wraps ``jax.experimental.transfer``: the producer registers page arrays
-    for pull (``offer``); the consumer pulls them straight into its own
-    devices (``pull``) — KV moves device->device over the XLA transfer
-    service (ICI/DCN on TPU pods) with no host serde round trip. This is the
-    stack's NIXL-GPU-direct analogue (reference
-    deployment-vllm-multi.yaml:256-296) for slices that share a host or
-    fabric; the TCP blob path remains the cross-pod fallback.
+    Wraps ``jax.experimental.transfer``: producer processes register page
+    arrays for pull under leader-assigned uuids (``offer_fixed``, driven by
+    the replicated runner.kv_offer_page); consumer processes pull them
+    straight into their own devices (``pull``) — KV moves device->device
+    over the XLA transfer service (ICI within a slice, DCN between pods)
+    with no host serde round trip. This is the stack's NIXL-GPU-direct
+    analogue (reference deployment-vllm-multi.yaml:256-296); the TCP blob
+    path remains the per-page fallback.
     """
 
     def __init__(self, runner, host: str = "127.0.0.1"):
@@ -254,9 +303,15 @@ class DeviceKVEndpoint:
         from jax.experimental import transfer
 
         self.runner = runner
-        client = runner.mesh.devices.flat[0].client
+        # the PROCESS-LOCAL device: on a multi-host mesh, mesh.devices
+        # includes non-addressable devices whose client cannot host this
+        # process's transfer server
+        self._local_dev = next(
+            d for d in runner.mesh.devices.flat
+            if d.process_index == jax.process_index()
+        )
         self._server = transfer.start_transfer_server(
-            client, f"{host}:0", [f"{host}:0"]
+            self._local_dev.client, f"{host}:0", [f"{host}:0"]
         )
         self.address = self._server.address()
         self._conns: dict = {}
@@ -265,34 +320,45 @@ class DeviceKVEndpoint:
         self._lock = threading.Lock()
         self.offered_pages = 0
         self.pulled_pages = 0
-        self.leaked_offers = 0
 
-    def offer(self, k_dev, v_dev) -> tuple[int, list, list]:
-        """Register a page's device K/V for remote pull. Returns
-        (uuid, shape, dtype-name); the arrays stay referenced until
-        ``release``."""
+    # Retirement policy for fixed offers: there is no per-offer release
+    # handshake (the consumer's ack proves only its LEADER pulled; its
+    # followers replay the pull from the step stream asynchronously), so the
+    # producer cannot safely drop a ref on ack. Instead refs retire by AGE
+    # (past any plausible in-flight pull — consumer-side staging gives up at
+    # 120 s) with a hard count cap as backstop; a pulled offer's buffers
+    # free with the ref, and an unpulled one that old has already failed on
+    # the consumer (unstage + the producer's TCP fallback). sweep() runs on
+    # every new offer (on every process — offers are replicated), so an
+    # idle producer pins at most its final ~120 s of transferred pages.
+    OFFER_TTL = 120.0
+    OFFER_CAP = 256
+
+    def sweep(self) -> None:
+        import time as time_mod
+
+        now = time_mod.monotonic()
         with self._lock:
-            uuid = self._uuid
-            self._uuid += 1
-            self._offered[uuid] = (k_dev, v_dev)
+            for u in [u for u, (_, _, d) in self._offered.items() if d < now]:
+                self._offered.pop(u)
+            while len(self._offered) > self.OFFER_CAP:
+                self._offered.pop(next(iter(self._offered)))
+
+    def offer_fixed(self, uuid: int, k_dev, v_dev) -> None:
+        """Offer under a caller-chosen uuid (multi-host: the leader assigns
+        uuids and replicates the offer so every process registers its local
+        copy under a predictable id; see runner.kv_offer_page)."""
+        import time as time_mod
+
+        self.sweep()
+        with self._lock:
+            self._offered[uuid] = (
+                k_dev, v_dev, time_mod.monotonic() + self.OFFER_TTL
+            )
+            # keep self-assigned uuids clear of leader-assigned ranges
+            self._uuid = max(self._uuid, uuid + 1)
         self._server.await_pull(uuid, [k_dev, v_dev])
         self.offered_pages += 1
-        return uuid, list(k_dev.shape), str(k_dev.dtype)
-
-    def release(self, uuid: int, pulled: bool = True) -> None:
-        """Drop our reference to an offered page. LIMITATION: the XLA API has
-        no await_pull cancel, so if the peer never pulled, the transfer
-        server's own registration (and the page's device buffers) persist
-        until this endpoint is closed — tracked in ``leaked_offers`` and
-        bounded in practice because offers only outlive their pull on
-        transient pull errors (refusals never register; see push_device)."""
-        with self._lock:
-            if self._offered.pop(uuid, None) is not None and not pulled:
-                self.leaked_offers += 1
-                logger.warning(
-                    "unpulled transfer offer %d leaks one page of device "
-                    "memory until shutdown (%d total)", uuid, self.leaked_offers,
-                )
 
     def pull(self, addr: str, uuid: int, shape, dtype):
         """Pull a page's (k, v) device arrays from the producer at ``addr``."""
@@ -304,7 +370,7 @@ class DeviceKVEndpoint:
             if conn is None:
                 conn = self._server.connect(addr)
                 self._conns[addr] = conn
-        dev = self.runner.mesh.devices.flat[0]
+        dev = self._local_dev
         sds = jax.ShapeDtypeStruct(
             tuple(shape), jnp.dtype(dtype),
             sharding=jax.sharding.SingleDeviceSharding(dev),
@@ -334,27 +400,53 @@ class DeviceStaging:
     arrives (client abort after prefill) must not pin consumer HBM or wedge
     the budget into permanent TCP fallback."""
 
-    def __init__(self, max_bytes: int = 1 << 30, ttl: float = 120.0):
+    _META = "META"  # sentinel k-slot: page staged per-process in runner.kv_staged
+
+    def __init__(self, max_bytes: int = 1 << 30, ttl: float = 120.0,
+                 on_expire=None):
         import time as time_mod
 
         self._time = time_mod.monotonic
         self.max_bytes = max_bytes
         self.ttl = ttl
-        self._pages: dict[str, tuple] = {}      # key -> (k, v, deadline)
+        # on_expire(key): fired (outside the lock) when a META entry expires —
+        # multi-host consumers replicate kv_unstage_page so every process
+        # drops its staged copy together with this accounting entry
+        self.on_expire = on_expire
+        self._pages: dict[str, tuple] = {}      # key -> (k|META, v|nbytes, deadline)
         self._reserved: dict[str, tuple] = {}   # key -> (nbytes, deadline)
         self._bytes = 0
         self._lock = threading.Lock()
         self.expired_pages = 0
 
-    def _sweep_locked(self) -> None:
+    @classmethod
+    def _entry_bytes(cls, entry: tuple) -> int:
+        k, v, _ = entry
+        return int(v) if isinstance(k, str) else int(k.nbytes) * 2
+
+    def _sweep_locked(self) -> list:
+        """Drop expired entries; returns expired META keys so the caller can
+        fire ``on_expire`` after releasing the lock."""
         now = self._time()
+        expired_meta = []
         for key in [k for k, (_, _, d) in self._pages.items() if d < now]:
-            k_dev, _, _ = self._pages.pop(key)
-            self._bytes -= int(k_dev.nbytes) * 2
+            entry = self._pages.pop(key)
+            self._bytes -= self._entry_bytes(entry)
             self.expired_pages += 1
+            if isinstance(entry[0], str):
+                expired_meta.append(key)
         for key in [k for k, (_, d) in self._reserved.items() if d < now]:
             nbytes, _ = self._reserved.pop(key)
             self._bytes -= nbytes
+        return expired_meta
+
+    def _fire_expired(self, keys: list) -> None:
+        if self.on_expire is not None:
+            for k in keys:
+                try:
+                    self.on_expire(k)
+                except Exception:  # noqa: BLE001 - cleanup is best-effort
+                    logger.exception("staging on_expire(%s) failed", k)
 
     def reserve(self, key: str, nbytes: int) -> str:
         """Atomically check-and-reserve budget for an incoming page.
@@ -363,19 +455,42 @@ class DeviceStaging:
         reservation that may never complete — the producer must keep its
         TCP fallback)."""
         with self._lock:
-            self._sweep_locked()
+            expired = self._sweep_locked()
             if key in self._pages:
-                return "have"  # staged and ready for admission
-            if key in self._reserved:
+                verdict = "have"  # staged and ready for admission
+            elif key in self._reserved:
                 # an in-flight reservation may never complete (producer died
                 # mid-handshake); do NOT claim we have it — the producer must
                 # keep its TCP fallback for this page
-                return "full"
-            if self._bytes + nbytes > self.max_bytes:
-                return "full"
-            self._reserved[key] = (nbytes, self._time() + self.ttl)
-            self._bytes += nbytes
-            return "reserved"
+                verdict = "full"
+            elif self._bytes + nbytes > self.max_bytes:
+                verdict = "full"
+            else:
+                self._reserved[key] = (nbytes, self._time() + self.ttl)
+                self._bytes += nbytes
+                verdict = "reserved"
+        self._fire_expired(expired)
+        return verdict
+
+    def promote(self, key: str, nbytes: int = 0) -> None:
+        """Convert a reservation into a META entry: the page's device copies
+        live per process in runner.kv_staged (multi-host pull); this object
+        keeps only the budget accounting and admission visibility. ``nbytes``
+        is the pulled page's real size — charged when the reservation TTL'd
+        out during a slow pull, so staged HBM never escapes the budget."""
+        with self._lock:
+            res = self._reserved.pop(key, None)
+            if res is not None:
+                # reservation bytes stay counted; they simply become the
+                # page's accounting entry
+                size = res[0]
+            else:
+                size = nbytes
+                self._bytes += size
+            if key not in self._pages:
+                self._pages[key] = (self._META, size, self._time() + self.ttl)
+            else:
+                self._bytes -= size  # already staged; drop the double count
 
     def unreserve(self, key: str) -> None:
         with self._lock:
@@ -396,16 +511,22 @@ class DeviceStaging:
 
     def contains(self, key: str) -> bool:
         with self._lock:
-            self._sweep_locked()
-            return key in self._pages
+            expired = self._sweep_locked()
+            found = key in self._pages
+        self._fire_expired(expired)
+        return found
 
     def pop(self, key: str):
+        """Staged arrays, the string "replicated" for a multi-host META entry
+        (restore via runner.kv_restore_page), or None."""
         with self._lock:
             entry = self._pages.pop(key, None)
             if entry is None:
                 return None
+            self._bytes -= self._entry_bytes(entry)
+            if isinstance(entry[0], str):
+                return "replicated"
             k_dev, v_dev, _ = entry
-            self._bytes -= int(k_dev.nbytes) * 2
             return (k_dev, v_dev)
 
     def clear(self) -> None:

@@ -372,6 +372,14 @@ class RouterApp:
                 lines.append(f'{name}{{quantile="{q}"}} {round(v, 3)}')
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
+    async def metrics_reset(self, request: web.Request) -> web.Response:
+        """Clear the TTFT hop sample window (debug/bench endpoint) so a
+        benchmark phase's hop quantiles describe only that phase."""
+        from production_stack_tpu.router.request_service import reset_hop_samples
+
+        reset_hop_samples()
+        return web.json_response({"status": "ok"})
+
     # -- files & batches (parity files_router.py, batches_router.py) --------
 
     async def upload_file(self, request: web.Request) -> web.Response:
@@ -466,6 +474,7 @@ class RouterApp:
         r.add_get("/v1/models", self.models)
         r.add_get("/health", self.health)
         r.add_get("/metrics", self.metrics)
+        r.add_post("/metrics/reset", self.metrics_reset)
         r.add_get("/engines", self.engines)
         r.add_get("/version", self.version)
         r.add_post("/v1/files", self.upload_file)

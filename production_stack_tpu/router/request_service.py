@@ -45,6 +45,13 @@ def record_hop_sample(recv_to_route: float, route_to_connect: float,
     _hop_samples.append((recv_to_route, route_to_connect, connect_to_first))
 
 
+def reset_hop_samples() -> None:
+    """Clear the hop sample window (POST /metrics/reset): a benchmark phase
+    scrapes then resets, so each phase's quantiles describe THAT phase's
+    requests instead of pooling across differently-loaded phases."""
+    _hop_samples.clear()
+
+
 def get_hop_quantiles() -> dict:
     """{hop: {p50, p99}} in ms over the sample window."""
     if not _hop_samples:

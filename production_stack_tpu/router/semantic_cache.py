@@ -78,8 +78,8 @@ class NumpyIndex:
         best = int(np.argmax(sims))
         return float(sims[best]), best
 
-    def pop_front(self) -> None:
-        self.vectors = self.vectors[1:]
+    def pop_front(self, k: int = 1) -> None:
+        self.vectors = self.vectors[k:]
 
 
 class FaissIndex:
@@ -108,17 +108,19 @@ class FaissIndex:
         sims, ids = self._index.search(np.ascontiguousarray(q[None], np.float32), 1)
         return float(sims[0, 0]), int(ids[0, 0])
 
-    def pop_front(self) -> None:
-        # IndexFlatIP stores vectors densely: rebuild without row 0 (eviction
-        # is rare — once per insert beyond max_entries)
+    def pop_front(self, k: int = 1) -> None:
+        # IndexFlatIP stores vectors densely: eviction is an O(n*dim) rebuild
+        # without the dropped rows, so callers evict in batches to keep the
+        # steady-state store path O(1) amortized.
         n = self._count
+        k = min(k, n)
         kept = np.vstack(
-            [self._index.reconstruct(i) for i in range(1, n)]
-        ) if n > 1 else np.zeros((0, self.dim), np.float32)
+            [self._index.reconstruct(i) for i in range(k, n)]
+        ) if n > k else np.zeros((0, self.dim), np.float32)
         self._index = self._faiss.IndexFlatIP(self.dim)
         if len(kept):
             self._index.add(np.ascontiguousarray(kept, np.float32))
-        self._count = n - 1
+        self._count = n - k
 
 
 def default_embedder() -> "tuple[Callable[[str], np.ndarray], int]":
@@ -205,5 +207,9 @@ class SemanticCache:
         self.index.add(self.embed(prompt))
         self.entries.append({"response": response, "ts": time.time()})
         if len(self.entries) > self.max_entries:
-            self.index.pop_front()
-            self.entries.pop(0)
+            # Batch-evict the oldest eighth: a FAISS flat index can only
+            # evict via full rebuild, so amortize that cost over many stores
+            # instead of paying O(n*dim) on every miss once the cache fills.
+            k = max(1, self.max_entries // 8)
+            self.index.pop_front(k)
+            del self.entries[:k]

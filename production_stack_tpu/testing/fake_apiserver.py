@@ -107,16 +107,38 @@ class FakeAPIServer:
                 return web.json_response(coll[name])
             obj.setdefault("metadata", {})["uid"] = coll[name]["metadata"].get("uid")
             obj["metadata"]["resourceVersion"] = str(next(_COUNTER))
+            # deletionTimestamp is apiserver-owned: carry it across updates
+            prior_dts = coll[name]["metadata"].get("deletionTimestamp")
+            if prior_dts and "deletionTimestamp" not in obj["metadata"]:
+                obj["metadata"]["deletionTimestamp"] = prior_dts
             # preserve status across spec updates (K8s semantics)
             if "status" in coll[name] and "status" not in obj:
                 obj["status"] = coll[name]["status"]
+            # a terminating object whose last finalizer was removed goes away
+            # (K8s finalizer semantics — what the real apiserver does when a
+            # controller finishes cleanup and clears its finalizer)
+            if obj["metadata"].get("deletionTimestamp") and not obj[
+                "metadata"
+            ].get("finalizers"):
+                coll.pop(name, None)
+                self._notify(key, "DELETED", obj)
+                return web.json_response(obj)
             coll[name] = obj
             self._notify(key, "MODIFIED", obj)
             return web.json_response(obj)
         if request.method == "DELETE":
-            obj = coll.pop(name, None)
+            obj = coll.get(name)
             if obj is None:
                 return web.json_response({"kind": "Status", "code": 404}, status=404)
+            if obj.get("metadata", {}).get("finalizers"):
+                # finalizer semantics: mark terminating, keep the object until
+                # a controller clears its finalizer (K8s graceful deletion)
+                if not obj["metadata"].get("deletionTimestamp"):
+                    obj["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+                    obj["metadata"]["resourceVersion"] = str(next(_COUNTER))
+                    self._notify(key, "MODIFIED", obj)
+                return web.json_response(obj)
+            coll.pop(name, None)
             self._notify(key, "DELETED", obj)
             return web.json_response({"kind": "Status", "code": 200})
         return web.json_response({"kind": "Status", "code": 405}, status=405)

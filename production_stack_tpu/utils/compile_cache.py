@@ -15,7 +15,9 @@ restarts and same-model replicas skip straight to warm starts.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import sys
 
 from production_stack_tpu.utils.logging import init_logger
 
@@ -28,6 +30,39 @@ _DEFAULT_DIR = os.path.join(
 )
 
 _enabled_dir: str | None = None
+
+
+def _cpu_feature_scope() -> str:
+    """Subdirectory name isolating XLA:CPU AOT entries by writer configuration.
+
+    XLA:CPU serializes executables as AOT results whose embedded machine
+    features must match the loading process exactly; a mismatch (different
+    host ISA, jaxlib, or tuning flags flipped by co-loaded frameworks such
+    as TensorFlow/torch initializing LLVM differently) makes
+    cpu_aot_loader.cc reject — or worse, mis-accept — every entry. Keying
+    the directory on those inputs means a process only ever reads entries
+    written by an identically-configured process.
+    """
+    import jax
+
+    parts = [
+        jax.__version__,
+        getattr(jax, "lib", None) and getattr(jax.lib, "__version__", "") or "",
+        os.environ.get("XLA_FLAGS", ""),
+        ",".join(sorted(m for m in ("tensorflow", "torch") if m in sys.modules)),
+    ]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    parts.append(line.strip())
+                    break
+    except OSError:
+        import platform
+
+        parts.append(platform.processor() or platform.machine())
+    digest = hashlib.sha1("\n".join(parts).encode()).hexdigest()[:12]
+    return f"cpu-{digest}"
 
 
 def enable_persistent_cache(
@@ -69,6 +104,15 @@ def enable_persistent_cache(
         cache_dir = _DEFAULT_DIR
     if scope:
         cache_dir = os.path.join(cache_dir, scope)
+    try:
+        if jax.default_backend() == "cpu":
+            # Explicitly-enabled CPU caches (tests, dryruns) get a
+            # writer-config scope so feature-mismatched AOT entries are never
+            # even offered to the loader (see _cpu_feature_scope).
+            cache_dir = os.path.join(cache_dir, _cpu_feature_scope())
+    except Exception as e:  # noqa: BLE001 - no backend yet: don't risk a shared dir
+        logger.warning("compilation cache disabled (%s: %s)", type(e).__name__, e)
+        return None
     if _enabled_dir == cache_dir:
         return _enabled_dir
     try:

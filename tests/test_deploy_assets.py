@@ -54,6 +54,51 @@ class TestHelmChart:
                         break
         assert not missing, f"templates reference unknown values: {missing}"
 
+    def test_example_values_match_schema(self):
+        """Every shipped example values file (helm/examples/) must satisfy
+        the chart schema and only use value keys the default values.yaml
+        knows — an example that drifts from the chart is worse than none."""
+        jsonschema = pytest.importorskip("jsonschema")
+        schema = json.loads(_load("helm/values.schema.json"))
+        base = yaml.safe_load(_load("helm/values.yaml"))
+        exdir = os.path.join(ROOT, "helm", "examples")
+        names = [n for n in os.listdir(exdir) if n.endswith(".yaml")]
+        assert names, "helm/examples/ should ship at least one example"
+        for name in names:
+            values = yaml.safe_load(_load(f"helm/examples/{name}"))
+            jsonschema.validate(values, schema)
+
+            def check(node, ref, path):
+                if not isinstance(node, dict) or not isinstance(ref, dict):
+                    return
+                for k, v in node.items():
+                    assert k in ref, f"{name}: unknown key {path}{k}"
+                    check(v, ref[k], f"{path}{k}.")
+
+            for key, section in values.items():
+                assert key in base, f"{name}: unknown top-level key {key}"
+                if key == "servingEngineSpec":
+                    for model in section.get("modelSpec", []):
+                        check(model, base[key]["modelSpec"][0], "modelSpec[].")
+                else:
+                    check(section, base[key], f"{key}.")
+
+    def test_32k_example_page_budget(self):
+        """The long-context example's sizing comments must stay true: the KV
+        pool must hold >= 8 full-length contexts and fit per-chip HBM
+        (values-17 parity — the reference serves maxModelLen 32000)."""
+        values = yaml.safe_load(_load("helm/examples/values-32k-kv-aware.yaml"))
+        model = values["servingEngineSpec"]["modelSpec"][0]
+        assert model["maxModelLen"] == 32768
+        # Llama-3.1-8B: 32 layers x 8 kv-heads x 128 head-dim, bf16
+        kv_bytes_per_token = 2 * 32 * 8 * 128 * 2
+        ctx_bytes = model["maxModelLen"] * kv_bytes_per_token
+        pool = model["kvCacheMemoryGB"] * (1 << 30)
+        assert pool // ctx_bytes >= 8, "pool should hold >= 8 full contexts"
+        chips = model["tpu"]["chips"]
+        per_chip = (16e9 * 2 / chips) + pool / chips + 2e9  # weights+kv+ws
+        assert per_chip < 16e9, "per-chip HBM budget exceeded (v5e = 16 GB)"
+
     def test_model_iteration_fields(self):
         """Fields templates access on each modelSpec entry must exist in the
         default modelSpec (keeps values.yaml a complete reference)."""

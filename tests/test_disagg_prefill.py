@@ -114,7 +114,7 @@ class TestDisaggPrefillDeviceTransfer:
 
     def test_kv_ships_device_to_device(self, pd):
         producer, consumer = pd
-        if producer._kv_sender.device_endpoint is None:
+        if producer._kv_sender._mh_addrs is None:
             pytest.skip("transfer service unavailable on this platform")
         prompt = "a fairly long shared prompt that spans multiple kv pages " * 3
 
@@ -155,7 +155,7 @@ class TestDisaggPrefillDeviceTransfer:
         )
         producer.start()
         try:
-            if producer._kv_sender.device_endpoint is None:
+            if producer._kv_sender._mh_addrs is None:
                 pytest.skip("transfer service unavailable")
             prompt = "pages sharded over tensor parallel ranks " * 4
             _run(producer, prompt, "pdt-1", 1)

@@ -479,6 +479,7 @@ cfg = EngineConfig(
     distributed_num_processes=2, distributed_process_id={pid},
     worker_sync_port={sync_port},
     kv_role="consumer", kv_transfer_port={kv_port},
+    kv_transfer_device={device},
 )
 
 async def run():
@@ -491,12 +492,16 @@ asyncio.run(run())
 
 
 @pytest.mark.slow
-def test_multihost_consumer_disaggregated_prefill():
+@pytest.mark.parametrize("device", [False, True], ids=["tcp", "device"])
+def test_multihost_consumer_disaggregated_prefill(device):
     """Disaggregated prefill with a MULTI-HOST decode pool: a single-host
-    producer prefills, KV ships over TCP to the 2-process consumer cluster
-    (whose restores are REPLICATED set_page SPMD dispatches), and the router
-    streams the decode from the consumer's leader. The reference's analogue
-    is NIXL-linked P/D pools under multi-node vLLM."""
+    producer prefills and KV ships to the 2-process consumer cluster —
+    either as TCP blobs (restores are REPLICATED set_page SPMD dispatches)
+    or, with --kv-transfer-device, device->device over the XLA transfer
+    service: every consumer process pulls its assigned copy and the restore
+    is the replicated kv_restore_page, so ZERO host-serde blobs cross hosts.
+    The reference's analogue is NIXL-linked P/D pools under multi-node vLLM
+    (deployment-vllm-multi.yaml:256-296)."""
     from production_stack_tpu.testing.procs import start_proc, stop_proc, wait_healthy
 
     coord, sync, chttp, phttp, rport, kvport = (
@@ -513,6 +518,7 @@ def test_multihost_consumer_disaggregated_prefill():
             code = _PD_CONSUMER.format(
                 root=os.path.abspath(ROOT), http_port=chttp,
                 coord_port=coord, pid=pid, sync_port=sync, kv_port=kvport,
+                device=device,
             )
             procs.append(subprocess.Popen(
                 [sys.executable, "-u", "-c", code],
@@ -525,7 +531,7 @@ def test_multihost_consumer_disaggregated_prefill():
             "--prefill-chunk", "32",
             "--kv-role", "producer",
             "--kv-peer-url", f"http://127.0.0.1:{kvport}",
-        ])
+        ] + (["--kv-transfer-device"] if device else []))
         named["producer"] = producer
         import urllib.request
 
@@ -575,11 +581,31 @@ def test_multihost_consumer_disaggregated_prefill():
             f"http://127.0.0.1:{chttp}/metrics", timeout=30
         ) as r:
             metrics = r.read().decode()
-        loaded = [
-            float(l.rsplit(" ", 1)[1]) for l in metrics.splitlines()
-            if l.startswith("vllm:kv_offload_loaded_pages_total{")
-        ]
-        assert loaded and loaded[0] > 0, metrics[:2000]
+
+        def metric(name: str) -> float:
+            vals = [
+                float(l.rsplit(" ", 1)[1]) for l in metrics.splitlines()
+                if l.startswith(f"vllm:{name}{{")
+            ]
+            assert vals, f"{name} missing:\n{metrics[:2000]}"
+            return vals[0]
+
+        assert metric("kv_offload_loaded_pages_total") > 0
+        if device:
+            # the DCN device path carried every page: per-process pulls +
+            # replicated restores, zero host-serde blobs cross-host
+            assert metric("kv_transfer_device_pages_total") > 0
+            assert metric("kv_transfer_received_chunks_total") == 0
+            assert metric("kv_offload_device_loaded_pages_total") > 0
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{phttp}/metrics", timeout=30
+            ) as r:
+                pm = r.read().decode()
+            psent = [
+                float(l.rsplit(" ", 1)[1]) for l in pm.splitlines()
+                if l.startswith("vllm:kv_transfer_sent_chunks_total{")
+            ]
+            assert psent and psent[0] == 0, "producer fell back to TCP blobs"
     finally:
         for p in named.values():
             stop_proc(p)

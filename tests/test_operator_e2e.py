@@ -16,6 +16,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from pathlib import Path
 
@@ -184,6 +185,102 @@ def test_operator_loads_lora_onto_pods(apiserver):
         cr = _req(port, "GET", f"{base}/sql-lora")
         assert cr["status"]["phase"] == "Loaded"
         assert cr["status"]["loadedPods"] == ["llama-engine-0"]
+        # the reconcile added the cleanup finalizer before loading
+        assert cr["metadata"]["finalizers"] == [
+            "production-stack.tpu.ai/lora-finalizer"
+        ]
+
+        # deleting the CR marks it terminating (finalizer pending); the next
+        # reconcile unloads from every loaded pod, clears the finalizer, and
+        # the apiserver completes the delete (reference
+        # loraadapter_controller.go:586-616, :872)
+        hits.clear()
+        _req(port, "DELETE", f"{base}/sql-lora")
+        cr = _req(port, "GET", f"{base}/sql-lora")  # still there: terminating
+        assert cr["metadata"]["deletionTimestamp"]
+        _run_operator(_operator_bin(), port)
+        assert ("/v1/unload_lora_adapter", {"lora_name": "sql-lora"}) in hits
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _req(port, "GET", f"{base}/sql-lora")
+        assert exc.value.code == 404
+    finally:
+        httpd.shutdown()
+
+
+@needs_native
+def test_operator_lora_placement_and_http_download(apiserver, tmp_path):
+    """deployment.replicas caps placement to the first N ready pods (reference
+    getOptimalPlacement, loraadapter_controller.go:403-457) and an http source
+    is downloaded to shared storage with spec.source.path persisted
+    (discoverAdapter :311-334)."""
+    port = apiserver
+    hits = []
+
+    class Handler(__import__("http.server", fromlist=["BaseHTTPRequestHandler"]).BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            hits.append((self.path, json.loads(body)))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def do_GET(self):  # adapter artifact host
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"fake-safetensors-bytes")
+
+        def log_message(self, *a):
+            pass
+
+    import http.server
+    import os
+
+    eng_port = free_port()
+    httpd = http.server.HTTPServer(("127.0.0.1", eng_port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        for i, ready in enumerate([True, True, False]):
+            _req(port, "POST", "/api/v1/namespaces/default/pods", {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"eng-{i}",
+                             "labels": {"model": "llama-3-8b"}},
+                "status": {"podIP": "127.0.0.1",
+                           "containerStatuses": [{"ready": ready}]},
+            })
+        base = f"/apis/{GROUP}/{VERSION}/namespaces/default/loraadapters"
+        _req(port, "POST", base, {
+            "apiVersion": f"{GROUP}/{VERSION}", "kind": "LoraAdapter",
+            "metadata": {"name": "web-lora"},
+            "spec": {"baseModel": "llama-3-8b",
+                     "source": {
+                         "type": "http",
+                         "repository":
+                             f"http://127.0.0.1:{eng_port}/web-lora.safetensors",
+                     },
+                     "deployment": {"replicas": 1},
+                     "enginePort": eng_port},
+        })
+        env = dict(os.environ, PSTPU_LORA_STORAGE=str(tmp_path))
+        bin_path = _operator_bin()
+        subprocess.run(
+            [str(bin_path), "--apiserver-host", "127.0.0.1",
+             "--apiserver-port", str(port), "--namespace", "default",
+             "--max-passes", "2", "--resync-seconds", "1"],
+            check=True, capture_output=True, timeout=120, env=env,
+        )
+        # artifact downloaded to shared storage
+        assert (tmp_path / "web-lora" / "web-lora.safetensors").read_bytes() == (
+            b"fake-safetensors-bytes"
+        )
+        cr = _req(port, "GET", f"{base}/web-lora")
+        # controller persisted the discovered path back into the spec
+        assert cr["spec"]["source"]["path"] == str(tmp_path / "web-lora")
+        # replicas=1 -> only the first ready pod (name order) loads it
+        assert cr["status"]["phase"] == "Loaded"
+        assert cr["status"]["loadedPods"] == ["eng-0"]
+        loads = [h for h in hits if h[0] == "/v1/load_lora_adapter"]
+        assert len({json.dumps(h[1]) for h in loads}) == 1  # one pod only
+        assert loads[0][1]["lora_name"] == "web-lora"
     finally:
         httpd.shutdown()
 

@@ -11,6 +11,7 @@ is ours, so the whole path is first-party (engine/tool_parser.py).
 import asyncio
 import json
 import threading
+import types
 
 import pytest
 import requests
@@ -172,6 +173,7 @@ class _ScriptedEngine:
         self.is_sleeping = False
         self.lora = None
         self.prompts = []
+        self.model_cfg = types.SimpleNamespace(vocab_size=self.tokenizer.vocab_size)
 
     def start(self):
         pass
@@ -456,7 +458,9 @@ class TestValidation:
 
     def test_bad_logit_bias_400(self, scripted_server):
         base, _ = scripted_server(["x"])
-        for bad in ({"not_an_int": 1.0}, {"5": 500.0}, {"-3": 1.0}):
+        # out-of-vocab ids get a 400 like OpenAI, not a silent device drop
+        for bad in ({"not_an_int": 1.0}, {"5": 500.0}, {"-3": 1.0},
+                    {str(ByteTokenizer.vocab_size): 1.0}):
             r = requests.post(
                 f"{base}/v1/chat/completions",
                 json={"messages": [{"role": "user", "content": "hi"}],
