@@ -286,6 +286,8 @@ class EngineServer:
         emit("decode_dispatches_total", "counter", s["decode_dispatches_total"])
         emit("decode_chained_dispatches_total", "counter",
              s["decode_chained_dispatches_total"])
+        emit("runahead_prefill_dispatches_total", "counter",
+             s.get("runahead_prefill_dispatches_total", 0))
         for k in sorted(s):  # kv offload / transfer / spec / loop metrics
             if k.startswith(("kv_", "spec_decode_", "engine_loop_")):
                 kind = "counter" if k.endswith("_total") else "gauge"

@@ -211,6 +211,10 @@ class LLMEngine:
         # network-attached chips (each unchained dispatch pays a fetch RTT)
         self.decode_dispatches_total = 0
         self.decode_chained_dispatches_total = 0
+        # prefill dispatches issued while a decode chain was in flight
+        # (run-ahead): the device queued them behind the chain instead of
+        # idling through its fetch + scheduling turnaround
+        self.runahead_prefill_dispatches_total = 0
         self.spec_draft_tokens = 0     # drafts proposed (rounds * spec_k)
         self.spec_accepted_tokens = 0  # drafts the target accepted
         self.num_preemptions = 0
@@ -480,19 +484,30 @@ class LLMEngine:
 
     # -- engine loop (device thread) ----------------------------------------
 
-    def _drain_inbox(self, block: bool) -> None:
+    def _drain_inbox(self, block: bool, defer_aborts: bool = False) -> list:
+        """Drain queued arrivals/aborts/device commands. With
+        ``defer_aborts`` (mid-chain run-ahead), aborts are RETURNED instead
+        of applied: an abort frees the sequence's pages, and a page freed
+        while a dispatched-but-unfetched chain still writes to it must not
+        be reallocated to a run-ahead admission. The caller re-queues them
+        once the chain has been applied (aborts are idempotent and
+        order-independent — abort of an already-finished seq is a no-op)."""
+        deferred: list = []
         timeout = 0.5 if block else None
         while True:
             try:
                 item = self._inbox.get(block=block, timeout=timeout)
             except queue_mod.Empty:
-                return
+                return deferred
             block = False
             if item is None:
-                return
+                return deferred
             if isinstance(item, tuple) and item[0] == "device_cmd":
                 item[1]()  # LoRA update / embed forward, serialized with steps
             elif isinstance(item, tuple) and item[0] == "abort":
+                if defer_aborts:
+                    deferred.append(item)
+                    continue
                 for s in self.scheduler.waiting + self.scheduler.running:
                     if s.seq_id == item[1] and not s.finished:
                         self.scheduler._finish(s, "abort")
@@ -524,6 +539,10 @@ class LLMEngine:
             # stays below ~half a request (scheduler.schedule)
             self.scheduler.arrival_rate = self._recent_arrival_rate()
             self.scheduler.burst_seconds = self._burst_seconds
+            self.scheduler.last_arrival_age = (
+                time.monotonic() - self._arrival_times[-1]
+                if self._arrival_times else float("inf")
+            )
             t0 = time.perf_counter()
             self.loop_seconds["wait"] += t0 - t_sec
             batch = self.scheduler.schedule()
@@ -531,16 +550,14 @@ class LLMEngine:
             if batch is None:
                 continue
             if batch.kind == "prefill":
-                now = time.monotonic()
-                for s in batch.seqs:
-                    if s.first_dispatch_time is None:
-                        s.first_dispatch_time = now
-                        self.admission_wait_ms.append(
-                            (now - s.arrival_time) * 1000
-                        )
+                self._note_first_dispatch(batch)
             fetched = True
             lp_data = None  # (chosen [B, cols], top_ids, top_lp [B, cols, K])
             t_step = time.perf_counter()
+            # apply/emit seconds booked inline (incremental chained fetch)
+            # this iteration — excluded from the step/chain_fetch sections
+            # so the loop_seconds breakdown stays disjoint and sums to wall
+            inline_ae = 0.0
             try:
                 inp = StepInput(
                     batch.input_ids, batch.positions, batch.page_table,
@@ -629,20 +646,17 @@ class LLMEngine:
                         t_chain = time.perf_counter()
                         # chained bursts: all dispatches go out before any
                         # fetch, so the chain costs bursts*compute + 1 round
-                        # trip. Fetch EVERY burst before applying any — apply
-                        # may finish sequences and free their pages, which
-                        # must not happen while a later burst could still be
-                        # writing to them.
+                        # trip for the LAST burst only.
                         devs = self.runner.step_multi_pipelined(
-                            inp, self.scheduler.decode_steps, batch.bursts, wlp
+                            inp, self.scheduler.decode_steps, batch.bursts,
+                            wlp,
+                            # grouped on-device concat + eager host copy at
+                            # each 4-burst boundary (see runner docstring);
+                            # the logprobs path still fetches whole-chain
+                            fetch_group=0 if wlp else 4,
                         )
                         t_disp = time.perf_counter()
                         self.loop_seconds["chain_dispatch"] += t_disp - t_chain
-                        # concatenate ON DEVICE and fetch once: each
-                        # np.asarray is a full host<->device round trip
-                        # (~100 ms on a network-attached chip), so per-burst
-                        # fetches would cost bursts*RTT and erase most of
-                        # what chaining saves
                         import jax.numpy as jnp
 
                         if wlp:
@@ -658,15 +672,56 @@ class LLMEngine:
                             ))
                             lp_data = tuple(lps)
                         else:
-                            tokens = np.asarray(
-                                jnp.concatenate(devs, axis=1)
-                            )  # [B, bursts*k]
+                            # incremental grouped fetch: the runner already
+                            # enqueued each group's on-device concat at its
+                            # burst boundary and started its host copy, so
+                            # group j's tokens stream back while groups
+                            # j+1.. still compute — the fetch RTT (and the
+                            # ~50 ms per-RPC floor, amortized 4x) hides
+                            # inside the chain's own compute, and clients
+                            # get a chunk per group instead of one
+                            # chain-sized batch. Applying group j before
+                            # j+1 lands is safe: a row that finishes
+                            # (EOS/stop) keeps computing masked/discarded
+                            # tokens, its freed pages cannot be reallocated
+                            # until the next schedule() (this thread), and
+                            # the garbage tokens write past the region the
+                            # prefix cache registered.
+                            gcats = devs
+                            # run-ahead: admit fresh arrivals and dispatch
+                            # their prefill chunks NOW — the device queues
+                            # them straight behind the chain's bursts
+                            # instead of idling through the chain's fetch +
+                            # scheduling turnaround. Aborts are deferred
+                            # (see _drain_inbox) so no page freed under the
+                            # in-flight chain can be re-allocated here.
+                            ra_done, ra_inter = self._runahead_prefills(batch)
+                            ae0 = (self.loop_seconds["apply"]
+                                   + self.loop_seconds["emit"])
+                            for c in gcats:
+                                self._apply_and_emit(batch, np.asarray(c))
+                            # the chain's fetches retire dispatches QUEUED
+                            # BEFORE the chain; run-ahead intermediates came
+                            # after, so they stay suspect until the next
+                            # fetch unless a run-ahead final fetch follows
+                            self._unfetched = ra_inter
+                            for ra, ids in ra_done:
+                                self._apply_and_emit(ra, np.asarray(ids))
+                            if ra_done:
+                                self._unfetched = []
+                            inline_ae = (
+                                self.loop_seconds["apply"]
+                                + self.loop_seconds["emit"] - ae0
+                            )
+                            fetched = False  # retirement handled above
+                            tokens = None  # processed inline
                         self.loop_seconds["chain_fetch"] += (
-                            time.perf_counter() - t_disp
+                            time.perf_counter() - t_disp - inline_ae
                         )
-                        # per-burst wall time EMA (includes the fetch RTT
-                        # amortized over the chain — a mild overestimate,
-                        # which errs toward shorter chains / better TTFT)
+                        # per-burst wall time EMA (includes fetch + apply +
+                        # emit amortized over the chain — a mild
+                        # overestimate, erring toward shorter chains and so
+                        # better TTFT under arrivals)
                         dt = (time.perf_counter() - t_chain) / batch.bursts
                         self._burst_seconds = (
                             0.7 * self._burst_seconds + 0.3 * dt
@@ -729,45 +784,124 @@ class LLMEngine:
                         self.scheduler._finish(s, "error")
                         self._emit(s, "", error=True)
                 continue
-            t_apply = time.perf_counter()
-            self.loop_seconds["step"] += t_apply - t_step
+            self.loop_seconds["step"] += (
+                time.perf_counter() - t_step - inline_ae
+            )
             if fetched:
                 self._unfetched.clear()  # a real fetch retires prior dispatches
-            events = self.scheduler.apply_step(
-                batch, tokens, self.tokenizer.eos_token_id
-            )
-            if batch.kind == "prefill":
-                for s, c in zip(batch.seqs, batch.chunk_sizes):
-                    self.total_prompt_tokens += c
-            if self._kv_sender is not None:
-                # ship KV before emitting the finish event: the prefill HTTP
-                # response must not return until the decode peer holds the KV
-                pushed = set()
-                for s, _, _, _ in events:
-                    if s.finished and s.seq_id not in pushed:
-                        pushed.add(s.seq_id)
-                        self._push_finished_kv(s)
-            t_emit = time.perf_counter()
-            self.loop_seconds["apply"] += t_emit - t_apply
-            # group burst events per sequence: one RequestOutput per seq per
-            # device step, carrying every new token (finished only on the
-            # last, so consumers never drop trailing burst tokens)
-            grouped: dict[str, tuple[Sequence, list[int], list]] = {}
-            for s, tok, i, j in events:
-                g = grouped.setdefault(s.seq_id, (s, [], []))
-                g[1].append(tok)
-                if lp_data is not None and s.params.logprobs is not None:
-                    n = min(s.params.logprobs, lp_data[1].shape[2])
-                    g[2].append({
-                        "logprob": float(lp_data[0][i, j]),
-                        "top_ids": lp_data[1][i, j, :n].tolist(),
-                        "top_logprobs": lp_data[2][i, j, :n].tolist(),
-                    })
-            for s, toks, lps in grouped.values():
-                self.total_generation_tokens += len(toks)
-                self._process_token(s, toks, lps or None)
-            self.loop_seconds["emit"] += time.perf_counter() - t_emit
+            if tokens is not None:
+                self._apply_and_emit(batch, tokens, lp_data)
         logger.info("engine loop exited")
+
+    def _note_first_dispatch(self, batch) -> None:
+        """Record the admission-wait hop (arrival -> first prefill dispatch)
+        for rows reaching the device for the first time — in the main loop
+        or via run-ahead."""
+        now = time.monotonic()
+        for s in batch.seqs:
+            if s.first_dispatch_time is None:
+                s.first_dispatch_time = now
+                self.admission_wait_ms.append((now - s.arrival_time) * 1000)
+
+    @staticmethod
+    def _runahead_allowed(s: Sequence) -> bool:
+        """Rows whose dispatch needs no bias/penalty/logprob staging — that
+        staging lives on the normal path only; others wait for it."""
+        return (
+            not s.params.wants_penalties
+            and s.params.logprobs is None
+            and not s.params.logit_bias
+            and (s.params.ignore_eos
+                 or len(s.output_ids) >= s.params.min_tokens)
+        )
+
+    def _runahead_prefills(self, chain_batch):
+        """Dispatch prefill work for sequences disjoint from an in-flight
+        decode chain (the device queues it behind the chain's bursts — zero
+        idle). Returns (final_dispatches_to_fetch, intermediate_batches).
+        Stops at the first final-chunk dispatch so a single trailing fetch
+        retires every intermediate before it. Deferred aborts are re-queued
+        HERE, before anything can raise — they are only processed at the
+        next ordinary inbox drain, after the chain has been applied."""
+        for item in self._drain_inbox(block=False, defer_aborts=True):
+            self._inbox.put(item)
+        ra_done: list = []
+        ra_inter: list = []
+        if self._sleeping:
+            return ra_done, ra_inter
+        exclude = {id(s) for s in chain_batch.seqs}
+        for _ in range(4):  # bound the work queued behind one chain
+            ra = self.scheduler.schedule_prefill_runahead(
+                exclude, allow=self._runahead_allowed
+            )
+            if ra is None:
+                break
+            self._note_first_dispatch(ra)
+            self.runahead_prefill_dispatches_total += 1
+            inp = StepInput(
+                ra.input_ids, ra.positions, ra.page_table, ra.kv_lens,
+                ra.temperature, ra.top_k, ra.top_p, lora_ids=ra.lora_ids,
+                kv_limits=ra.kv_limits,
+            )
+            if not any(
+                s.num_computed + c >= len(s.prompt_ids)
+                for s, c in zip(ra.seqs, ra.chunk_sizes)
+            ):
+                # all-intermediate chunks: skip-fetch (same optimization as
+                # the main loop) and account the progress immediately so the
+                # next planning round sees it
+                self.runner.step(inp)
+                self._unfetched.append(ra)
+                ra_inter.append(ra)
+                self._apply_and_emit(
+                    ra, np.full((len(ra.seqs),), -1, np.int32)
+                )
+            else:
+                ids, _ = self.runner.step(inp)
+                ra_done.append((ra, ids))
+                break  # one trailing fetch retires all intermediates above
+        return ra_done, ra_inter
+
+    def _apply_and_emit(self, batch, tokens, lp_data=None) -> None:
+        """Apply one fetched token matrix to scheduler state and stream the
+        resulting deltas — called once per dispatch, or once per BURST for
+        incrementally-fetched chains (the per-column apply is identical
+        either way; scheduler.apply_step skips finished rows)."""
+        t_apply = time.perf_counter()
+        events = self.scheduler.apply_step(
+            batch, tokens, self.tokenizer.eos_token_id
+        )
+        if batch.kind == "prefill":
+            for s, c in zip(batch.seqs, batch.chunk_sizes):
+                self.total_prompt_tokens += c
+        if self._kv_sender is not None:
+            # ship KV before emitting the finish event: the prefill HTTP
+            # response must not return until the decode peer holds the KV
+            pushed = set()
+            for s, _, _, _ in events:
+                if s.finished and s.seq_id not in pushed:
+                    pushed.add(s.seq_id)
+                    self._push_finished_kv(s)
+        t_emit = time.perf_counter()
+        self.loop_seconds["apply"] += t_emit - t_apply
+        # group burst events per sequence: one RequestOutput per seq per
+        # device step, carrying every new token (finished only on the
+        # last, so consumers never drop trailing burst tokens)
+        grouped: dict[str, tuple[Sequence, list[int], list]] = {}
+        for s, tok, i, j in events:
+            g = grouped.setdefault(s.seq_id, (s, [], []))
+            g[1].append(tok)
+            if lp_data is not None and s.params.logprobs is not None:
+                n = min(s.params.logprobs, lp_data[1].shape[2])
+                g[2].append({
+                    "logprob": float(lp_data[0][i, j]),
+                    "top_ids": lp_data[1][i, j, :n].tolist(),
+                    "top_logprobs": lp_data[2][i, j, :n].tolist(),
+                })
+        for s, toks, lps in grouped.values():
+            self.total_generation_tokens += len(toks)
+            self._process_token(s, toks, lps or None)
+        self.loop_seconds["emit"] += time.perf_counter() - t_emit
 
     def _push_finished_kv(self, seq: Sequence) -> None:
         """Producer role: push every hashed page of a finished sequence to the
@@ -1106,6 +1240,9 @@ class LLMEngine:
             "generation_tokens_total": self.total_generation_tokens,
             "decode_dispatches_total": self.decode_dispatches_total,
             "decode_chained_dispatches_total": self.decode_chained_dispatches_total,
+            "runahead_prefill_dispatches_total": (
+                self.runahead_prefill_dispatches_total
+            ),
         }
         for section, secs in self.loop_seconds.items():
             out[f"engine_loop_{section}_seconds_total"] = round(secs, 3)

@@ -357,16 +357,30 @@ class ModelRunner:
         return toks
 
     def step_multi_pipelined(
-        self, inp: StepInput, k: int, bursts: int, want_logprobs: bool = False
+        self,
+        inp: StepInput,
+        k: int,
+        bursts: int,
+        want_logprobs: bool = False,
+        fetch_group: int = 0,
     ) -> list:
         """Dispatch ``bursts`` chained k-step decode bursts WITHOUT fetching
-        between them; returns the per-burst device token arrays ([B, k] each).
+        between them; returns the per-burst device token arrays ([B, k] each)
+        — or, with ``fetch_group`` g > 0 (and no logprobs), per-GROUP arrays
+        ([B, <=g*k] each) whose on-device concatenation is enqueued right at
+        the group boundary and whose host copy starts immediately.
 
         Why: on network-attached TPUs every host fetch costs a full round
         trip (~100 ms), comparable to the burst's compute. Chaining feeds
         burst j+1's input token straight from burst j's device-resident
         output (toks[:, -1:]), so a chain of m bursts costs m*compute + 1 RTT
         when the caller finally fetches, instead of m*(compute + RTT).
+        Grouped fetching goes further: because device programs execute in
+        ENQUEUE order, a group's concat+copy enqueued at its boundary
+        completes as soon as ITS bursts do — the transfer overlaps the later
+        bursts' compute, so the caller can apply/emit group j while group
+        j+1 still runs (a concat enqueued after the last burst would wait
+        for the whole chain instead).
 
         The host mirrors the device's per-row activity rule exactly
         (_multi_step_fn body: emit; active = pos>=0 & lens<kv_limits;
@@ -377,16 +391,34 @@ class ModelRunner:
         bursts*k budget (scheduler plans this).
         """
         if bursts <= 1:
-            return [self.step_multi(inp, k, want_logprobs)]
+            res = self.step_multi(inp, k, want_logprobs)
+            if fetch_group and not want_logprobs:
+                res.copy_to_host_async()
+            return [res]
         pos = np.asarray(inp.positions, np.int64)[:, 0].copy()
         lens = np.asarray(inp.kv_lens, np.int64).copy()
         limits = np.asarray(inp.kv_limits, np.int64)
         outs = []
+        group: list = []
+
+        def flush_group():
+            if not group:
+                return
+            cat = group[0] if len(group) == 1 else jnp.concatenate(group, axis=1)
+            cat.copy_to_host_async()
+            outs.append(cat)
+            group.clear()
+
         cur = inp
         for j in range(bursts):
             res = self.step_multi(cur, k, want_logprobs)
             toks = res[0] if want_logprobs else res
-            outs.append(res)
+            if fetch_group and not want_logprobs:
+                group.append(res)
+                if len(group) >= fetch_group:
+                    flush_group()
+            else:
+                outs.append(res)
             if j == bursts - 1:
                 break
             for _ in range(k):  # exact mirror of the device scan
@@ -405,6 +437,8 @@ class ModelRunner:
                     self._last_hist if inp.history is not None else None
                 ),
             )
+        if fetch_group and not want_logprobs:
+            flush_group()
         return outs
 
     def step_spec(

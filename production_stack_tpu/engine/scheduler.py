@@ -175,6 +175,10 @@ class Scheduler:
         # its prefill — exactly the TTFT admission-wait tradeoff.
         self.arrival_rate = 0.0
         self.burst_seconds = 0.05
+        # seconds since the engine last saw a request arrive (refreshed per
+        # loop iteration); streak-based chain growth requires real
+        # quiescence, not just a momentary gap in a sporadic stream
+        self.last_arrival_age = float("inf")
         # streak-based chain growth: each chained dispatch pays exactly one
         # fetch round trip, so depth sets the RTT share of decode time on
         # network-attached chips. Sustained quiescence (consecutive chained
@@ -342,11 +346,20 @@ class Scheduler:
                 )
                 else 1
             )
-            if bursts > 1 and self._chain_streak > 0:
+            if (
+                bursts > 1
+                and self._chain_streak > 0
+                and (admission_blocked or self.last_arrival_age > 1.0)
+            ):
                 # sustained quiescence: double the chain depth per
                 # consecutive fully-chained dispatch, up to the cap — depth
                 # sets the fetch-RTT share of decode time, and a continuing
-                # streak is evidence nothing else wants the device
+                # streak is evidence nothing else wants the device. A
+                # SPORADIC arrival stream (gaps shorter than ~1 s) blocks
+                # growth even when the instant queue is empty: a deep chain
+                # is an admission-wait floor for whoever arrives next —
+                # unless admission is blocked anyway, where depth only
+                # drains the queue faster.
                 bursts = min(
                     bursts << min(self._chain_streak, 4),
                     self.decode_pipeline_cap,
@@ -402,6 +415,28 @@ class Scheduler:
                     return self._take_prefill(prefilling)
             return batch
         return None
+
+    def schedule_prefill_runahead(
+        self, exclude_ids: set, allow=None
+    ) -> Optional[ScheduledBatch]:
+        """Plan a prefill dispatch for sequences DISJOINT from an in-flight
+        decode chain (engine run-ahead): new arrivals admit and their chunks
+        dispatch while the chain still computes, so the device queues the
+        prefill right behind the chain's bursts instead of idling a fetch
+        round trip + scheduling turnaround. Disjointness means no mirrored
+        state is needed — nothing the chain will apply touches these rows.
+        ``allow`` filters candidates BEFORE planning (rows needing staging
+        the run-ahead path doesn't do wait for the normal path), so a
+        skipped row never perturbs _last_kind/_chain_streak."""
+        self._try_admit()
+        prefilling = [
+            s for s in self.running if s.in_prefill and id(s) not in exclude_ids
+        ]
+        if allow is not None:
+            prefilling = [s for s in prefilling if allow(s)]
+        if not prefilling:
+            return None
+        return self._take_prefill(prefilling)
 
     def _take_prefill(self, prefilling: list[Sequence]) -> ScheduledBatch:
         """Plan the next prefill dispatch: shortest remaining prompts first

@@ -130,3 +130,79 @@ def test_decode_fallback_replans_from_live_state():
                          7, np.int32)
         )
         sched.apply_step(batch, toks, eos_token_id=-1)
+
+
+def test_chains_when_admission_blocked():
+    """Oversubscription (waiting requests but every seat taken): chaining
+    must still engage — blocked arrivals cannot start regardless, and the
+    chain drains the running set (and so the queue) bursts-fold faster on
+    fetch-RTT-bound hosts. This is what decides multi-round-qa TTFT."""
+    sched = _mk_scheduler(max_num_seqs=1)
+    dec = Sequence("dec", prompt_ids=[1] * 8,
+                   params=SamplingParams(max_tokens=64, ignore_eos=True))
+    sched.add(dec)
+    _drive(sched, steps=1)  # prefill; dec now holds the only seat
+    sched.add(Sequence("blocked", prompt_ids=[2] * 8,
+                       params=SamplingParams(max_tokens=4, ignore_eos=True)))
+    batch = sched.schedule()
+    assert batch.kind == "decode"
+    assert batch.bursts == 3, "seat-blocked waiting work must not stop chains"
+
+
+def test_chain_depth_grows_on_quiescent_streak():
+    """Consecutive fully-chained dispatches with nothing else runnable double
+    the chain depth up to decode_pipeline_cap (each chained dispatch pays one
+    fetch round trip, so depth sets the RTT share of decode time)."""
+    sched = _mk_scheduler(decode_pipeline=2)
+    dec = Sequence("dec", prompt_ids=[1] * 8,
+                   params=SamplingParams(max_tokens=512, ignore_eos=True))
+    sched.add(dec)
+    _drive(sched, steps=1)
+    depths = []
+    for _ in range(4):
+        batch = sched.schedule()
+        assert batch.kind == "decode"
+        depths.append(batch.bursts)
+        toks = np.full(
+            (len(batch.kv_lens), sched.decode_steps * batch.bursts), 7, np.int32
+        )
+        sched.apply_step(batch, toks, eos_token_id=-1)
+    assert depths[0] == 2  # first chain: configured decode_pipeline
+    assert depths[1] > depths[0]  # streak doubles it...
+    assert max(depths) <= sched.decode_pipeline_cap  # ...up to the cap
+    # an arrival-rate signal caps the depth back down (adaptive)
+    sched.arrival_rate = 1000.0
+    sched.burst_seconds = 1.0
+    batch = sched.schedule()
+    assert batch.bursts == 1
+
+
+def test_runahead_prefill_is_disjoint_from_chain():
+    """schedule_prefill_runahead plans prefill work ONLY for sequences
+    outside the in-flight chain, admitting fresh arrivals; chunk accounting
+    via apply_step lets repeated calls walk the whole prompt."""
+    sched = _mk_scheduler()
+    dec = Sequence("dec", prompt_ids=[1] * 8,
+                   params=SamplingParams(max_tokens=64, ignore_eos=True))
+    sched.add(dec)
+    _drive(sched, steps=1)
+    chain = sched.schedule()
+    assert chain.kind == "decode"
+    # a new request arrives mid-chain
+    sched.add(Sequence("new", prompt_ids=[2] * 32,
+                       params=SamplingParams(max_tokens=4, ignore_eos=True)))
+    exclude = {id(s) for s in chain.seqs}
+    ra = sched.schedule_prefill_runahead(exclude)
+    assert ra is not None and ra.kind == "prefill"
+    assert all(id(s) not in exclude for s in ra.seqs)
+    assert ra.seqs[0].seq_id == "new"
+    sched.apply_step(ra, np.full((len(ra.kv_lens),), 7, np.int32), -1)
+    ra2 = sched.schedule_prefill_runahead(exclude)
+    assert ra2 is not None and ra2.chunk_sizes[0] == 16  # next chunk
+    sched.apply_step(ra2, np.full((len(ra2.kv_lens),), 7, np.int32), -1)
+    assert sched.schedule_prefill_runahead(exclude) is None  # prompt done
+    # the chain itself still applies cleanly afterwards
+    toks = np.full(
+        (len(chain.kv_lens), sched.decode_steps * chain.bursts), 7, np.int32
+    )
+    sched.apply_step(chain, toks, eos_token_id=-1)
