@@ -71,7 +71,10 @@ def main() -> None:
             llama.PRESETS["llama-3.2-1b"], max_model_len=32768
         )
         model_desc = "llama-3.2-1b-class (random weights)"
-        prefill_len, decode_batch, ctx_pages = 1024, 16, 16  # 1024-token contexts
+        # decode at the SERVING operating point (32 seats, the stack phase's
+        # max_num_seqs) — per-step cost is mostly batch-independent, so
+        # tokens/sec/chip scales with B until HBM pressure
+        prefill_len, decode_batch, ctx_pages = 1024, 32, 16  # 1k contexts
         page_size = 64
         long_targets = [16384, 32768]
     else:  # tiny fallback so the benchmark is runnable anywhere
@@ -119,35 +122,39 @@ def main() -> None:
     p50_ttft = float(np.percentile(ttfts, 50))
     p99_ttft = float(np.percentile(ttfts, 99))
 
-    # --- decode throughput: batch of decode_batch sequences at ~1k context ---
-    B = decode_batch
+    # --- decode throughput: sequences at ~1k context, at the serving batch
+    # (decode_batch) and at B=16 for cross-round comparability ---
     k = EngineConfig().decode_steps  # fused burst length, as LLMEngine serves
     # leave k KV slots of headroom so the burst never writes past the pages
     # each row owns
     ctx = ctx_pages * page_size - k - 1
-    pt = np.arange(B * ctx_pages).reshape(B, ctx_pages)
-    dec = StepInput(
-        input_ids=rng.randint(0, cfg.vocab_size, (B, 1)),
-        positions=np.full((B, 1), ctx),
-        page_table=pt,
-        kv_lens=np.full((B,), ctx + 1),
-        temperature=np.full(B, 0.7),
-        top_k=np.full(B, 40),
-        top_p=np.full(B, 0.95),
-    )
-    # engine decode path: fused multi-step bursts — one dispatch yields k
-    # tokens/seq, amortizing host<->device round trips exactly as LLMEngine
-    # serves
-    for _ in range(2):  # compile, then post-donation relayout (see above)
-        toks = runner.step_multi(dec, k)
-        np.asarray(toks)  # real fetch — block_until_ready is a no-op on axon
-    bursts = 16
-    t0 = time.perf_counter()
-    for _ in range(bursts):
-        toks = runner.step_multi(dec, k)
-    np.asarray(toks)
-    dt = time.perf_counter() - t0
-    decode_tps = B * k * bursts / dt
+    decode_points = {}
+    for B in sorted({min(16, decode_batch), decode_batch}):
+        pt = np.arange(B * ctx_pages).reshape(B, ctx_pages)
+        dec = StepInput(
+            input_ids=rng.randint(0, cfg.vocab_size, (B, 1)),
+            positions=np.full((B, 1), ctx),
+            page_table=pt,
+            kv_lens=np.full((B,), ctx + 1),
+            temperature=np.full(B, 0.7),
+            top_k=np.full(B, 40),
+            top_p=np.full(B, 0.95),
+        )
+        # engine decode path: fused multi-step bursts — one dispatch yields
+        # k tokens/seq, amortizing host<->device round trips exactly as
+        # LLMEngine serves
+        for _ in range(2):  # compile, then post-donation relayout (see above)
+            toks = runner.step_multi(dec, k)
+            np.asarray(toks)  # real fetch — block_until_ready no-ops on axon
+        bursts = 16
+        t0 = time.perf_counter()
+        for _ in range(bursts):
+            toks = runner.step_multi(dec, k)
+        np.asarray(toks)
+        dt = time.perf_counter() - t0
+        decode_points[B] = B * k * bursts / dt
+    B = decode_batch
+    decode_tps = decode_points[B]
 
     # --- long context (values-17 parity, 32k max_model_len): chunked prefill
     # of one 16k then 32k sequence in engine-style 1k chunks, plus a decode
@@ -225,6 +232,9 @@ def main() -> None:
         "decode_tokens_per_sec_per_chip": round(decode_tps, 1),
         "decode_batch": B,
         "decode_context": ctx + 1,
+        "decode_tokens_per_sec_by_batch": {
+            str(b): round(v, 1) for b, v in decode_points.items()
+        },
         "platform": platform,
         "model": model_desc,
     }
@@ -444,9 +454,10 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         # is excluded and what remains is the router/SSE per-chunk overhead
         # on top of the engine's decode rate
         # 384-token streams: the steady-state window (deep quiescent chains)
-        # dominates the ramp, which is what "steady-state decode" measures
+        # dominates the ramp, which is what "steady-state decode" measures.
+        # Concurrency = the engine's full seat count (its decode batch).
         dec_gen = 384 if on_tpu else 16
-        dec_conc = 16 if on_tpu else conc
+        dec_conc = 32 if on_tpu else conc
         def decode_request(_i, target=None):
             ttft, total, chunks = one_request(dec_gen, target=target, prompt_len=64)
             return ttft, total, chunks

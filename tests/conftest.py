@@ -20,6 +20,16 @@ if not os.environ.get("PSTPU_TEST_TPU"):
 # Persistent compilation cache: every engine test pays fresh jit compiles
 # otherwise, which is what kept the fast suite from finishing in CI time.
 # Repo-local so the first full run warms every later one.
+#
+# torch MUST be imported before the cache is enabled: loading it flips
+# XLA:CPU's LLVM tuning features (prefer-no-scatter/-gather) for every
+# compile AFTER the import, and the cache directory is scoped by a
+# writer-config hash computed at enable time (compile_cache.py
+# _cpu_feature_scope). A test importing torch mid-session would otherwise
+# write feature-flipped AOT entries into a dir whose readers don't expect
+# them — cpu_aot_loader then rejects (or worse, SIGILLs on) every load.
+import torch  # noqa: E402,F401
+
 from production_stack_tpu.utils.compile_cache import enable_persistent_cache  # noqa: E402
 
 enable_persistent_cache(

@@ -173,3 +173,44 @@ class TestDisaggPrefillDeviceTransfer:
         finally:
             producer.stop()
             consumer.stop()
+
+
+class TestDeviceTransferFallback:
+    """A broken device channel must degrade to TCP blobs per page — not fail
+    the transfer (the producer treats every device-path refusal/error as
+    'push the blob instead')."""
+
+    def test_dead_transfer_endpoint_falls_back_to_tcp(self):
+        consumer = LLMEngine(
+            _base(kv_role="consumer", kv_transfer_port=0, port=8331,
+                  kv_transfer_device=True)
+        )
+        consumer.start()
+        producer = LLMEngine(
+            _base(kv_role="producer", port=8330, kv_transfer_device=True,
+                  kv_peer_url=f"127.0.0.1:{consumer._kv_receiver.bound_port}")
+        )
+        producer.start()
+        try:
+            if producer._kv_sender._mh_addrs is None:
+                pytest.skip("transfer service unavailable")
+            # poison the producer's advertised endpoint address: consumer
+            # pulls will fail, every page must fall back to the blob path
+            producer._kv_sender._mh_addrs = ["127.0.0.1:1"]
+            prompt = "kv that must survive a dead device channel " * 3
+            _run(producer, prompt, "fb-1", 1)
+            assert producer._kv_sender.sent_chunks > 0, \
+                "pages must ship as TCP blobs when the device pull fails"
+            assert consumer._kv_receiver.received_chunks > 0
+            toks = _run(consumer, prompt, "fb-2", 8)
+            assert consumer.kv.offload_hits > 0
+            mono = LLMEngine(_base(port=8332))
+            mono.start()
+            try:
+                expected = _run(mono, prompt, "fb-mono", 8)
+            finally:
+                mono.stop()
+            assert toks == expected
+        finally:
+            producer.stop()
+            consumer.stop()
