@@ -260,7 +260,55 @@ def main() -> None:
             "vs_baseline": round(200.0 / p50_ttft, 3),
             "extras": extras,
         }
-    print(json.dumps(primary), flush=True)
+    emit_primary(primary)
+
+
+def emit_primary(primary: dict) -> None:
+    """Print the verbose payload first, then a FINAL metric line guaranteed
+    to fit the driver's tail-capture window.
+
+    The driver parses the LAST ~2,000 chars of stdout; round 4's final line
+    embedded full per-point hop breakdowns, overflowed that window, and the
+    official number was recorded as ``parsed: null``. The full payload now
+    goes to ``BENCH_DETAILS.json`` + an earlier stdout line; the final line
+    keeps only scalar extras and is hard-capped at 1,500 chars."""
+    details_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAILS.json"
+    )
+    try:
+        with open(details_path, "w") as f:
+            json.dump(primary, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps({"bench_details": primary}), flush=True)
+
+    extras = primary.get("extras", {})
+    compact_extras = {
+        k: v for k, v in extras.items()
+        if isinstance(v, (int, float, str, bool)) or v is None
+    }
+    # per-QPS sweep summary in minimal form (the full points live in details)
+    pts = extras.get("qa_points") or []
+    if pts:
+        compact_extras["qa_ttft_p50_by_qps"] = {
+            str(p["qps"]): p["p50_ttft_ms"] for p in pts
+        }
+        compact_extras["qa_admission_wait_p50_by_qps"] = {
+            str(p["qps"]): p["ttft_breakdown_ms"]
+            .get("engine.admission_wait", {}).get("p50")
+            for p in pts if p.get("ttft_breakdown_ms")
+        }
+    final = dict(primary, extras=compact_extras)
+    line = json.dumps(final)
+    # hard cap: drop extras keys (longest encoding first) until it fits
+    while len(line) > 1500 and compact_extras:
+        victim = max(
+            compact_extras, key=lambda k: len(json.dumps({k: compact_extras[k]}))
+        )
+        compact_extras.pop(victim)
+        final = dict(primary, extras=compact_extras)
+        line = json.dumps(final)
+    print(line, flush=True)
 
 
 def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
@@ -312,8 +360,20 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         # (scheduler.py) shortens chains under a live arrival stream, so
         # TTFT no longer pays for the chaining that decode throughput earns.
         cfg = EngineConfig(
-            model=model, host="127.0.0.1", port=eport, max_model_len=4096,
+            model=model, host="127.0.0.1", port=eport,
+            # max_model_len=32768: the SERVING config matches the reference's
+            # canonical kv-aware deployment (values-17-kv-aware.yaml:15 /
+            # helm/examples/values-32k-kv-aware.yaml) — every HTTP request in
+            # this run is admitted under a 32k context budget, and the QA
+            # phase's ~9k-token histories actually exercise it
+            max_model_len=32768 if on_tpu else 4096,
             max_num_seqs=32, kv_cache_memory_gb=4.0, prefill_chunk=1024,
+            # CPU offload tier: the QA phase's working set (~20 users x ~9k
+            # tokens) deliberately exceeds the 4 GB HBM KV budget, so evicted
+            # histories spill here and restore on the user's next round —
+            # the reference's LMCache CPU-offload story, measured end-to-end
+            kv_offload_cpu_gb=10.0 if on_tpu else 0.0,
+            kv_offload_max_io_pages=8 if on_tpu else 0,
             # QA arrival clusters put many short cached-prefix prefills in
             # the queue at once; batching 8 per dispatch halves the
             # RTT-bound dispatch count on the admission path
@@ -325,6 +385,8 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             # CPU jit ignores buffer donation, so pool updates copy the whole
             # pool per step — keep it small there; TPU updates are in-place
             num_pages=None if on_tpu else 2048,
+            # the per-phase hop windows below need POST /metrics/reset
+            enable_debug_endpoints=True,
         )
         engine_server, engine_runner = asyncio.run_coroutine_threadsafe(
             engine_api.serve(cfg), loop
@@ -334,7 +396,12 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             "--service-discovery", "static",
             "--static-backends", f"http://127.0.0.1:{eport}",
             "--static-models", model,
-            "--routing-logic", "roundrobin",
+            # prefixaware: the reference's canonical QA run routes on KV
+            # locality (run.sh kvaware setup); with one engine the routing
+            # decision is trivial but the trie lookup cost is real and on
+            # the TTFT path, so the headline pays for it honestly
+            "--routing-logic", "prefixaware",
+            "--enable-debug-endpoints",  # per-phase hop-window resets
         ])
         _, router_runner = asyncio.run_coroutine_threadsafe(
             router_app.serve(rargs), loop
@@ -440,13 +507,42 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         # Two rounds: ramp-up/down crosses several (batch, pages) buckets,
         # and any bucket left cold would compile (~20-40s on a tunneled
         # chip) inside the measured window
-        for _ in range(2):
+        def measure_stack_tps():
+            t0 = time.perf_counter()
             with cf.ThreadPoolExecutor(conc) as ex:
                 list(ex.map(lambda _i: one_request(gen), range(conc)))
-        t0 = time.perf_counter()
-        with cf.ThreadPoolExecutor(conc) as ex:
-            list(ex.map(lambda _i: one_request(gen), range(conc)))
-        stack_tps = conc * gen / (time.perf_counter() - t0)
+            return conc * gen / (time.perf_counter() - t0)
+
+        for _ in range(2):
+            measure_stack_tps()  # warm the concurrent batch shape buckets
+        sc0 = engine_counters()
+        stack_tps = measure_stack_tps()
+        sc1 = engine_counters()
+        # r3->r4 this number fell 36% when the phase's engine config widened
+        # (prefill_batch 4->8 among others); bisect the live scheduling knob
+        # in-process (same engine, same compiled programs otherwise) and
+        # attribute via dispatch counters so a future regression has a cause
+        # attached, not just a delta
+        stack_bisect = {}
+        if on_tpu:
+            sched = engine_server.engine.scheduler
+            orig_pb = sched.prefill_batch
+            try:
+                sched.prefill_batch = 4
+                measure_stack_tps()  # warm the B=4 bucket
+                stack_bisect["stack_tokens_per_sec_prefill_batch_4"] = round(
+                    measure_stack_tps(), 1
+                )
+            finally:
+                sched.prefill_batch = orig_pb
+        stack_disp = {
+            k.split(":")[1]: sc1.get(k, 0) - sc0.get(k, 0)
+            for k in (
+                "vllm:decode_dispatches_total",
+                "vllm:decode_chained_dispatches_total",
+                "vllm:runahead_prefill_dispatches_total",
+            )
+        }
 
         # steady-state decode THROUGH the stack: short prefill, long decode,
         # fixed concurrency at the engine's full decode batch; rate counts
@@ -487,7 +583,9 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             c1.get("vllm:decode_chained_dispatches_total", 0)
             - c0.get("vllm:decode_chained_dispatches_total", 0)
         )
+        out.update(stack_bisect)
         out.update({
+            "http_stack_dispatches": stack_disp,
             "http_stack_tokens_per_sec": round(stack_tps, 1),
             "http_decode_tokens_per_sec": round(float(sum(decode_rates)), 1),
             "http_decode_engine_direct_tokens_per_sec": round(
@@ -515,8 +613,20 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
 
         qa_points = []
         qa_err = None
-        users, rounds, answer_len = (32, 5, 100) if on_tpu else (4, 2, 8)
-        shared_words, hist_words = (150, 100) if on_tpu else (20, 10)
+        # Canonical workload SHAPE (reference multi-round-qa/run.sh:14-35:
+        # 320 users x 10 rounds, 1k shared prefix, 20k-token histories, KV
+        # pre-populated into CPU offload), scaled to one 1B chip: 15 users,
+        # ~1,200-word (~8.5k-token with the byte tokenizer) histories. The
+        # working set (~135k tokens by the last round) slightly exceeds the
+        # ~131k-token HBM budget, so cold histories spill to the CPU tier
+        # and restore on later rounds — offload engages and hit rate must
+        # survive the round-trips. Sizing note (measured): the axon tunnel
+        # moves ~10-40 MB/s, so a FULL 300 MB history round-trip is ~30 s —
+        # kv_offload_max_io_pages=8 bounds each spill/restore and the
+        # engine recomputes past the cap (~30x faster than restoring here);
+        # on PCIe-attached TPU hosts the cap would be 0 (unbounded).
+        users, rounds, answer_len = (15, 5, 100) if on_tpu else (4, 2, 8)
+        shared_words, hist_words = (150, 1200) if on_tpu else (20, 10)
 
         def run_qa(qps, n_users, n_rounds, ans):
             qa_args = qa_parse_args([
@@ -530,9 +640,15 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
                 "--user-history-len", str(hist_words),
                 "--round-gap", "1.0",
                 "--log-interval", "0",
+                # tails can hit a capped offload restore + recompute; record
+                # them as latency, not as failures
+                "--request-timeout", "600",
             ])
             mgr = UserSessionManager(qa_args)
-            return asyncio.run_coroutine_threadsafe(mgr.run(), loop).result(1800)
+            summary = asyncio.run_coroutine_threadsafe(
+                mgr.run(), loop
+            ).result(1800)
+            return summary, mgr
 
         # warmup: the QA workload reaches context lengths (and so page-table
         # width buckets) and batch shapes the earlier phases never touched;
@@ -544,12 +660,15 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             run_qa(8.0, users, max(1, rounds // 2), answer_len)
         except Exception:  # noqa: BLE001 - warmup is best-effort
             pass
-        for qps in ([1.0, 2.0] if on_tpu else [4.0]):
+        # >=3 points, the top one past saturation (~19 req/s of pure decode
+        # capacity falls to a few req/s once restores + new-turn prefills
+        # land on the same chip)
+        for qps in ([1.0, 2.0, 4.0] if on_tpu else [4.0]):
             try:
                 reset_hop_windows()
                 c0 = engine_counters()
                 t0 = time.perf_counter()
-                summary = run_qa(qps, users, rounds, answer_len)
+                summary, mgr = run_qa(qps, users, rounds, answer_len)
                 elapsed = time.perf_counter() - t0
                 if summary.completed == 0 or summary.p50_ttft != summary.p50_ttft:
                     raise RuntimeError(
@@ -565,6 +684,14 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
                     c1.get("vllm:gpu_prefix_cache_queries_total", 0)
                     - c0.get("vllm:gpu_prefix_cache_queries_total", 0)
                 )
+
+                def delta(name):
+                    return c1.get(name, 0) - c0.get(name, 0)
+
+                # served prompt length from the CLIENT's usage records (the
+                # engine's prompt_tokens_total counts computed chunks only,
+                # which caching makes tiny); evidences the >=8k histories
+                ptoks = [r.prompt_tokens for r in mgr.records if r.prompt_tokens]
                 qa_points.append({
                     "qps": qps,
                     "p50_ttft_ms": round(summary.p50_ttft * 1000, 2),
@@ -582,6 +709,21 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
                     "completed": summary.completed,
                     "failed": summary.failed,
                     "elapsed_s": round(elapsed, 1),
+                    # evidence the canonical shape actually ran: avg served
+                    # prompt length (history included) and the offload tier's
+                    # spill/restore traffic during THIS point
+                    "avg_prompt_tokens": (
+                        round(float(np.mean(ptoks))) if ptoks else 0
+                    ),
+                    "kv_offload_saved_pages": delta(
+                        "vllm:kv_offload_saved_pages_total"
+                    ),
+                    "kv_offload_loaded_pages": delta(
+                        "vllm:kv_offload_loaded_pages_total"
+                    ),
+                    "kv_offload_hit_pages": delta(
+                        "vllm:kv_offload_hit_pages_total"
+                    ),
                     "ttft_breakdown_ms": scrape_hops(),
                 })
             except Exception as e:  # noqa: BLE001 - record, keep other points
@@ -605,10 +747,28 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
                 "qa_users": users,
                 "qa_rounds": rounds,
                 "qa_answer_len": answer_len,
+                "qa_history_words": hist_words,
+                "qa_avg_prompt_tokens": head["avg_prompt_tokens"],
+                "qa_kv_offload_saved_pages": head["kv_offload_saved_pages"],
+                "qa_kv_offload_loaded_pages": head["kv_offload_loaded_pages"],
                 "qa_points": qa_points,
             })
         if qa_err:
             out["qa_error"] = qa_err
+
+        # ---- 32k serving proof: one >=16k-token prompt through the FULL
+        # stack (router -> api_server -> scheduler -> engine) under the
+        # max_model_len=32768 config — the reference SERVES maxModelLen 32000
+        # (values-17-kv-aware.yaml:15); ours must too, not just run 16k at
+        # the runner. Chunked admission: 16 x 1k prefill chunks.
+        if on_tpu:
+            try:
+                lc_ttft, lc_total, _ = one_request(8, prompt_len=16384)
+                lc_ttft2, _, _ = one_request(8, prompt_len=16384)
+                out["http_16k_ttft_ms"] = round(lc_ttft2 * 1000, 2)
+                out["http_16k_cold_ttft_ms"] = round(lc_ttft * 1000, 2)
+            except Exception as e:  # noqa: BLE001
+                out["http_16k_error"] = f"{type(e).__name__}: {e}"
         return out
     except Exception as e:  # noqa: BLE001 - fail-soft by design
         out["http_stack_error"] = f"{type(e).__name__}: {e}"

@@ -21,6 +21,7 @@
 #include <algorithm>
 #include <cerrno>
 #include <cstdlib>
+#include <cstring>
 #include <fstream>
 #include <functional>
 #include <string>
@@ -28,6 +29,8 @@
 
 #include "json.h"
 #include "k8s.h"
+
+extern char** environ;  // inherited child env for run_cmd's execve
 
 namespace op {
 
@@ -54,7 +57,10 @@ inline std::string b64_decode(const std::string& in) {
 }
 
 // run argv without a shell (no quoting/injection surface); extra_env entries
-// are set only in the child, so secrets never appear in /proc/*/cmdline.
+// are visible only to the child, so secrets never appear in
+// /proc/*/cmdline. The child env is built BEFORE fork as an envp array for
+// execve — setenv between fork and exec is not async-signal-safe (it
+// allocates) and deadlocks if another thread held the malloc lock at fork.
 // Returns exit code, -1 on spawn failure.
 inline int run_cmd(const std::vector<std::string>& argv,
                    const std::vector<std::pair<std::string, std::string>>&
@@ -63,12 +69,58 @@ inline int run_cmd(const std::vector<std::string>& argv,
   cargv.reserve(argv.size() + 1);
   for (const auto& a : argv) cargv.push_back(const_cast<char*>(a.c_str()));
   cargv.push_back(nullptr);
+  // inherited environment + extras, materialized pre-fork; inherited
+  // entries shadowed by an extra_env key are dropped (getenv returns the
+  // FIRST match, so appending alone would let a stale parent value win)
+  std::vector<std::string> env_store;
+  for (char** e = environ; *e != nullptr; e++) {
+    const char* eq = strchr(*e, '=');
+    std::string key = eq ? std::string(*e, eq - *e) : std::string(*e);
+    bool shadowed = false;
+    for (const auto& kv : extra_env)
+      if (kv.first == key) shadowed = true;
+    if (!shadowed) env_store.emplace_back(*e);
+  }
+  for (const auto& kv : extra_env)
+    env_store.push_back(kv.first + "=" + kv.second);
+  std::vector<char*> cenv;
+  cenv.reserve(env_store.size() + 1);
+  for (auto& s : env_store) cenv.push_back(const_cast<char*>(s.c_str()));
+  cenv.push_back(nullptr);
+  // resolve PATH pre-fork too (execve does no PATH search). Mirror execvp:
+  // a candidate must be an executable REGULAR file (a directory passes
+  // access(X_OK)), an empty PATH component means the cwd, a caller-supplied
+  // PATH in extra_env takes effect, and a search MISS fails (execvp never
+  // implicitly tries the bare name against the cwd).
+  std::string exe = argv.empty() ? "" : argv[0];
+  if (!exe.empty() && exe.find('/') == std::string::npos) {
+    const char* path = getenv("PATH");
+    std::string p = path ? path : "/usr/local/bin:/usr/bin:/bin";
+    for (const auto& kv : extra_env)
+      if (kv.first == "PATH") p = kv.second;
+    bool found = false;
+    size_t pos = 0;
+    while (pos <= p.size()) {
+      size_t end = p.find(':', pos);
+      if (end == std::string::npos) end = p.size();
+      std::string dir = p.substr(pos, end - pos);
+      if (dir.empty()) dir = ".";
+      std::string cand = dir + "/" + exe;
+      struct stat st{};
+      if (stat(cand.c_str(), &st) == 0 && S_ISREG(st.st_mode) &&
+          access(cand.c_str(), X_OK) == 0) {
+        exe = cand;
+        found = true;
+        break;
+      }
+      pos = end + 1;
+    }
+    if (!found) return -1;
+  }
   pid_t pid = fork();
   if (pid < 0) return -1;
   if (pid == 0) {
-    for (const auto& kv : extra_env)
-      setenv(kv.first.c_str(), kv.second.c_str(), 1);
-    execvp(cargv[0], cargv.data());
+    execve(exe.c_str(), cargv.data(), cenv.data());
     _exit(127);
   }
   int status = 0;
@@ -727,14 +779,18 @@ class Reconciler {
     if (!cr.at_path("metadata.deletionTimestamp").as_string().empty()) {
       json::Value body;
       body.set("lora_name", adapter);
-      auto pods = list_lora_pods(spec);
-      for (const auto& pod : pods["items"].as_array()) {
-        bool was_loaded = false;
-        for (const auto& lp : cr.at_path("status.loadedPods").as_array())
-          if (lp.as_string() == pod.at_path("metadata.name").as_string())
-            was_loaded = true;
-        if (was_loaded)
-          lora_post(pod, spec, "/v1/unload_lora_adapter", body);
+      // resolve status.loadedPods by NAME (GET each pod): filtering through
+      // the CURRENT label selector would skip a pod whose labels changed
+      // (or after spec.podLabelSelector was edited) and leave the adapter
+      // loaded after the finalizer clears
+      for (const auto& lp : cr.at_path("status.loadedPods").as_array()) {
+        try {
+          auto pod = kc_.get("", "v1", ns_, "pods", lp.as_string());
+          if (pod) lora_post(*pod, spec, "/v1/unload_lora_adapter", body);
+        } catch (const std::exception&) {
+          // pod unreachable/apiserver hiccup: best-effort — the pod restart
+          // loses in-memory adapters anyway
+        }
       }
       json::Value crcopy = cr;
       json::Array keep;

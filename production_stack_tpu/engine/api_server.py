@@ -35,6 +35,24 @@ logger = init_logger(__name__)
 # hop gauges this attributes stack tail latency to a stage.
 _ttft_hops: collections.deque = collections.deque(maxlen=2048)
 
+# Cumulative distributions backing the dashboard's TTFT / latency heatmap
+# panels (reference vllm-dashboard.json:34-1312); vLLM-compatible names and
+# bucket boundaries so those panel queries work unchanged.
+from production_stack_tpu.utils.metrics import (  # noqa: E402
+    LATENCY_BUCKETS,
+    TTFT_BUCKETS,
+    Histogram,
+)
+
+_ttft_hist = Histogram(
+    "vllm:time_to_first_token_seconds", TTFT_BUCKETS,
+    "Time to first token distribution",
+)
+_latency_hist = Histogram(
+    "vllm:e2e_request_latency_seconds", LATENCY_BUCKETS,
+    "End-to-end request latency distribution",
+)
+
 
 def _ttft_hop_quantiles() -> dict:
     if not _ttft_hops:
@@ -277,6 +295,9 @@ class EngineServer:
 
         emit("num_requests_running", "gauge", s["num_requests_running"])
         emit("num_requests_waiting", "gauge", s["num_requests_waiting"])
+        emit("num_requests_swapped", "gauge", s.get("num_requests_swapped", 0))
+        emit("num_preemptions_total", "counter",
+             s.get("num_preemptions_total", 0))
         emit("gpu_cache_usage_perc", "gauge", s["gpu_cache_usage_perc"])
         emit("gpu_prefix_cache_hit_rate", "gauge", s["gpu_prefix_cache_hit_rate"])
         emit("gpu_prefix_cache_hits_total", "counter", s["gpu_prefix_cache_hits_total"])
@@ -323,6 +344,9 @@ class EngineServer:
                     f'vllm:ttft_hop_{hop}_ms{{model_name="{m}",quantile="{q}"}} '
                     f"{round(v, 3)}"
                 )
+        # distribution histograms (dashboard TTFT/latency heatmap panels)
+        lines.extend(_ttft_hist.render(f'model_name="{m}"'))
+        lines.extend(_latency_hist.render(f'model_name="{m}"'))
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
     async def metrics_reset(self, request: web.Request) -> web.Response:
@@ -331,6 +355,8 @@ class EngineServer:
         gauges pool samples from differently-loaded phases. Counters and
         serving stats are untouched."""
         _ttft_hops.clear()
+        _ttft_hist.reset()
+        _latency_hist.reset()
         waits = getattr(self.engine, "admission_wait_ms", None)
         if waits is not None:
             waits.clear()
@@ -541,10 +567,14 @@ class EngineServer:
         gen = gens[0]
 
         if not stream:
+            t_first_box = [None]
+
             async def collect(i, g):
                 text, finish_reason, last = [], None, None
                 tok_ids, lp_entries = [], []
                 async for out in g:
+                    if t_first_box[0] is None:
+                        t_first_box[0] = time.perf_counter()
                     text.append(out.text_delta)
                     last = out
                     if out.logprobs is not None:
@@ -606,6 +636,9 @@ class EngineServer:
                     (_usage(l) or {}).get("completion_tokens", 0) for l in lasts if l
                 )
                 usage["total_tokens"] = usage["prompt_tokens"] + usage["completion_tokens"]
+            if t_first_box[0] is not None:
+                _ttft_hist.observe(t_first_box[0] - t_accept)
+            _latency_hist.observe(time.perf_counter() - t_accept)
             return web.json_response(
                 {
                     "id": oid,
@@ -740,6 +773,7 @@ class EngineServer:
                         (t_first_out - t_submit) * 1000,
                         (time.perf_counter() - t_first_out) * 1000,
                     ))
+                    _ttft_hist.observe(t_first_out - t_accept)
             if lasts[0] is not None:
                 usage = _usage(lasts[0])
                 if n > 1:
@@ -759,6 +793,7 @@ class EngineServer:
             for sid in sub_ids:
                 self.engine.abort(sid)
             raise
+        _latency_hist.observe(time.perf_counter() - t_accept)
         await resp.write_eof()
         return resp
 
@@ -1004,7 +1039,11 @@ class EngineServer:
         r.add_get("/version", self.version)
         r.add_get("/v1/models", self.models)
         r.add_get("/metrics", self.metrics)
-        r.add_post("/metrics/reset", self.metrics_reset)
+        if self.cfg.enable_debug_endpoints:
+            # state-mutating and unauthenticated — benchmark/debug runs only
+            # (wiping the hop-quantile sample windows corrupts live
+            # observability, so production servers don't register it)
+            r.add_post("/metrics/reset", self.metrics_reset)
         r.add_post("/tokenize", self.tokenize)
         r.add_post("/detokenize", self.detokenize)
         r.add_post("/v1/chat/completions", self.chat_completions)

@@ -60,8 +60,11 @@ class EngineConfig:
     speculative_ngram: int = 3
     enable_prefix_caching: bool = True
     enable_chunked_prefill: bool = True
-    # decode attention implementation, threaded into the model config:
-    # auto | xla | pallas | pallas_interpret (ModelRunner resolves "auto")
+    # attention implementation, threaded into the model config:
+    # auto | xla | pallas | pallas_prefill | pallas_interpret (ModelRunner
+    # resolves "auto"; "pallas" = the decode kernel, "pallas_prefill"
+    # additionally runs the EXPERIMENTAL chunked-prefill kernel — currently
+    # XLA-parity on v5e, models/llama.py)
     attn_impl: str = "auto"
     # tool-call extraction from chat completions (engine/tool_parser.py):
     # auto | hermes | json | off. The reference reaches this via vLLM's
@@ -98,6 +101,10 @@ class EngineConfig:
     distributed_process_id: Optional[int] = None    # default: hostname -N suffix
     worker_sync_port: int = 8477
     enable_sleep_mode: bool = False
+    # register unauthenticated state-mutating debug endpoints (POST
+    # /metrics/reset); benchmark and test harnesses only — a production
+    # server must not let any client wipe its observability windows
+    enable_debug_endpoints: bool = False
     # persistent XLA compilation cache directory (utils/compile_cache.py);
     # None resolves via $PSTPU_COMPILE_CACHE_DIR then ~/.cache. In K8s this
     # is a PVC (helm values.compileCache) so pod restarts start warm instead
@@ -112,6 +119,15 @@ class EngineConfig:
     lora_target_modules: str = "q_proj,k_proj,v_proj,o_proj"
     # KV offload (LMCache-equivalent) wiring
     kv_offload_cpu_gb: float = 0.0
+    # cap on pages moved per offload operation (one spill batch at eviction,
+    # one restore chain at prefix match); 0 = unbounded. On PCIe-attached
+    # hosts (~10-30 GB/s) unbounded is right; on network-attached chips
+    # (axon tunnel ~10-40 MB/s measured) a 9k-token history is ~300 MB and
+    # RECOMPUTING it (~9.7k tok/s chunked prefill) beats restoring it ~30x,
+    # so the cap bounds the engine-loop stall and the prefix recomputes past
+    # it. Spill overflow beyond the cap is dropped + reported evicted (the
+    # global KV index stays truthful).
+    kv_offload_max_io_pages: int = 0
     kv_offload_dir: Optional[str] = None
     kv_offload_disk_gb: float = 16.0
     kv_remote_url: Optional[str] = None

@@ -133,7 +133,10 @@ class LLMEngine:
                 self.runner, max_loras=cfg.max_loras, max_rank=cfg.max_lora_rank
             )
         self._offload = self._make_offload_connector(cfg)
-        self.kv = KVPageManager(num_pages, cfg.page_size, offload=self._offload)
+        self.kv = KVPageManager(
+            num_pages, cfg.page_size, offload=self._offload,
+            max_io_pages=cfg.kv_offload_max_io_pages,
+        )
         # disaggregated prefill (SURVEY.md §2.3): producer pushes finished
         # prefill KV to the decode peer; consumer receives into its store
         self._kv_sender = None
@@ -1215,7 +1218,8 @@ class LLMEngine:
                 self.runner.restore_params()
             self.runner.reset_kv()  # replicated in multi-host
             self.kv = KVPageManager(
-                self.kv.num_pages, self.kv.page_size, offload=self._offload
+                self.kv.num_pages, self.kv.page_size, offload=self._offload,
+                max_io_pages=self.cfg.kv_offload_max_io_pages,
             )
             self.scheduler.kv = self.kv
             self._sleeping = False
@@ -1232,6 +1236,8 @@ class LLMEngine:
         out = {
             "num_requests_running": self.scheduler.num_running(),
             "num_requests_waiting": self.scheduler.num_waiting(),
+            "num_requests_swapped": self.scheduler.num_swapped(),
+            "num_preemptions_total": self.scheduler.preemptions_total,
             "gpu_cache_usage_perc": self.kv.usage(),
             "gpu_prefix_cache_hits_total": self.kv.prefix_hits,
             "gpu_prefix_cache_queries_total": self.kv.prefix_queries,
@@ -1269,6 +1275,13 @@ class LLMEngine:
             out["kv_offload_device_loaded_pages_total"] = (
                 self._offload.device_loaded_pages
             )
+        ep = getattr(self.runner, "kv_endpoint", None)
+        if ep is not None:
+            # offer-retirement observability (transfer.py sweep): pinned HBM
+            # and the upper bound on unpulled-offer leaks
+            out["kv_transfer_pinned_offer_bytes"] = ep.pinned_offer_bytes()
+            out["kv_transfer_leaked_offers_total"] = ep.leaked_offers
+            out["kv_transfer_cap_evicted_offers_total"] = ep.cap_evicted_offers
         if self._offload is not None:
             o = self._offload.stats()
             out["kv_offload_hit_pages_total"] = self.kv.offload_hits

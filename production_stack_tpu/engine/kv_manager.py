@@ -56,9 +56,17 @@ class KVPageManager:
       and are evicted only when a fresh allocation needs them.
     """
 
-    def __init__(self, num_pages: int, page_size: int, offload=None):
+    def __init__(
+        self, num_pages: int, page_size: int, offload=None,
+        max_io_pages: int = 0,
+    ):
         self.num_pages = num_pages
         self.page_size = page_size
+        # per-operation offload I/O budget (pages); 0 = unbounded. See
+        # EngineConfig.kv_offload_max_io_pages: on slow host<->device links
+        # recompute beats restore past a few pages, and an uncapped spill
+        # batch stalls the engine loop for the whole fetch.
+        self.max_io_pages = max_io_pages
         self.pages = [PageInfo() for _ in range(num_pages)]
         self.free_list: list[int] = list(range(num_pages - 1, -1, -1))
         self.hash_to_page: dict[bytes, int] = {}
@@ -82,7 +90,7 @@ class KVPageManager:
     def allocate(self, n: int) -> Optional[list[int]]:
         if self.num_free() < n:
             return None
-        out = []
+        out, spill = [], []
         for _ in range(n):
             if self.free_list:
                 pid = self.free_list.pop()
@@ -91,11 +99,26 @@ class KVPageManager:
                 info = self.pages[pid]
                 if info.hash is not None:
                     if self.offload is not None:  # spill KV before slot reuse
-                        self.offload.save_page(pid, info.hash)
+                        spill.append((pid, info.hash))
                     self.hash_to_page.pop(info.hash, None)
                     info.hash = None
             self.pages[pid].ref_count = 1
             out.append(pid)
+        if spill:
+            # batched: one device fetch for the whole eviction set, not one
+            # ~100 ms host<->device round trip per page (connector.save_pages).
+            # Over budget, the OLDEST evictions spill — eviction order is
+            # free order, i.e. a sequence's HEAD pages first, and a prefix
+            # chain can only restore from its head (the tail past the cap
+            # recomputes, or re-shares if still in HBM). The rest are
+            # dropped + reported evicted so the global KV index stays
+            # truthful.
+            cap = self.max_io_pages
+            if cap and len(spill) > cap:
+                dropped = spill[cap:]
+                spill = spill[:cap]
+                self.offload.report_evict([h for _, h in dropped])
+            self.offload.save_pages(spill)
         return out
 
     def free(self, page_ids: Sequence[int]) -> None:
@@ -132,35 +155,94 @@ class KVPageManager:
             info.ref_count += 1
             shared.append(pid)
         if self.offload is not None:
-            # extend the match from the offload tiers: restore chunk-by-chunk
-            # into freshly allocated pages until the chain misses
-            for h in hashes[len(shared):]:
-                pid = self.hash_to_page.get(h)
-                if pid is not None:
-                    # chunk re-appeared in HBM further along the chain (e.g.
-                    # registered by a later request) — share it, don't restore
-                    info = self.pages[pid]
-                    if info.ref_count == 0:
-                        self.evictable.pop(pid, None)
-                    info.ref_count += 1
-                    shared.append(pid)
-                    continue
-                if not self.offload.has(h):
-                    break
-                got = self.allocate(1)
-                if got is None:
-                    break
-                pid = got[0]
-                if not self.offload.load_page(pid, h):
-                    self.free([pid])  # blob vanished between has() and get()
-                    break
-                info = self.pages[pid]
-                info.hash = h
-                self.hash_to_page[h] = pid
-                shared.append(pid)
-                self.offload_hits += 1
+            shared = self._extend_from_offload(hashes, shared)
         self.prefix_hits += len(shared)
         return shared, len(shared) * self.page_size
+
+    def _extend_from_offload(
+        self, hashes: list[bytes], shared: list[int]
+    ) -> list[int]:
+        """Extend an HBM prefix match from the offload tiers — BATCHED.
+
+        Plans the whole chain extension first (HBM re-shares interleaved with
+        tier restores), then restores every needed page through ONE
+        host->device upload + scatter per <=64 pages
+        (connector.load_pages). The per-page restore this replaces paid a
+        full host<->device round trip (~100 ms network-attached) per page —
+        an 8k-token history (128 pages) would have taken >10 s to restore.
+        """
+        # plan the longest contiguous extension: share pages already (back)
+        # in HBM, restore tier-resident ones; stop at the first miss
+        plan: list[tuple[bytes, Optional[int]]] = []  # (hash, pid | None)
+        n_restores = 0
+        for h in hashes[len(shared):]:
+            pid = self.hash_to_page.get(h)
+            if pid is not None:
+                # chunk re-appeared in HBM further along the chain (e.g.
+                # registered by a later request) — share it, don't restore.
+                # Ref it NOW so planning's own allocations can't evict it.
+                info = self.pages[pid]
+                if info.ref_count == 0:
+                    self.evictable.pop(pid, None)
+                info.ref_count += 1
+                plan.append((h, pid))
+            elif self.offload.has(h):
+                if self.max_io_pages and n_restores >= self.max_io_pages:
+                    # restore budget exhausted: truncate the chain here — on
+                    # a slow link the remaining prefix RECOMPUTES faster
+                    # than it restores (EngineConfig.kv_offload_max_io_pages).
+                    # Checked only when a restore is actually NEEDED: pages
+                    # still HBM-resident keep sharing for free above.
+                    break
+                plan.append((h, None))
+                n_restores += 1
+            else:
+                break
+        # allocate slots for every restore; shrink the plan from the tail
+        # until the allocation fits (dropping a share un-refs it)
+        restore_pids: list[int] = []
+        while plan:
+            n_restore = sum(1 for _, p in plan if p is None)
+            if n_restore == 0:
+                break
+            got = self.allocate(n_restore)
+            if got is not None:
+                restore_pids = got
+                break
+            h, pid = plan.pop()
+            if pid is not None:
+                self.free([pid])
+        n_restore = len(restore_pids)
+        restored = (
+            self.offload.load_pages(
+                list(zip(restore_pids, (h for h, p in plan if p is None)))
+            )
+            if n_restore
+            else 0
+        )
+        # stitch the final chain: a failed restore truncates it there;
+        # shares past the truncation un-ref, unused restore slots free
+        ri = 0
+        broke = False
+        for h, pid in plan:
+            if broke:
+                if pid is not None:
+                    self.free([pid])
+            elif pid is not None:
+                shared.append(pid)
+            elif ri < restored:
+                rp = restore_pids[ri]
+                ri += 1
+                info = self.pages[rp]
+                info.hash = h
+                self.hash_to_page[h] = rp
+                shared.append(rp)
+                self.offload_hits += 1
+            else:
+                broke = True
+        if ri < n_restore:
+            self.free(restore_pids[ri:])  # unhashed -> back to the free list
+        return shared
 
     def register_filled(
         self, tokens: Sequence[int], page_ids: Sequence[int], salt: bytes = b""

@@ -196,6 +196,8 @@ class ModelRunner:
         self._steps: dict[bool, Any] = {}  # want_logprobs -> jitted step
         self._set_page_fn = None  # built lazily in set_page
         self._get_page_fn = None  # built lazily in get_page (multi-host)
+        self._get_pages_fns = {}  # batched offload spill, per id-count bucket
+        self._set_pages_fns = {}  # batched offload restore
         self._last_hist = None    # device history after a burst (chaining)
         self._params_host = None  # host copy during sleep level 2
         self._encode = None       # built lazily in encode (pooled embeddings)
@@ -568,6 +570,64 @@ class ModelRunner:
             k, v = self._get_page_fn(self.k_pages, self.v_pages, jnp.int32(pid))
             return jax.device_get((k, v))
         return jax.device_get((self.k_pages[:, pid], self.v_pages[:, pid]))
+
+    def get_pages(self, pids: "list[int]"):
+        """Fetch N pages' K/V in ONE host round trip.
+
+        The per-page :meth:`get_page` costs a full host<->device round trip
+        (~100 ms on a network-attached chip); an eviction storm spilling a
+        long history page-by-page would stall the engine loop for seconds.
+        The page-id vector is bucketed to powers of two (padded by repeating
+        the last id — an extra gather lane, harmless) so the program count
+        stays bounded. Returns ``(ks, vs)``: per-page ``[L, page, KH, D]``
+        host arrays."""
+        n = len(pids)
+        bucket = 1
+        while bucket < n:
+            bucket <<= 1
+        ids = jnp.asarray(
+            np.asarray(list(pids) + [pids[-1]] * (bucket - n), np.int32)
+        )
+        fn = self._get_pages_fns.get(bucket)
+        if fn is None:
+            rep = NamedSharding(self.mesh, P())
+            fn = jax.jit(
+                lambda kp, vp, i: (kp[:, i], vp[:, i]),
+                out_shardings=(rep, rep),
+            )
+            self._get_pages_fns[bucket] = fn
+        k, v = jax.device_get(fn(self.k_pages, self.v_pages, ids))
+        return [k[:, i] for i in range(n)], [v[:, i] for i in range(n)]
+
+    def set_pages(self, pids: "list[int]", ks, vs) -> None:
+        """Write N pages in ONE host->device upload + one scatter program
+        (batched offload restore — see :meth:`get_pages` for why). ``ks``/
+        ``vs`` are per-page ``[L, page, KH, D]`` arrays. Padding duplicates
+        the last (id, data) lane, so the duplicate scatter rewrites the same
+        value — deterministic."""
+        n = len(pids)
+        bucket = 1
+        while bucket < n:
+            bucket <<= 1
+        ids = np.asarray(list(pids) + [pids[-1]] * (bucket - n), np.int32)
+        dt = self.k_pages.dtype
+        k = np.stack(list(ks) + [ks[-1]] * (bucket - n), axis=1)
+        v = np.stack(list(vs) + [vs[-1]] * (bucket - n), axis=1)
+        fn = self._set_pages_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(
+                lambda kp, vp, i, k, v: (
+                    kp.at[:, i].set(k), vp.at[:, i].set(v)
+                ),
+                donate_argnums=(0, 1),
+            )
+            self._set_pages_fns[bucket] = fn
+        rep = self._rep
+        kd = jax.device_put(jnp.asarray(k, dt), rep)
+        vd = jax.device_put(jnp.asarray(v, dt), rep)
+        self.k_pages, self.v_pages = fn(
+            self.k_pages, self.v_pages, jnp.asarray(ids), kd, vd
+        )
 
     def get_page_device(self, pid: int):
         """One page's K/V as SINGLE-DEVICE arrays (device 0), for the

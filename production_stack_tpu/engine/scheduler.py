@@ -167,6 +167,7 @@ class Scheduler:
         self.spec_ngram = max(1, spec_ngram)
         self.waiting: list[Sequence] = []
         self.running: list[Sequence] = []
+        self.preemptions_total = 0
         self._last_kind = "decode"  # prefill/decode alternation state
         # adaptive chain-depth inputs, refreshed by the engine loop each
         # iteration: recent request arrivals/sec and the measured per-burst
@@ -188,6 +189,16 @@ class Scheduler:
         self.decode_pipeline_cap = (
             min(16, self.decode_pipeline * 4) if self.decode_pipeline > 1 else 1
         )
+        # worst-case admission-wait budget: while admission is OPEN (free
+        # seats and pages), an arrival landing right after a chained dispatch
+        # cannot reach the device until the chain retires — run-ahead prefill
+        # only queues BEHIND the in-flight bursts. The expected-arrival cap
+        # below bounds the mean, not the tail: under sparse traffic
+        # (rate ~ 1/s) it allowed ~0.5 s chains, and an unlucky arrival ate
+        # the whole chain (measured qps-1.0 admission p50 443 ms — WORSE than
+        # qps 2.0). Cap (bursts-1)*burst_seconds by this budget whenever an
+        # arrival could actually start, so worst-case wait stays ~100 ms.
+        self.chain_wait_budget_s = 0.1
 
     # -- api ----------------------------------------------------------------
 
@@ -332,12 +343,21 @@ class Scheduler:
             # and drains the running set (and so the queue) ~bursts-fold
             # faster on fetch-RTT-bound hosts, which is what decides TTFT
             # under oversubscription (the multi-round-qa shape).
-            admission_blocked = len(self.running) >= self.max_num_seqs
+            # _try_admit just ran, so a non-empty waiting queue means its head
+            # is blocked — by seats OR by KV pages. Either way nothing new can
+            # reach the device until running work retires, which chaining
+            # accelerates; treat both as admission-blocked.
+            admission_blocked = (
+                len(self.running) >= self.max_num_seqs or bool(self.waiting)
+            )
+            # chaining engages regardless of queue state: an empty queue
+            # means nothing is delayed, and a non-empty one (post-_try_admit)
+            # means admission is blocked anyway — the wall-time cap below is
+            # what protects arrivals while admission is OPEN
             bursts = (
                 self.decode_pipeline
                 if (
-                    (not self.waiting or admission_blocked)
-                    and not prefilling  # a chain would delay the next chunk
+                    not prefilling  # a chain would delay the next chunk
                     and not self.spec_k
                     and self.decode_steps > 1
                     # penalties chain fine: the device history (updated
@@ -386,6 +406,17 @@ class Scheduler:
                     > 0.5
                 ):
                     bursts -= 1
+                # worst-case bound (not just expected): while an arrival
+                # COULD start immediately (free seats + pages), never chain
+                # deeper than the wait budget — the expected cap above lets
+                # sparse traffic (rate <= ~1/s) keep half-second chains, and
+                # whoever arrives mid-chain eats the remainder whole.
+                cap = 1 + max(
+                    1,
+                    int(self.chain_wait_budget_s
+                        / max(self.burst_seconds, 1e-4)),
+                )
+                bursts = min(bursts, cap)
             if bursts > 1:
                 # min_tokens: the EOS ban is fixed for everything one dispatch
                 # covers, so a chained dispatch could overshoot the floor by
@@ -610,9 +641,17 @@ class Scheduler:
         seq.pages = []
         seq.num_computed = 0
         seq.num_cached = 0
+        seq.preempted = True  # vllm:num_requests_swapped until re-admitted
+        self.preemptions_total += 1
         if seq in self.running:
             self.running.remove(seq)
         self.waiting.insert(0, seq)
+
+    def num_swapped(self) -> int:
+        """Preempted sequences parked in the waiting queue — the analogue of
+        vLLM's num_requests_swapped (ours drop/respill KV through the offload
+        tiers instead of a dedicated swap space)."""
+        return sum(1 for s in self.waiting if getattr(s, "preempted", False))
 
     # -- result application -------------------------------------------------
 

@@ -164,6 +164,96 @@ class KVOffloadConnector:
             logger.exception("kv offload save_page failed; dropping page %s", h.hex())
             self.report_evict([h])
 
+    def save_pages(self, pairs: "list[tuple[int, bytes]]") -> None:
+        """Offload a batch of HBM pages before their slots are reused —
+        ONE device fetch per <=64 pages instead of one per page (each fetch
+        is a full host<->device round trip on network-attached chips; an
+        eviction storm spilling a long history page-by-page would stall the
+        engine loop for seconds). Never raises (same engine-loop safety as
+        save_page)."""
+        todo = pairs
+        stored = 0  # prefix of `todo` safely in the store
+        try:
+            if not self.store.enabled():
+                self.report_evict([h for _, h in pairs])
+                return
+            # pages already offloaded (contains_local) stay OUT of the evict
+            # set on failure — their blobs still exist
+            todo = [
+                (pid, h) for pid, h in pairs
+                if not self.store.contains_local(h.hex())
+            ]
+            for i in range(0, len(todo), 64):
+                chunk = todo[i : i + 64]
+                ks, vs = self.runner.get_pages([pid for pid, _ in chunk])
+                for (pid, h), k, v in zip(chunk, ks, vs):
+                    blob = self.serde.serialize(np.asarray(k), np.asarray(v))
+                    self.store.put(h.hex(), blob)
+                    self.saved_pages += 1
+                    stored += 1
+        except Exception:
+            # evict ONLY what was neither already local nor stored before
+            # the failure; reporting stored pages evicted would poison the
+            # global KV index for chunks this instance actually holds
+            logger.exception("kv offload save_pages failed; dropping rest")
+            self.report_evict([h for _, h in todo[stored:]])
+
+    def load_pages(self, pairs: "list[tuple[int, bytes]]") -> int:
+        """Restore a batch of pages into HBM — one upload + one scatter
+        program per <=64 pages (see save_pages). Returns the length of the
+        successfully restored PREFIX of ``pairs``: a vanished/unreadable blob
+        truncates the chain there, matching the prefix-cache contract. Never
+        raises."""
+        done = 0
+        batch_ids: list[int] = []
+        batch_k: list = []
+        batch_v: list = []
+
+        def flush() -> bool:
+            nonlocal done
+            if not batch_ids:
+                return True
+            try:
+                self.runner.set_pages(batch_ids, batch_k, batch_v)
+            except Exception:
+                logger.exception("kv offload batched restore failed")
+                return False
+            done += len(batch_ids)
+            self.loaded_pages += len(batch_ids)
+            batch_ids.clear()
+            batch_k.clear()
+            batch_v.clear()
+            return True
+
+        for pid, h in pairs:
+            try:
+                if self.device_staging is not None and self.device_staging.contains(
+                    h.hex()
+                ):
+                    # staged device page: flush the host batch first so the
+                    # restored prefix stays in chain order, then inject
+                    # through the (device-to-device) single-page path
+                    if not flush():
+                        return done
+                    if not self.load_page(pid, h):
+                        return done
+                    done += 1
+                    continue
+                blob = self.store.get(h.hex())
+                if blob is None:
+                    break
+                k, v = serde_mod.deserialize(blob)
+                batch_ids.append(pid)
+                batch_k.append(k)
+                batch_v.append(v)
+                if len(batch_ids) >= 64 and not flush():
+                    return done
+            except Exception:
+                logger.exception("kv offload load_pages failed for %s", h.hex())
+                break
+        flush()
+        return done
+
     def has(self, h: bytes) -> bool:
         try:
             if self.device_staging is not None and self.device_staging.contains(h.hex()):

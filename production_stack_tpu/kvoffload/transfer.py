@@ -134,15 +134,26 @@ class KVTransferReceiver:
                     elif self.device_endpoint is not None and self.staging is not None:
                         addr, uuid = hdr["assignments"][0]
                         try:
-                            k_dev, v_dev = await asyncio.to_thread(
-                                self.device_endpoint.pull,
-                                addr, int(uuid), hdr["shape"], hdr["dtype"],
+                            # pull probes the producer address first: the
+                            # XLA transfer pull is lazy and would "succeed"
+                            # against a dead producer (hanging only on
+                            # first use, uninterruptibly) — the TCP blob
+                            # fallback contract needs the failure HERE
+                            k_dev, v_dev = await asyncio.wait_for(
+                                asyncio.to_thread(
+                                    self.device_endpoint.pull,
+                                    addr, int(uuid), hdr["shape"],
+                                    hdr["dtype"],
+                                ),
+                                timeout=15.0,
                             )
                             self.staging.put(key, k_dev, v_dev)
                             self.device_pages += 1
                             ok = True
-                        except Exception as e:  # noqa: BLE001
+                        except (Exception, asyncio.TimeoutError) as e:  # noqa: BLE001
                             self.staging.unreserve(key)
+                            if self.device_endpoint is not None:
+                                self.device_endpoint.mark_dead(addr)
                             logger.warning("device kv pull failed: %s", e)
                     await write_frame(writer, {"ok": ok})
                 elif op == "ping":
@@ -320,6 +331,16 @@ class DeviceKVEndpoint:
         self._lock = threading.Lock()
         self.offered_pages = 0
         self.pulled_pages = 0
+        # leak accounting: offers retired by TTL (the producer cannot tell a
+        # pulled offer from an abandoned one — no release handshake — so this
+        # is an UPPER BOUND on leaks; XLA's await_pull has no cancel, so an
+        # unpulled registration's device buffers outlive the dropped Python
+        # ref). Cap-evictions are counted separately: they indicate budget
+        # pressure, not age.
+        self.leaked_offers = 0
+        self.cap_evicted_offers = 0
+        self._dead_addrs: dict[str, float] = {}    # addr -> retry-after
+        self._probed_addrs: dict[str, float] = {}  # addr -> probe-valid-until
 
     # Retirement policy for fixed offers: there is no per-offer release
     # handshake (the consumer's ack proves only its LEADER pulled; its
@@ -333,6 +354,20 @@ class DeviceKVEndpoint:
     # idle producer pins at most its final ~120 s of transferred pages.
     OFFER_TTL = 120.0
     OFFER_CAP = 256
+    # size-aware budget: the count cap alone lets 256 fully-replicated pages
+    # pin GBs of HBM at realistic page sizes under sustained transfer; the
+    # byte cap retires oldest offers first once the pinned set crosses it
+    OFFER_BYTES_CAP = 256 << 20
+
+    @staticmethod
+    def _offer_bytes(entry: tuple) -> int:
+        k, v = entry[0], entry[1]
+        return int(getattr(k, "nbytes", 0)) + int(getattr(v, "nbytes", 0))
+
+    def pinned_offer_bytes(self) -> int:
+        """HBM currently pinned by live offers (per local device replica)."""
+        with self._lock:
+            return sum(self._offer_bytes(e) for e in self._offered.values())
 
     def sweep(self) -> None:
         import time as time_mod
@@ -341,8 +376,15 @@ class DeviceKVEndpoint:
         with self._lock:
             for u in [u for u, (_, _, d) in self._offered.items() if d < now]:
                 self._offered.pop(u)
-            while len(self._offered) > self.OFFER_CAP:
-                self._offered.pop(next(iter(self._offered)))
+                self.leaked_offers += 1
+            pinned = sum(self._offer_bytes(e) for e in self._offered.values())
+            while self._offered and (
+                len(self._offered) > self.OFFER_CAP
+                or pinned > self.OFFER_BYTES_CAP
+            ):
+                entry = self._offered.pop(next(iter(self._offered)))  # oldest
+                pinned -= self._offer_bytes(entry)
+                self.cap_evicted_offers += 1
 
     def offer_fixed(self, uuid: int, k_dev, v_dev) -> None:
         """Offer under a caller-chosen uuid (multi-host: the leader assigns
@@ -360,11 +402,62 @@ class DeviceKVEndpoint:
         self._server.await_pull(uuid, [k_dev, v_dev])
         self.offered_pages += 1
 
+    DEAD_ADDR_TTL = 60.0
+
+    def mark_dead(self, addr: str) -> None:
+        """Negative-cache a producer address after a failed/hung pull so
+        subsequent pages fail fast to the TCP blob path instead of each
+        eating a pull timeout (and leaking a blocked thread)."""
+        import time as time_mod
+
+        with self._lock:
+            self._dead_addrs[addr] = time_mod.monotonic() + self.DEAD_ADDR_TTL
+            self._conns.pop(addr, None)
+
+    PROBE_TTL = 30.0
+
+    def _probe_addr(self, addr: str) -> None:
+        """Fail fast on an unreachable producer. The XLA transfer pull is
+        LAZY: connect()+pull() against a dead address "succeed" and the
+        returned arrays only hang when first consumed — and that hang is not
+        interruptible from Python, so materialize-with-timeout cannot back a
+        fallback path either. A plain TCP probe catches the realistic
+        failure (producer pod gone) before any page is staged; probes cache
+        per address for PROBE_TTL."""
+        import socket
+        import time as time_mod
+
+        now = time_mod.monotonic()
+        with self._lock:
+            if self._probed_addrs.get(addr, 0.0) > now:
+                return
+        host, _, port = addr.rpartition(":")
+        try:
+            socket.create_connection((host or "127.0.0.1", int(port)),
+                                     timeout=3.0).close()
+        except OSError as e:
+            raise ConnectionError(f"kv producer {addr} unreachable: {e}") from e
+        with self._lock:
+            self._probed_addrs[addr] = now + self.PROBE_TTL
+
     def pull(self, addr: str, uuid: int, shape, dtype):
-        """Pull a page's (k, v) device arrays from the producer at ``addr``."""
+        """Pull a page's (k, v) device arrays from the producer at ``addr``.
+        The returned arrays are lazy; reachability is probed first (see
+        _probe_addr) so a dead producer raises here and the caller's TCP
+        blob fallback engages."""
+        import time as time_mod
+
         import jax
         import jax.numpy as jnp
 
+        with self._lock:
+            dead_until = self._dead_addrs.get(addr, 0.0)
+            if dead_until > time_mod.monotonic():
+                raise ConnectionError(
+                    f"kv producer {addr} marked dead until {dead_until:.0f}"
+                )
+            self._dead_addrs.pop(addr, None)
+        self._probe_addr(addr)
         with self._lock:
             conn = self._conns.get(addr)
             if conn is None:
@@ -417,6 +510,7 @@ class DeviceStaging:
         self._reserved: dict[str, tuple] = {}   # key -> (nbytes, deadline)
         self._bytes = 0
         self._lock = threading.Lock()
+        self._expire_q = None  # lazy single-worker on_expire queue
         self.expired_pages = 0
 
     @classmethod
@@ -441,12 +535,40 @@ class DeviceStaging:
         return expired_meta
 
     def _fire_expired(self, keys: list) -> None:
-        if self.on_expire is not None:
-            for k in keys:
-                try:
-                    self.on_expire(k)
-                except Exception:  # noqa: BLE001 - cleanup is best-effort
-                    logger.exception("staging on_expire(%s) failed", k)
+        """Queue on_expire for a single BACKGROUND worker. reserve()/
+        contains() run on the KV receiver's asyncio event loop (page_query
+        handler), and on_expire -> engine unstage blocks on the engine
+        device thread — up to ~2 min mid-deep-chain. Firing inline would
+        head-of-line-block every KV transfer connection behind one expiry;
+        a thread PER sweep would pile up unboundedly behind a wedged device
+        thread, so one worker drains a queue. The worker re-checks each key
+        under the lock right before firing: a page re-staged (or
+        re-reserved) while the callback sat queued must NOT have its fresh
+        copy dropped by a stale expiry."""
+        if self.on_expire is None or not keys:
+            return
+        with self._lock:
+            if self._expire_q is None:
+                import queue as queue_mod
+
+                self._expire_q = queue_mod.Queue()
+                threading.Thread(
+                    target=self._expire_worker, daemon=True,
+                    name="kv-staging-expire",
+                ).start()
+        for k in keys:
+            self._expire_q.put(k)
+
+    def _expire_worker(self) -> None:
+        while True:
+            k = self._expire_q.get()
+            with self._lock:
+                if k in self._pages or k in self._reserved:
+                    continue  # re-staged while the callback was queued
+            try:
+                self.on_expire(k)
+            except Exception:  # noqa: BLE001 - cleanup is best-effort
+                logger.exception("staging on_expire(%s) failed", k)
 
     def reserve(self, key: str, nbytes: int) -> str:
         """Atomically check-and-reserve budget for an incoming page.

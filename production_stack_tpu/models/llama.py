@@ -475,12 +475,31 @@ def forward(
         "lora_ids": lora_ids, "lora_scale": lora_scale,
     }
 
-    # pallas decode streams pages straight from the STACKED pools (layer
+    # pallas kernels stream pages straight from the STACKED pools (layer
     # index in scalar prefetch): slicing k_pages[l] per layer at the call
     # site would materialize a pool-sized copy every layer, since XLA cannot
-    # fuse a dynamic-slice into a pallas_call operand (~1.5 ms/step on v5e)
+    # fuse a dynamic-slice into a pallas_call operand (~1.5 ms/step on v5e).
+    # Decode (T == 1) streams on any mesh (sharded kernel); chunked prefill
+    # (T >= 16, post-write) streams single-device — multi-device prefill
+    # keeps the XLA/ring path (GSPMD cannot partition a pallas_call and the
+    # sp axis owns long chunks).
+    single_dev = mesh is None or mesh.devices.size == 1
+    # prefill kernel is OPT-IN (attn_impl="pallas_prefill") / interpret-test
+    # only: measured on v5e it only reaches parity with the XLA gather path
+    # (~67 ms vs ~68 ms attention at 16k ctx) — page-granular (64-slot)
+    # matmuls fragment the MXU, and prefill is compute-bound so the gather
+    # traffic the kernel saves is cheap there. A contiguous-KV variant
+    # (in-kernel DMA gather of N pages -> one wide matmul) is the path to a
+    # win; until then serving keeps XLA for chunks.
+    prefill_kernel_ok = (
+        T >= 16 and single_dev and sp == 1 and kv_burst is None
+        and cfg.attn_impl in ("pallas_prefill", "pallas_interpret")
+    )
     stream_pools = (
-        cfg.attn_impl.startswith("pallas") and T == 1 and pp == 1 and post_write
+        cfg.attn_impl.startswith("pallas")
+        and pp == 1
+        and post_write
+        and (T == 1 or prefill_kernel_ok)
     )
 
     def layer(x_aux, layer_in):
@@ -568,6 +587,30 @@ def forward(
                     q[:, 0], *pool_args, aux["page_table"], aux["kv_lens"],
                     **pallas_kw,
                 )[:, None]
+        elif (
+            Tm > 1
+            and cfg.attn_impl.startswith("pallas")
+            and stream_pools
+            and not burst
+        ):
+            # chunked prefill: pallas flash kernel streams pages HBM->VMEM
+            # (no [B, S, KH, D] pool gather) and folds the chunk's own K/V
+            # in-register — the XLA scan ran at <20% MFU at 16k context
+            # (ops/pallas/prefill_attention.py)
+            from production_stack_tpu.ops.pallas.prefill_attention import (
+                ragged_paged_attention_prefill,
+            )
+
+            pool_dt = k_pages.dtype
+            attn = ragged_paged_attention_prefill(
+                q, k_pages, v_pages, aux["page_table"], aux["positions"],
+                aux["kv_lens"],
+                k.astype(pool_dt), v.astype(pool_dt),
+                jnp.sum(aux["positions"] >= 0, axis=1).astype(jnp.int32),
+                window=cfg.sliding_window,
+                interpret=cfg.attn_impl == "pallas_interpret",
+                layer=li,
+            )
         else:
             kc, vc = gather_kv_pages(kp, vp, aux["page_table"])
             if burst:

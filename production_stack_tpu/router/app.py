@@ -370,6 +370,14 @@ class RouterApp:
             lines.append(f"# TYPE {name} gauge")
             for q, v in qs.items():
                 lines.append(f'{name}{{quantile="{q}"}} {round(v, 3)}')
+        # TTFT / e2e-latency distribution histograms (dashboard heatmaps)
+        from production_stack_tpu.router.request_service import (
+            latency_hist,
+            ttft_hist,
+        )
+
+        lines.extend(ttft_hist.render('source="router"'))
+        lines.extend(latency_hist.render('source="router"'))
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
     async def metrics_reset(self, request: web.Request) -> web.Response:
@@ -474,7 +482,9 @@ class RouterApp:
         r.add_get("/v1/models", self.models)
         r.add_get("/health", self.health)
         r.add_get("/metrics", self.metrics)
-        r.add_post("/metrics/reset", self.metrics_reset)
+        if getattr(self.args, "enable_debug_endpoints", False):
+            # state-mutating and unauthenticated — benchmark/debug runs only
+            r.add_post("/metrics/reset", self.metrics_reset)
         r.add_get("/engines", self.engines)
         r.add_get("/version", self.version)
         r.add_post("/v1/files", self.upload_file)

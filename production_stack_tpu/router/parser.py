@@ -69,6 +69,9 @@ def parse_args(argv=None):
     p.add_argument("--request-stats-window", type=float, default=60.0)
     p.add_argument("--log-stats", action="store_true")
     p.add_argument("--log-stats-interval", type=float, default=10.0)
+    # unauthenticated state-mutating debug endpoints (POST /metrics/reset);
+    # benchmark/test harnesses only
+    p.add_argument("--enable-debug-endpoints", action="store_true")
     p.add_argument("--dynamic-config-json", type=str, default=None)
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-path", type=str, default="/tmp/tpu_router_files")

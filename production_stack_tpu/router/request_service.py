@@ -39,10 +39,28 @@ _client_session: Optional[aiohttp.ClientSession] = None
 # tail latency is attributable to a stage, not just "the stack".
 _hop_samples: collections.deque = collections.deque(maxlen=2048)
 
+# Router-observed TTFT / e2e latency distributions (reference dashboard's
+# heatmap panels; vLLM-compatible names + buckets — utils/metrics.py)
+from production_stack_tpu.utils.metrics import (  # noqa: E402
+    LATENCY_BUCKETS,
+    TTFT_BUCKETS,
+    Histogram,
+)
+
+ttft_hist = Histogram(
+    "vllm:time_to_first_token_seconds", TTFT_BUCKETS,
+    "Time to first token distribution (router-observed)",
+)
+latency_hist = Histogram(
+    "vllm:e2e_request_latency_seconds", LATENCY_BUCKETS,
+    "End-to-end request latency distribution (router-observed)",
+)
+
 
 def record_hop_sample(recv_to_route: float, route_to_connect: float,
                       connect_to_first: float) -> None:
     _hop_samples.append((recv_to_route, route_to_connect, connect_to_first))
+    ttft_hist.observe((recv_to_route + route_to_connect + connect_to_first) / 1000)
 
 
 def reset_hop_samples() -> None:
@@ -50,6 +68,8 @@ def reset_hop_samples() -> None:
     scrapes then resets, so each phase's quantiles describe THAT phase's
     requests instead of pooling across differently-loaded phases."""
     _hop_samples.clear()
+    ttft_hist.reset()
+    latency_hist.reset()
 
 
 def get_hop_quantiles() -> dict:
@@ -147,6 +167,9 @@ async def process_request(
                     captured.append(chunk)
                 await resp.write(chunk)
             await resp.write_eof()
+            latency_hist.observe(
+                time.perf_counter() - (ts_recv or t_route)
+            )
             if capture_body is not None:
                 await capture_body(backend_resp.status, b"".join(captured))
             return resp

@@ -1,0 +1,55 @@
+"""Doc-rot guard for MEASURED NUMBERS (round 4 verdict: docs quoted a run
+that wasn't the official artifact). The numbers tables in README.md and
+docs/benchmarking.md are generated blocks; this test re-renders them from the
+checked-in BENCH_DETAILS.json and fails on any disagreement."""
+
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir)
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+import update_bench_docs as ubd  # noqa: E402
+
+
+def test_docs_numbers_match_artifact():
+    details_path = os.path.join(ROOT, "BENCH_DETAILS.json")
+    if not os.path.exists(details_path):
+        pytest.skip("no BENCH_DETAILS.json checked in yet")
+    with open(details_path) as f:
+        block = ubd.render_block(json.load(f))
+    for rel in ubd.DOC_PATHS:
+        with open(os.path.join(ROOT, rel)) as f:
+            text = f.read()
+        assert ubd.START in text and ubd.END in text, f"{rel}: markers missing"
+        start = text.index(ubd.START)
+        end = text.index(ubd.END) + len(ubd.END)
+        assert text[start:end] == block, (
+            f"{rel}: measured-numbers block is stale — run "
+            "`python scripts/update_bench_docs.py` after bench.py and commit "
+            "both the docs and BENCH_DETAILS.json"
+        )
+
+
+def test_render_block_is_deterministic():
+    details = {
+        "value": 123.4,
+        "extras": {
+            "qa_qps": 2.0, "qa_tokens_per_sec_per_chip": 400.0,
+            "qa_kv_hit_rate": 0.95, "qa_users": 20, "qa_rounds": 5,
+            "qa_history_words": 1200, "qa_avg_prompt_tokens": 9000,
+            "qa_kv_offload_saved_pages": 10, "qa_kv_offload_loaded_pages": 5,
+            "qa_points": [{"qps": 1.0, "p50_ttft_ms": 150.0},
+                          {"qps": 2.0, "p50_ttft_ms": 123.4}],
+            "platform": "tpu", "model": "llama-3.2-1b-class",
+            "decode_tokens_per_sec_by_batch": {"16": 1500.0, "32": 1900.0},
+        },
+    }
+    b1 = ubd.render_block(details)
+    b2 = ubd.render_block(json.loads(json.dumps(details)))
+    assert b1 == b2
+    assert b1.startswith(ubd.START) and b1.endswith(ubd.END)
+    assert "123" in b1 and "1,900" in b1

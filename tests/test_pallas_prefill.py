@@ -1,0 +1,160 @@
+"""Pallas flash prefill kernel vs the XLA oracle (ops/attention.flash_attention
+over gathered pages + stale_kv_positions — the write-after-attend contract)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from production_stack_tpu.ops.attention import (
+    flash_attention,
+    gather_kv_pages,
+    stale_kv_positions,
+)
+from production_stack_tpu.ops.pallas.prefill_attention import (
+    ragged_paged_attention_prefill,
+)
+
+
+def _case(B=2, T=32, NH=8, KH=2, D=64, page=8, P=64, maxp=8, seed=0,
+          dtype=jnp.float32, computed=(8, 16)):
+    """Chunked-prefill shapes: each row has ``computed[b]`` tokens already in
+    the pool and a chunk of up to T fresh tokens in-register."""
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, T, NH, D), dtype)
+    kp = jnp.asarray(rng.randn(P, page, KH, D), dtype)
+    vp = jnp.asarray(rng.randn(P, page, KH, D), dtype)
+    k_cur = jnp.asarray(rng.randn(B, T, KH, D), dtype)
+    v_cur = jnp.asarray(rng.randn(B, T, KH, D), dtype)
+    pt = jnp.asarray(
+        rng.choice(P, (B * maxp), replace=False).reshape(B, maxp), jnp.int32
+    )
+    positions = np.full((B, T), -1, np.int32)
+    chunks = []
+    for b in range(B):
+        c = T - 4 * b  # ragged chunk sizes
+        chunks.append(c)
+        positions[b, :c] = np.arange(computed[b], computed[b] + c)
+    kv_lens = jnp.asarray(
+        [computed[b] + chunks[b] for b in range(B)], jnp.int32
+    )
+    cur_lens = jnp.asarray(chunks, jnp.int32)
+    return q, kp, vp, pt, jnp.asarray(positions), kv_lens, k_cur, v_cur, cur_lens
+
+
+def _oracle(q, kp, vp, pt, positions, kv_lens, k_cur, v_cur, window=None,
+            softcap=None):
+    page = kp.shape[1]
+    kc, vc = gather_kv_pages(kp, vp, pt)
+    kv_pos = stale_kv_positions(pt, positions, page)
+    k = jnp.concatenate([kc, k_cur.astype(kc.dtype)], axis=1)
+    v = jnp.concatenate([vc, v_cur.astype(vc.dtype)], axis=1)
+    return flash_attention(
+        q, k, v, q_positions=positions, kv_lens=kv_lens,
+        window=window, kv_positions=kv_pos,
+    )
+
+
+class TestPrefillKernelVsOracle:
+    def test_ragged_chunks_with_history(self):
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case()
+        ref = _oracle(q, kp, vp, pt, pos, lens, kc, vc)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True, q_block=16
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_no_history_first_chunk(self):
+        """computed=0: everything is in-register, pool contributes nothing."""
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case(computed=(0, 0), seed=1)
+        ref = _oracle(q, kp, vp, pt, pos, lens, kc, vc)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True, q_block=16
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_deep_history_multiple_page_blocks(self):
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case(
+            B=2, T=16, maxp=8, page=8, computed=(40, 64), seed=2
+        )
+        ref = _oracle(q, kp, vp, pt, pos, lens, kc, vc)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl,
+            interpret=True, q_block=8, pages_per_block=2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_padded_rows_zero(self):
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case(seed=3)
+        # row 1 fully padded (no valid chunk tokens)
+        pos = pos.at[1].set(-1)
+        cl = cl.at[1].set(0)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True, q_block=16
+        )
+        assert not np.any(np.isnan(np.asarray(out)))
+        np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
+
+    def test_sliding_window(self):
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case(seed=4, computed=(16, 24))
+        ref = _oracle(q, kp, vp, pt, pos, lens, kc, vc, window=12)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, window=12,
+            interpret=True, q_block=16,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_logit_softcap(self):
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case(seed=5)
+        ref = flash_attention(
+            q,
+            jnp.concatenate(
+                [gather_kv_pages(kp, vp, pt)[0], kc], axis=1
+            ),
+            jnp.concatenate(
+                [gather_kv_pages(kp, vp, pt)[1], vc], axis=1
+            ),
+            q_positions=pos, kv_lens=lens, logit_softcap=30.0,
+            kv_positions=stale_kv_positions(pt, pos, kp.shape[1]),
+        )
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, logit_softcap=30.0,
+            interpret=True, q_block=16,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_bf16(self):
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case(dtype=jnp.bfloat16, seed=6)
+        ref = _oracle(q, kp, vp, pt, pos, lens, kc, vc)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True, q_block=16
+        )
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2, rtol=3e-2,
+        )
+
+    def test_stacked_pools_layer_index(self):
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case(seed=7)
+        L = 3
+        rng = np.random.RandomState(8)
+        kps = jnp.asarray(rng.randn(L, *kp.shape), kp.dtype)
+        vps = jnp.asarray(rng.randn(L, *vp.shape), vp.dtype)
+        for lyr in (0, 2):
+            ref = _oracle(q, kps[lyr], vps[lyr], pt, pos, lens, kc, vc)
+            out = ragged_paged_attention_prefill(
+                q, kps, vps, pt, pos, lens, kc, vc, cl,
+                interpret=True, q_block=16, layer=lyr,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+            )
