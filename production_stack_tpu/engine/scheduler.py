@@ -410,7 +410,15 @@ class Scheduler:
                 # COULD start immediately (free seats + pages), never chain
                 # deeper than the wait budget — the expected cap above lets
                 # sparse traffic (rate <= ~1/s) keep half-second chains, and
-                # whoever arrives mid-chain eats the remainder whole.
+                # whoever arrives mid-chain eats the remainder whole. The
+                # floor is ONE extra burst even when a single burst exceeds
+                # the budget (long-context decode can run ~0.5 s/burst):
+                # chained dispatches are what enable run-ahead prefill
+                # (engine._runahead_prefills), which starts an arrival's
+                # prefill — and emits its first token — DURING the chain, so
+                # a 2-burst chain beats an unchained burst of the same
+                # length for exactly the arrival this cap protects. The
+                # enforced worst case is max(budget, one extra burst).
                 cap = 1 + max(
                     1,
                     int(self.chain_wait_budget_s

@@ -218,7 +218,13 @@ def ragged_paged_attention_decode(
         k_cur = k_cur[:, None]  # [B, KH, D] -> C=1 window
         v_cur = v_cur[:, None]
     if pages_per_block is None:
-        pages_per_block = max(1, min(128 // page_size, max_pages))
+        # ~128 KV slots per cell for the short-context buckets this was
+        # tuned on; long-context buckets (>=128 pages, e.g. 9k-token QA
+        # histories in a 256-page bucket) quadruple the cell count and the
+        # per-cell pipeline overhead was measured dominating the step
+        # (~40 ms/step at B=32 x 256 pages) — target ~512 slots there
+        target = 512 if max_pages >= 128 else 128
+        pages_per_block = max(1, min(target // page_size, max_pages))
     N = max(1, min(pages_per_block, max_pages))
     n_blocks = -(-max_pages // N)
     win = (

@@ -222,6 +222,14 @@ def ragged_paged_attention_prefill(
         pad = n_qb * TQ - T
         q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
         positions = jnp.pad(positions, ((0, 0), (0, pad)), constant_values=-1)
+    # pad the chunk operands to a whole number of CB=128 fold sub-blocks
+    # (padded entries carry cpos=-1 -> invisible); without this the kernel's
+    # fori over C // CB would silently drop the tail of a non-multiple chunk
+    CB = 128
+    if T % CB:
+        cpad = CB - T % CB
+        k_cur = jnp.pad(k_cur, ((0, 0), (0, cpad), (0, 0), (0, 0)))
+        v_cur = jnp.pad(v_cur, ((0, 0), (0, cpad), (0, 0), (0, 0)))
     win = (
         jnp.full((1,), 2**30, jnp.int32)
         if window is None
@@ -229,12 +237,18 @@ def ragged_paged_attention_prefill(
     )
     lyr = jnp.asarray(layer, jnp.int32).reshape(1)
     cl = jnp.asarray(cur_lens, jnp.int32)
+    Cp = k_cur.shape[1]  # CB-padded chunk length
     # chunk entry positions: entry j sits at positions[b, j] (valid j <
-    # cur_lens); reuse the UNPADDED positions for the chunk operand
-    cpos = jnp.where(
-        lax.broadcasted_iota(jnp.int32, (B, T), 1) < cl[:, None],
-        jnp.where(positions[:, :T] >= 0, positions[:, :T], -1),
-        -1,
+    # cur_lens); padded entries (incl. the CB-alignment tail) carry -1 and
+    # are invisible to the fold
+    cpos = jnp.full((B, Cp), -1, jnp.int32)
+    cpos = cpos.at[:, :T].set(
+        jnp.where(
+            (lax.broadcasted_iota(jnp.int32, (B, T), 1) < cl[:, None])
+            & (positions[:, :T] >= 0),
+            positions[:, :T],
+            -1,
+        )
     )
 
     def kv_index(i):
@@ -263,9 +277,9 @@ def ragged_paged_attention_prefill(
         ]
         operands += [k_pages, v_pages]
     in_specs += [
-        pl.BlockSpec((1, T, KH, D), crow),
-        pl.BlockSpec((1, T, KH, D), crow),
-        pl.BlockSpec((1, T), crow2),
+        pl.BlockSpec((1, Cp, KH, D), crow),
+        pl.BlockSpec((1, Cp, KH, D), crow),
+        pl.BlockSpec((1, Cp), crow2),
     ]
     operands += [k_cur, v_cur, cpos]
 

@@ -48,11 +48,15 @@ from production_stack_tpu.utils.metrics import (  # noqa: E402
 )
 
 ttft_hist = Histogram(
-    "vllm:time_to_first_token_seconds", TTFT_BUCKETS,
+    # vllm_router: namespace, NOT vllm: — a Prometheus scraping both router
+    # and engine would otherwise double-count every request in the
+    # dashboard's distribution heatmaps (each request is observed once by
+    # each server under the same series name)
+    "vllm_router:time_to_first_token_seconds", TTFT_BUCKETS,
     "Time to first token distribution (router-observed)",
 )
 latency_hist = Histogram(
-    "vllm:e2e_request_latency_seconds", LATENCY_BUCKETS,
+    "vllm_router:e2e_request_latency_seconds", LATENCY_BUCKETS,
     "End-to-end request latency distribution (router-observed)",
 )
 

@@ -41,6 +41,8 @@ def test_doc_flags_exist():
         "--enable-autoscaling",
         # reference vLLM flags, quoted when contrasting with our design
         "--distributed-executor-backend", "--enable-auto-tool-choice",
+        # pytest flags quoted in the README dev section
+        "--durations",
     }
     missing = {}
     pages = (

@@ -89,6 +89,20 @@ class TestPrefillKernelVsOracle:
             np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
         )
 
+    def test_chunk_not_multiple_of_fold_block(self):
+        """T=160 (not a multiple of the kernel's 128-wide fold sub-block):
+        the tail entries must still fold — regression for a silent drop."""
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case(
+            B=2, T=160, maxp=8, page=8, computed=(8, 16), seed=9
+        )
+        ref = _oracle(q, kp, vp, pt, pos, lens, kc, vc)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True, q_block=32
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
     def test_padded_rows_zero(self):
         q, kp, vp, pt, pos, lens, kc, vc, cl = _case(seed=3)
         # row 1 fully padded (no valid chunk tokens)
