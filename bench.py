@@ -18,11 +18,17 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts")
+)
+import trace_report  # noqa: E402  (scripts/trace_report.py)
 
 
 def main() -> None:
@@ -419,7 +425,37 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         engine_url = f"http://127.0.0.1:{eport}/v1/completions"
         rng = np.random.RandomState(7)
 
+        def settle_traces() -> None:
+            """The router records its root span in the handler's finally
+            block, which can run AFTER the client finishes reading the
+            stream; wait until the collector stops growing so scrapes and
+            resets see a complete phase window (no missing roots, no
+            stragglers leaking past a reset)."""
+            last = -1
+            for _ in range(20):
+                cur = requests.get(
+                    f"http://127.0.0.1:{rport}/v1/traces?limit=1", timeout=30
+                ).json()["recorded_total"]
+                if cur == last:
+                    return
+                last = cur
+                time.sleep(0.05)
+
+        def scrape_traces() -> dict:
+            """Merged trace export for the CURRENT phase window (router +
+            engine share the span collector in this co-hosted topology, but
+            merge_exports dedupes, so this also works against split pods)."""
+            settle_traces()
+            merged = trace_report.merge_exports(*(
+                requests.get(
+                    f"http://127.0.0.1:{port}/v1/traces?limit=400", timeout=30
+                ).json()
+                for port in (rport, eport)
+            ))
+            return merged
+
         def reset_hop_windows():
+            settle_traces()
             for port in (rport, eport):
                 requests.post(
                     f"http://127.0.0.1:{port}/metrics/reset", timeout=30
@@ -490,8 +526,17 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         # scrape BEFORE the engine-direct contrast requests so the hop
         # quantiles describe exactly the routed requests measured above
         ttft_breakdown = scrape_hops()
+        # per-phase attribution from the SAME routed requests' traces
+        # (router.request > routing/proxy > engine queue/prefill/decode):
+        # self-times sum to the root span, so transport/proxy overhead shows
+        # up as a phase instead of an unexplained residue
+        ttft_traces = scrape_traces()
+        ttft_attr = trace_report.phase_table(ttft_traces)
         eng_ttfts = [one_request(16, engine_url)[0] * 1000 for _ in range(n_reqs)]
         out.update({
+            "ttft_phase_attribution": ttft_attr["phases"],
+            "ttft_trace_e2e_p50_ms": ttft_attr["e2e_p50_ms"],
+            "ttft_trace_leaf_coverage_p50": ttft_attr["leaf_coverage_p50"],
             "http_p50_ttft_ms": round(float(np.percentile(ttfts, 50)), 2),
             "http_p99_ttft_ms": round(float(np.percentile(ttfts, 99)), 2),
             # engine-server-direct TTFT baseline; router overhead is
@@ -575,6 +620,9 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             return ttft, total, chunks
         with cf.ThreadPoolExecutor(dec_conc) as ex:  # warm the bucket
             list(ex.map(decode_request, range(dec_conc)))
+        # fresh trace window: the engine-side attribution below must describe
+        # ONLY the measured run (the warm run's spans would pollute it)
+        reset_hop_windows()
         c0 = engine_counters()
         with cf.ThreadPoolExecutor(dec_conc) as ex:
             res = list(ex.map(decode_request, range(dec_conc)))
@@ -582,24 +630,24 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
         decode_rates = [
             (dec_gen - 1) / (total - ttft) for ttft, total, _ in res if total > ttft
         ]
-        # same phase direct against the engine server: splits the gap to the
-        # runner-loop rate into (engine serving loop + SSE) vs (router proxy).
-        # Warm once + best-of-2: this phase previously committed a single
-        # cold/unlucky window (235 tok/s vs 1,788 the run before) as the
-        # official engine-direct number
-        def direct_sum():
-            with cf.ThreadPoolExecutor(dec_conc) as ex:
-                dres = list(ex.map(
-                    lambda i: decode_request(i, target=engine_url),
-                    range(dec_conc),
-                ))
-            return float(sum(
-                (dec_gen - 1) / (total - ttft)
-                for ttft, total, _ in dres if total > ttft
-            ))
-
-        direct_sum()  # warm the engine-direct connection pool/buckets
-        direct_tps = max(direct_sum(), direct_sum())
+        # Engine-side contrast from the SAME requests' traces — no second
+        # measurement pass. The old engine-direct pass (fresh per-thread TCP
+        # connections from a sync client) intermittently read 235-276 tok/s
+        # against a routed 1,800+ — physically impossible as an attribution;
+        # the engine.decode spans time the identical streams at the engine,
+        # so the routed number and its contrast can no longer disagree about
+        # which side the time went to.
+        dec_traces = scrape_traces()
+        dec_spans = [
+            s for spans in dec_traces.values() for s in spans
+            if s["name"] == "engine.decode" and s.get("duration_ms", 0) > 0
+        ]
+        traced_engine_tps = float(sum(
+            (s.get("attrs", {}).get("output_tokens", 1) - 1)
+            / (s["duration_ms"] / 1000.0)
+            for s in dec_spans
+        ))
+        decode_attr = trace_report.phase_table(dec_traces)
         total_disp = (
             c1.get("vllm:decode_dispatches_total", 0)
             - c0.get("vllm:decode_dispatches_total", 0)
@@ -613,7 +661,16 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             "http_stack_dispatches": stack_disp,
             "http_stack_tokens_per_sec": round(stack_tps, 1),
             "http_decode_tokens_per_sec": round(float(sum(decode_rates)), 1),
-            "http_decode_engine_direct_tokens_per_sec": round(direct_tps, 1),
+            # engine-side rate derived from the routed requests' own
+            # engine.decode spans (replaces the retired second-pass
+            # engine-direct contrast; docs/benchmarking.md)
+            "http_decode_engine_tokens_per_sec_traced": round(
+                traced_engine_tps, 1
+            ),
+            "http_decode_phase_attribution": decode_attr["phases"],
+            "http_decode_trace_leaf_coverage_p50": decode_attr[
+                "leaf_coverage_p50"
+            ],
             "http_decode_concurrency": dec_conc,
             # fraction of decode dispatches that chained bursts IN THIS
             # PHASE: chaining only engages on a quiescent batch, and each

@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import collections
+import inspect
 import json
 import time
 import uuid
@@ -214,6 +215,12 @@ class EngineServer:
     def __init__(self, cfg: EngineConfig, engine: Optional[LLMEngine] = None):
         self.cfg = cfg
         self.engine = engine or LLMEngine(cfg)
+        try:
+            self._engine_accepts_trace = "trace" in inspect.signature(
+                self.engine.generate
+            ).parameters
+        except (TypeError, ValueError):
+            self._engine_accepts_trace = False
         self.start_time = time.time()
         # graceful drain (SIGTERM): /health flips to 503 so readiness
         # probes / router health checks pull the pod from rotation, new
@@ -347,16 +354,37 @@ class EngineServer:
         # distribution histograms (dashboard TTFT/latency heatmap panels)
         lines.extend(_ttft_hist.render(f'model_name="{m}"'))
         lines.extend(_latency_hist.render(f'model_name="{m}"'))
+        # per-phase histograms (tracing subsystem): queue wait, prefill,
+        # time-per-output-token, offload restore — the dashboard's
+        # phase-breakdown panels and bench.py's attribution read these
+        from production_stack_tpu.tracing import render_phase_histograms
+
+        lines.extend(render_phase_histograms(f'model_name="{m}"'))
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def traces(self, request: web.Request) -> web.Response:
+        """Span ring-buffer export (read-only debug surface; docs/tracing.md).
+        ?trace_id= filters to one trace, ?limit= caps the trace count."""
+        from production_stack_tpu.tracing import export_for_query
+
+        payload, status = export_for_query(request.query)
+        return web.json_response(payload, status=status)
 
     async def metrics_reset(self, request: web.Request) -> web.Response:
         """Clear the TTFT hop sample windows (debug/bench endpoint): per-phase
         quantiles require each phase to start from an empty window, else the
         gauges pool samples from differently-loaded phases. Counters and
         serving stats are untouched."""
+        from production_stack_tpu.tracing import (
+            get_collector,
+            reset_phase_histograms,
+        )
+
         _ttft_hops.clear()
         _ttft_hist.reset()
         _latency_hist.reset()
+        reset_phase_histograms()
+        get_collector().reset()
         waits = getattr(self.engine, "admission_wait_ms", None)
         if waits is not None:
             waits.clear()
@@ -440,6 +468,15 @@ class EngineServer:
         tool_style: Optional[str] = None,
     ) -> web.StreamResponse:
         t_accept = time.perf_counter()
+        t_accept_wall = time.time()
+        # distributed tracing: adopt the router's traceparent (its sampled
+        # flag wins) or root a new trace for engine-direct requests; the
+        # engine.request span context parents every per-phase span the
+        # engine loop records for this request (docs/tracing.md)
+        from production_stack_tpu.tracing import get_collector
+
+        _collector = get_collector()
+        trace_ctx = _collector.root_from_headers(request.headers).child()
         if self.draining:
             return web.json_response(
                 {"error": {"message": "engine is draining for shutdown"}},
@@ -540,9 +577,18 @@ class EngineServer:
         sub_ids = [req_id] if n == 1 else [f"{req_id}#{i}" for i in range(n)]
 
         def _gen(sid):
-            return self.engine.generate(
-                sid, prompt_token_ids=prompt_ids, params=params, lora_name=lora_name
+            kwargs = dict(
+                prompt_token_ids=prompt_ids, params=params, lora_name=lora_name
             )
+            # duck-typed engines (tests, fakes) may predate the trace kwarg;
+            # they still get the engine.request span, just no phase spans.
+            # For n > 1 only choice 0 carries the context: n concurrent
+            # sibling phase-span sets under one engine.request would sum past
+            # the parent's wall time and corrupt the self-time attribution,
+            # so the trace follows one representative sequence
+            if self._engine_accepts_trace and sid == sub_ids[0]:
+                kwargs["trace"] = trace_ctx
+            return self.engine.generate(sid, **kwargs)
 
         t_submit = time.perf_counter()
         if n == 1:
@@ -639,6 +685,11 @@ class EngineServer:
             if t_first_box[0] is not None:
                 _ttft_hist.observe(t_first_box[0] - t_accept)
             _latency_hist.observe(time.perf_counter() - t_accept)
+            _collector.record(
+                "engine.request", trace_ctx, t_accept_wall,
+                time.perf_counter() - t_accept,
+                request_id=req_id, model=model, stream=False, n=n,
+            )
             return web.json_response(
                 {
                     "id": oid,
@@ -794,6 +845,11 @@ class EngineServer:
                 self.engine.abort(sid)
             raise
         _latency_hist.observe(time.perf_counter() - t_accept)
+        _collector.record(
+            "engine.request", trace_ctx, t_accept_wall,
+            time.perf_counter() - t_accept,
+            request_id=req_id, model=model, stream=True, n=n,
+        )
         await resp.write_eof()
         return resp
 
@@ -1040,9 +1096,12 @@ class EngineServer:
         r.add_get("/v1/models", self.models)
         r.add_get("/metrics", self.metrics)
         if self.cfg.enable_debug_endpoints:
-            # state-mutating and unauthenticated — benchmark/debug runs only
-            # (wiping the hop-quantile sample windows corrupts live
-            # observability, so production servers don't register it)
+            # unauthenticated debug surfaces — benchmark/debug runs only.
+            # /v1/traces is read-only but exposes request ids and timings;
+            # wiping the hop-quantile sample windows (/metrics/reset)
+            # corrupts live observability, so production servers register
+            # neither
+            r.add_get("/v1/traces", self.traces)
             r.add_post("/metrics/reset", self.metrics_reset)
         r.add_post("/tokenize", self.tokenize)
         r.add_post("/detokenize", self.detokenize)
@@ -1176,6 +1235,11 @@ async def serve(cfg: EngineConfig, engine: Optional[LLMEngine] = None):
             # replicated offer/pull/restore dispatches (must come after the
             # BroadcastingRunner wrap so followers mirror every step)
             engine.enable_multihost_device_kv()
+    from production_stack_tpu.tracing import configure_tracing
+
+    configure_tracing(
+        sample_rate=cfg.trace_sample_rate, capacity=cfg.trace_buffer_size
+    )
     server = EngineServer(cfg, engine)
     server.engine.start()
     app = server.build_app()

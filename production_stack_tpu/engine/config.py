@@ -148,6 +148,12 @@ class EngineConfig:
     kv_transfer_device_host: str = "127.0.0.1"
     # staging budget for device-pulled pages awaiting admission (consumer)
     kv_transfer_stage_mb: int = 1024
+    # distributed tracing (production_stack_tpu/tracing, docs/tracing.md):
+    # head-based sampling rate for traces ROOTED at this engine (requests
+    # arriving with a traceparent header keep the router's decision); 0.0
+    # turns span recording off entirely. Buffer size bounds tracer memory.
+    trace_sample_rate: float = 1.0
+    trace_buffer_size: int = 4096
 
     @property
     def name(self) -> str:

@@ -438,6 +438,7 @@ class LLMEngine:
         prompt_token_ids: Optional[list[int]] = None,
         params: Optional[SamplingParams] = None,
         lora_name: Optional[str] = None,
+        trace: Optional[object] = None,
     ) -> AsyncIterator[RequestOutput]:
         params = params or SamplingParams()
         if lora_name and self.lora is None:
@@ -465,7 +466,7 @@ class LLMEngine:
             self._texts[seq_id] = ""
         seq = Sequence(
             seq_id=seq_id, prompt_ids=list(prompt_token_ids), params=params,
-            lora_slot=lora_slot, cache_salt=cache_salt,
+            lora_slot=lora_slot, cache_salt=cache_salt, trace=trace,
         )
         self._inbox.put(seq)
         try:
@@ -1003,6 +1004,67 @@ class LLMEngine:
             self._texts[seq.seq_id] = prev + delta
         self._emit(seq, delta, tokens=new_tokens, logprobs=logprobs)
 
+    def _record_phase_trace(self, seq: Sequence) -> None:
+        """Record the per-phase spans and histograms for a finished sequence.
+
+        Phase boundaries come from timestamps the scheduler already keeps
+        (arrival, first prefill dispatch, first token, finish), so this runs
+        once per request at finish — zero cost on the step path. Histograms
+        are always-on (they back the dashboard's phase panels); spans only
+        when the request carries a sampled trace context."""
+        from production_stack_tpu import tracing
+
+        seq.trace_done = True
+        now_m = time.monotonic()
+        anchor = time.time() - now_m  # monotonic -> wall clock
+        end = seq.finish_time or now_m
+        fd = seq.first_dispatch_time
+        ft = seq.first_token_time
+        queue_s = max(0.0, (fd if fd is not None else end) - seq.arrival_time)
+        prefill_s = max(0.0, (ft - fd)) if fd is not None and ft is not None else 0.0
+        decode_s = max(0.0, (end - ft)) if ft is not None else 0.0
+        steps = len(seq.output_ids)
+        tracing.queue_time_hist.observe(queue_s)
+        if fd is not None and ft is not None:
+            tracing.prefill_time_hist.observe(prefill_s)
+        if ft is not None and steps > 1:
+            tracing.decode_step_time_hist.observe(decode_s / (steps - 1))
+        tr = seq.trace
+        if tr is None or not getattr(tr, "sampled", False):
+            return
+        col = tracing.get_collector()
+        # the scheduler pre-allocated the phase-span contexts at admission so
+        # offload spill/restore spans could nest under the phase whose wall
+        # window contains them; record the phases under those same contexts
+        col.record(
+            "engine.queue", seq.queue_span or tr.child(),
+            anchor + seq.arrival_time, queue_s, seq_id=seq.seq_id,
+        )
+        if fd is not None and ft is not None:
+            col.record(
+                "engine.prefill", seq.prefill_span or tr.child(),
+                anchor + fd, prefill_s,
+                seq_id=seq.seq_id, prompt_tokens=len(seq.prompt_ids),
+                cached_tokens=seq.num_cached,
+            )
+        if ft is not None:
+            attrs = {
+                "seq_id": seq.seq_id,
+                "output_tokens": steps,
+                "finish_reason": seq.finish_reason,
+            }
+            if steps > 1:
+                attrs["per_token_ms"] = round(decode_s / (steps - 1) * 1000, 3)
+            if seq.lora_slot:
+                # LoRA sub-phase marker: which adapter slot served the decode
+                attrs["lora_slot"] = seq.lora_slot
+            if self.cfg.speculative_k:
+                attrs["spec_k"] = self.cfg.speculative_k
+            col.record(
+                "engine.decode", seq.decode_span or tr.child(),
+                anchor + ft, decode_s, **attrs,
+            )
+
     def _emit(
         self,
         seq: Sequence,
@@ -1011,6 +1073,11 @@ class LLMEngine:
         error: bool = False,
         logprobs: Optional[list] = None,
     ) -> None:
+        if seq.finished and not seq.trace_done:
+            try:
+                self._record_phase_trace(seq)
+            except Exception:  # noqa: BLE001 - tracing must never break serving
+                logger.exception("phase trace recording failed")
         with self._lock:
             entry = self._outputs.get(seq.seq_id)
         if entry is None:

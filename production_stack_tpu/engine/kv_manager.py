@@ -118,7 +118,21 @@ class KVPageManager:
                 dropped = spill[cap:]
                 spill = spill[:cap]
                 self.offload.report_evict([h for _, h in dropped])
+            import time as time_mod
+
+            from production_stack_tpu import tracing
+
+            t_wall, t0 = time_mod.time(), time_mod.perf_counter()
             self.offload.save_pages(spill)
+            # spill span under whichever request's admission forced the
+            # eviction (scheduler publishes it); decode-growth evictions
+            # carry no ambient context and record nothing
+            ctx = tracing.current_context()
+            if ctx is not None:
+                tracing.get_collector().record(
+                    "engine.kv_spill", ctx.child(), t_wall,
+                    time_mod.perf_counter() - t0, pages=len(spill),
+                )
         return out
 
     def free(self, page_ids: Sequence[int]) -> None:
@@ -213,13 +227,26 @@ class KVPageManager:
             if pid is not None:
                 self.free([pid])
         n_restore = len(restore_pids)
-        restored = (
-            self.offload.load_pages(
+        restored = 0
+        if n_restore:
+            import time as time_mod
+
+            from production_stack_tpu import tracing
+
+            t_wall, t0 = time_mod.time(), time_mod.perf_counter()
+            restored = self.offload.load_pages(
                 list(zip(restore_pids, (h for h, p in plan if p is None)))
             )
-            if n_restore
-            else 0
-        )
+            dt = time_mod.perf_counter() - t0
+            # restore latency is a first-class phase: histogram always
+            # (dashboard phase panels), span when the admission is traced
+            tracing.offload_restore_hist.observe(dt)
+            ctx = tracing.current_context()
+            if ctx is not None:
+                tracing.get_collector().record(
+                    "engine.kv_restore", ctx.child(), t_wall, dt,
+                    pages_planned=n_restore, pages_restored=restored,
+                )
         # stitch the final chain: a failed restore truncates it there;
         # shares past the truncation un-ref, unused restore slots free
         ri = 0

@@ -79,6 +79,21 @@ class Sequence:
     first_dispatch_time: Optional[float] = None  # admission-wait instrumentation
     lora_slot: int = 0             # adapter slot (0 = base model)
     cache_salt: bytes = b""        # prefix-cache salt (adapter identity)
+    # distributed-tracing context (tracing.SpanContext of the engine.request
+    # span) — phase spans for this sequence parent under it; None = untraced
+    trace: Optional[object] = None
+    trace_done: bool = False       # phase spans recorded (guard against dupes)
+    finish_time: Optional[float] = None  # monotonic, set by _finish
+    # phase-span contexts, pre-allocated at first admission attempt so
+    # offload spill/restore spans triggered inside the scheduler can parent
+    # under the phase whose wall window contains them (first admission ->
+    # queue; post-preemption re-admission -> prefill or decode). As siblings
+    # of the phase they overlap they would double-count in self-time
+    # attribution. engine._record_phase_trace records the phase spans under
+    # these same contexts at finish.
+    queue_span: Optional[object] = None
+    prefill_span: Optional[object] = None
+    decode_span: Optional[object] = None
 
     @property
     def num_tokens(self) -> int:
@@ -231,24 +246,50 @@ class Scheduler:
         return -(-tokens // self.kv.page_size)
 
     def _try_admit(self) -> None:
+        from production_stack_tpu import tracing
+
         while self.waiting and len(self.running) < self.max_num_seqs:
             seq = self.waiting[0]
-            if self.enable_prefix_caching:
-                shared, cached = self.kv.match_prefix(seq.prompt_ids, seq.cache_salt)
-                # never serve the *entire* prompt from cache: the last token
-                # must be recomputed to produce logits
-                if cached >= len(seq.prompt_ids):
-                    drop = self._pages_needed(1)
-                    for pid in shared[-drop:]:
-                        self.kv.free([pid])
-                    shared = shared[:-drop]
-                    cached = len(shared) * self.kv.page_size
+            # publish a phase-span context for the admission window: offload
+            # spill/restore spans recorded inside match_prefix / allocate
+            # (kv_manager) nest under the phase of the request that caused
+            # them. First admission falls in the queue window; a
+            # preempted-then-readmitted sequence is re-admitted inside its
+            # prefill (dispatched, no token yet) or decode window, and
+            # parenting its restores under the already-closed queue span
+            # would double-count that time in the attribution
+            if seq.trace is not None and seq.queue_span is None:
+                seq.queue_span = seq.trace.child()
+                seq.prefill_span = seq.trace.child()
+                seq.decode_span = seq.trace.child()
+            if seq.first_token_time is not None:
+                phase_ctx = seq.decode_span
+            elif seq.first_dispatch_time is not None:
+                phase_ctx = seq.prefill_span
             else:
-                shared, cached = [], 0
-            need = self._pages_needed(
-                min(len(seq.prompt_ids) + 16, self.max_model_len + 1)
-            ) - len(shared)
-            fresh = self.kv.allocate(max(need, 0))
+                phase_ctx = seq.queue_span
+            tr_token = tracing.set_current(phase_ctx)
+            try:
+                if self.enable_prefix_caching:
+                    shared, cached = self.kv.match_prefix(
+                        seq.prompt_ids, seq.cache_salt
+                    )
+                    # never serve the *entire* prompt from cache: the last
+                    # token must be recomputed to produce logits
+                    if cached >= len(seq.prompt_ids):
+                        drop = self._pages_needed(1)
+                        for pid in shared[-drop:]:
+                            self.kv.free([pid])
+                        shared = shared[:-drop]
+                        cached = len(shared) * self.kv.page_size
+                else:
+                    shared, cached = [], 0
+                need = self._pages_needed(
+                    min(len(seq.prompt_ids) + 16, self.max_model_len + 1)
+                ) - len(shared)
+                fresh = self.kv.allocate(max(need, 0))
+            finally:
+                tracing.reset_current(tr_token)
             if fresh is None:
                 self.kv.free(shared)
                 return
@@ -299,6 +340,8 @@ class Scheduler:
     def _finish(self, seq: Sequence, reason: str) -> None:
         seq.finished = True
         seq.finish_reason = reason
+        if seq.finish_time is None:
+            seq.finish_time = time.monotonic()
         if self.enable_prefix_caching:
             self.kv.register_filled(
                 seq.prompt_ids + seq.output_ids, seq.pages, seq.cache_salt

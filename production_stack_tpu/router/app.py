@@ -113,6 +113,12 @@ class RouterApp:
         if args.callbacks:
             load_callbacks(args.callbacks)
         initialize_feature_gates(args.feature_gates)
+        from production_stack_tpu.tracing import configure_tracing
+
+        configure_tracing(
+            sample_rate=getattr(args, "trace_sample_rate", 1.0),
+            capacity=getattr(args, "trace_buffer_size", None),
+        )
         if get_feature_gates().is_enabled("SemanticCache"):
             from production_stack_tpu.router import semantic_cache as sc
 
@@ -378,14 +384,35 @@ class RouterApp:
 
         lines.extend(ttft_hist.render('source="router"'))
         lines.extend(latency_hist.render('source="router"'))
+        # per-phase histograms (tracing subsystem): the engine observes
+        # these; a router-only process exposes them zero-count so either
+        # scrape job satisfies the dashboard. In a co-hosted process
+        # (bench.py) both endpoints render the same process-global counts
+        # under different labels, so the dashboard's phase panels filter on
+        # model_name!="" to count the engine's series exactly once
+        from production_stack_tpu.tracing import render_phase_histograms
+
+        lines.extend(render_phase_histograms('source="router"'))
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def traces(self, request: web.Request) -> web.Response:
+        """Span ring-buffer export (read-only debug surface; docs/tracing.md).
+        ?trace_id= filters to one trace, ?limit= caps the trace count."""
+        from production_stack_tpu.tracing import export_for_query
+
+        payload, status = export_for_query(request.query)
+        return web.json_response(payload, status=status)
 
     async def metrics_reset(self, request: web.Request) -> web.Response:
         """Clear the TTFT hop sample window (debug/bench endpoint) so a
         benchmark phase's hop quantiles describe only that phase."""
         from production_stack_tpu.router.request_service import reset_hop_samples
+        from production_stack_tpu.tracing import get_collector
 
         reset_hop_samples()
+        # per-phase bench windows: traces too, so a phase's attribution table
+        # describes only that phase's requests
+        get_collector().reset()
         return web.json_response({"status": "ok"})
 
     # -- files & batches (parity files_router.py, batches_router.py) --------
@@ -483,7 +510,10 @@ class RouterApp:
         r.add_get("/health", self.health)
         r.add_get("/metrics", self.metrics)
         if getattr(self.args, "enable_debug_endpoints", False):
-            # state-mutating and unauthenticated — benchmark/debug runs only
+            # unauthenticated debug surfaces — benchmark/debug runs only
+            # (/v1/traces is read-only but exposes request ids, backends,
+            # and per-request timings; /metrics/reset is state-mutating)
+            r.add_get("/v1/traces", self.traces)
             r.add_post("/metrics/reset", self.metrics_reset)
         r.add_get("/engines", self.engines)
         r.add_get("/version", self.version)

@@ -93,6 +93,13 @@ def parse_args(argv=None):
                    help="presidio activates the NER tier (requires "
                         "presidio-analyzer); auto falls back to regex")
     p.add_argument("--sentry-dsn", type=str, default=None)
+    p.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   help="fraction of requests recorded by the distributed "
+                        "tracer (head-based, decided at the router and "
+                        "propagated via traceparent); 0.0 disables span "
+                        "recording entirely")
+    p.add_argument("--trace-buffer-size", type=int, default=4096,
+                   help="span ring-buffer capacity (bounds tracer memory)")
     args = p.parse_args(argv)
     validate_args(args)
     return args
@@ -111,6 +118,10 @@ def validate_args(args) -> None:
                 f"--static-backends ({n_backends}) and --static-models ({n_models}) "
                 "must have the same length"
             )
+    if not 0.0 <= args.trace_sample_rate <= 1.0:
+        raise ValueError("--trace-sample-rate must be in [0, 1]")
+    if args.trace_buffer_size < 1:
+        raise ValueError("--trace-buffer-size must be >= 1")
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("session routing requires --session-key")
     if args.routing_logic == "kvaware" and not args.kv_controller_url:

@@ -28,6 +28,7 @@ from production_stack_tpu.router.routing_logic import (
 from production_stack_tpu.router.engine_stats import get_engine_stats_scraper
 from production_stack_tpu.router.request_stats import get_request_stats_monitor
 from production_stack_tpu.router.service_discovery import EndpointInfo, get_service_discovery
+from production_stack_tpu.tracing import TRACEPARENT_HEADER, get_collector
 from production_stack_tpu.utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -125,6 +126,7 @@ async def process_request(
     is_streaming: bool,
     capture_body: Optional[object] = None,
     ts_recv: Optional[float] = None,
+    trace_ctx=None,
 ) -> web.StreamResponse:
     """Proxy `body` to backend and stream the response back, firing request
     stats callbacks (parity request.py:54-138).
@@ -132,18 +134,37 @@ async def process_request(
     `capture_body(status, bytes)` — optional async callback fired with the full
     response once the proxy completes (semantic-cache store, post_request
     callbacks). ``ts_recv`` is the perf_counter when the router first saw the
-    request, for the per-hop TTFT breakdown."""
+    request, for the per-hop TTFT breakdown. ``trace_ctx`` is the router's
+    request-level span context; the proxy records a child span and propagates
+    a grandchild over ``traceparent`` so engine spans nest under the proxy."""
     monitor = get_request_stats_monitor()
     monitor.on_new_request(backend_url, request_id)
     session = await get_client_session()
     resp: Optional[web.StreamResponse] = None
     captured: list[bytes] = []
+    collector = get_collector()
+    proxy_ctx = trace_ctx.child() if trace_ctx is not None else None
+    # Always forward X-Request-Id (router-generated when the client sent
+    # none): the engine honors it (api_server req_id), so router and engine
+    # logs — and trace spans — correlate on one id. Without this the engine
+    # minted its own `req-...` id and the two logs never joined. Strip any
+    # client-cased duplicates first — aiohttp would send both spellings.
+    out_headers = {
+        k: v
+        for k, v in _filter_headers(request.headers).items()
+        if k.lower() not in ("x-request-id", TRACEPARENT_HEADER)
+    }
+    out_headers["X-Request-Id"] = request_id
+    if proxy_ctx is not None:
+        out_headers[TRACEPARENT_HEADER] = proxy_ctx.to_traceparent()
+    t_wall = time.time()
     t_route = time.perf_counter()
+    proxy_attrs = {"backend": backend_url, "request_id": request_id}
     try:
         async with session.post(
             f"{backend_url}{endpoint}",
             data=body,
-            headers=_filter_headers(request.headers),
+            headers=out_headers,
         ) as backend_resp:
             t_conn = time.perf_counter()
             resp = web.StreamResponse(
@@ -174,11 +195,13 @@ async def process_request(
             latency_hist.observe(
                 time.perf_counter() - (ts_recv or t_route)
             )
+            proxy_attrs["status"] = backend_resp.status
             if capture_body is not None:
                 await capture_body(backend_resp.status, b"".join(captured))
             return resp
     except (aiohttp.ClientError, ConnectionResetError) as e:
         logger.error("backend %s failed for request %s: %s", backend_url, request_id, e)
+        proxy_attrs["error"] = str(e)
         if resp is None or not resp.prepared:
             return web.json_response({"error": f"backend error: {e}"}, status=502)
         # headers already sent: terminate the stream instead of sending a
@@ -189,8 +212,24 @@ async def process_request(
             pass
         return resp
     finally:
-        # fires on success, backend error, AND client disconnect (CancelledError)
+        # fires on success, backend error, AND client disconnect
+        # (CancelledError). Both spans record HERE so a disconnect cannot
+        # record the router.request root while dropping the router.proxy
+        # span — that would orphan the engine subtree (parented under
+        # proxy_ctx) out of the attribution and misattribute engine time
+        # to the router
         monitor.on_request_complete(backend_url, request_id)
+        collector.record(
+            "router.proxy", proxy_ctx, t_wall,
+            time.perf_counter() - t_route, **proxy_attrs,
+        )
+        if trace_ctx is not None:
+            start = t_wall - ((t_route - ts_recv) if ts_recv else 0.0)
+            collector.record(
+                "router.request", trace_ctx, start,
+                time.perf_counter() - (ts_recv or t_route),
+                endpoint=endpoint, request_id=request_id,
+            )
 
 
 async def route_general_request(
@@ -207,6 +246,13 @@ async def route_general_request(
     ts_recv = time.perf_counter()
     body = body_override if body_override is not None else await request.read()
     request_id = request.headers.get("X-Request-Id") or str(uuid.uuid4())
+    # request-level trace: adopt the client's traceparent (its sampled flag
+    # wins — head-based sampling) or root a new trace here; every downstream
+    # span (routing decision, proxy, engine phases) nests under this context.
+    # child() so the router.request span has its OWN id — recording under the
+    # client's span id verbatim would collide retries that reuse a header
+    # into one phantom span at merge time
+    trace_ctx = get_collector().root_from_headers(request.headers).child()
     try:
         request_json = json.loads(body) if body else {}
     except json.JSONDecodeError:
@@ -215,7 +261,8 @@ async def route_general_request(
     router = get_routing_logic()
     if isinstance(router, DisaggregatedPrefillRouter):
         return await route_disaggregated_prefill_request(
-            request, endpoint, request_json, request_id
+            request, endpoint, request_json, request_id,
+            trace_ctx=trace_ctx, ts_recv=ts_recv,
         )
 
     requested_model = request_json.get("model")
@@ -240,6 +287,7 @@ async def route_general_request(
 
     engine_stats = get_engine_stats_scraper().get_engine_stats()
     request_stats = get_request_stats_monitor().get_request_stats()
+    t_route0 = time.perf_counter()
     try:
         server_url = await router.route_request(
             endpoints, engine_stats, request_stats, request, request_json
@@ -249,6 +297,13 @@ async def route_general_request(
         return web.json_response({"error": f"routing failure: {e}"}, status=500)
 
     curr_time = time.time()
+    get_collector().record(
+        "router.routing", trace_ctx.child(),
+        curr_time - (time.perf_counter() - t_route0),
+        time.perf_counter() - t_route0,
+        backend=server_url, logic=type(router).__name__,
+        request_id=request_id,
+    )
     logger.info(
         "Routing request %s for model %s to %s at %f, process time = %.4f",
         request_id, requested_model, server_url, curr_time, curr_time - in_router_time,
@@ -257,24 +312,30 @@ async def route_general_request(
     return await process_request(
         request, body, server_url, endpoint, request_id,
         is_streaming=is_streaming, capture_body=capture_body, ts_recv=ts_recv,
+        trace_ctx=trace_ctx,
     )
 
 
 async def send_request_to_prefiller(
-    session: aiohttp.ClientSession, url: str, endpoint: str, payload: dict, request_id: str
+    session: aiohttp.ClientSession, url: str, endpoint: str, payload: dict,
+    request_id: str, trace_ctx=None,
 ) -> dict:
     """Phase 1: run prefill with max_tokens=1 (parity request.py:307-325)."""
+    headers = {"X-Request-Id": request_id}
+    if trace_ctx is not None:
+        headers[TRACEPARENT_HEADER] = trace_ctx.to_traceparent()
     async with session.post(
         f"{url}{endpoint}",
         json=payload,
-        headers={"X-Request-Id": request_id},
+        headers=headers,
     ) as resp:
         resp.raise_for_status()
         return await resp.json()
 
 
 async def route_disaggregated_prefill_request(
-    request: web.Request, endpoint: str, request_json: dict, request_id: str
+    request: web.Request, endpoint: str, request_json: dict, request_id: str,
+    trace_ctx=None, ts_recv: Optional[float] = None,
 ) -> web.StreamResponse:
     """Two-phase P/D flow (parity request.py:347-439): prefill pool computes
     KV (max_tokens=1), KV ships prefill->decode out-of-band (ICI/DCN via the
@@ -301,13 +362,19 @@ async def route_disaggregated_prefill_request(
         "Routing request %s for model %s to prefill=%s decode=%s at %f",
         request_id, request_json.get("model"), prefill_url, decode_url, t0,
     )
+    prefill_ctx = trace_ctx.child() if trace_ctx is not None else None
     try:
         await send_request_to_prefiller(
-            session, prefill_url, endpoint, prefill_json, request_id
+            session, prefill_url, endpoint, prefill_json, request_id,
+            trace_ctx=prefill_ctx,
         )
         monitor.on_request_response(prefill_url, request_id)
         monitor.on_request_complete(prefill_url, request_id)
         logger.info("Prefill of %s done in %.3fs (TTFT)", request_id, time.time() - t0)
+        get_collector().record(
+            "router.disagg_prefill", prefill_ctx, t0, time.time() - t0,
+            backend=prefill_url, request_id=request_id,
+        )
     except aiohttp.ClientError as e:
         monitor.on_request_complete(prefill_url, request_id)
         return web.json_response({"error": f"prefill failed: {e}"}, status=502)
@@ -316,9 +383,14 @@ async def route_disaggregated_prefill_request(
     decode_json["max_tokens"] = orig_max_tokens
     decode_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
     body = json.dumps(decode_json).encode()
+    # ts_recv rides through so the router.request root span covers the WHOLE
+    # P/D request (prefill phase included) — without it the root would start
+    # at the decode proxy and the disagg_prefill child would fall outside
+    # its parent's window, corrupting the attribution table
     return await process_request(
         request, body, decode_url, endpoint, request_id,
         is_streaming=bool(request_json.get("stream", False)),
+        trace_ctx=trace_ctx, ts_recv=ts_recv,
     )
 
 

@@ -17,6 +17,15 @@ import uuid
 
 from aiohttp import web
 
+from production_stack_tpu.tracing import (
+    decode_step_time_hist,
+    export_for_query,
+    get_collector,
+    prefill_time_hist,
+    queue_time_hist,
+    render_phase_histograms,
+)
+
 STATE = {
     "running": 0,
     "total": 0,
@@ -51,7 +60,14 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             f'vllm:gpu_prefix_cache_hits_total{{model_name="{model}"}} 10\n'
             f'vllm:gpu_prefix_cache_queries_total{{model_name="{model}"}} 20\n'
         )
+        # per-phase histograms, same names as the real engine's /metrics so
+        # smoke tests and dashboard queries exercise the fake identically
+        text += "\n".join(render_phase_histograms(f'model_name="{model}"')) + "\n"
         return web.Response(text=text, content_type="text/plain")
+
+    async def traces(request):
+        payload, status = export_for_query(request.query)
+        return web.json_response(payload, status=status)
 
     async def completions(request):
         return await _generate(request, chat=False)
@@ -70,14 +86,43 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         if uid:
             # visible marker for tests asserting user-id header propagation
             print(f"x-user-id={uid}", flush=True)
+        # distributed tracing, same span model as the real engine
+        # (engine.request > queue/prefill/decode) so router e2e tests can
+        # assert full-stack trace propagation without a TPU
+        collector = get_collector()
+        trace_ctx = collector.root_from_headers(request.headers).child()
+        t_accept = time.time()
         STATE["running"] += 1
         STATE["total"] += 1
         created = int(time.time())
         oid = ("chatcmpl-" if chat else "cmpl-") + req_id
+
+        def _phase(name, start, dur, **attrs):
+            collector.record(
+                name, trace_ctx.child(), start, dur,
+                seq_id=req_id, **attrs,
+            )
+
+        def _decode_done(t_first):
+            t_done = time.time()
+            _phase("engine.decode", t_first, t_done - t_first,
+                   output_tokens=max_tokens, finish_reason="length")
+            if max_tokens > 1:
+                decode_step_time_hist.observe(
+                    (t_done - t_first) / (max_tokens - 1)
+                )
+
         try:
-            await asyncio.sleep(ttft)
+            t_q = time.time()
+            _phase("engine.queue", t_accept, t_q - t_accept)
+            queue_time_hist.observe(t_q - t_accept)
+            await asyncio.sleep(ttft)  # injected prefill time
+            t_first = time.time()
+            _phase("engine.prefill", t_q, t_first - t_q, prompt_tokens=10)
+            prefill_time_hist.observe(t_first - t_q)
             if not stream:
                 await asyncio.sleep(max_tokens / speed)
+                _decode_done(t_first)
                 text = "Hello " * max_tokens
                 choice = (
                     {"index": 0, "message": {"role": "assistant", "content": text},
@@ -111,11 +156,16 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                     f"data: {json.dumps({'id': oid, 'object': 'chat.completion.chunk' if chat else 'text_completion', 'created': created, 'model': model, 'choices': [choice]})}\n\n".encode()
                 )
                 await asyncio.sleep(1.0 / speed)
+            _decode_done(t_first)
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
         finally:
             STATE["running"] -= 1
+            collector.record(
+                "engine.request", trace_ctx, t_accept,
+                time.time() - t_accept, request_id=req_id, model=model,
+            )
 
     async def sleep(request):
         STATE["sleeping"] = True
@@ -139,6 +189,7 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     app.router.add_get("/health", health)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/v1/traces", traces)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_post("/sleep", sleep)

@@ -1,0 +1,57 @@
+"""Request-level distributed tracing across router -> engine -> KV-offload.
+
+A W3C-``traceparent`` span context enters at the router proxy, propagates to
+the engine API server over the proxied request's headers, and is recorded
+against the serving hot phases — routing decision, engine queue wait, prefill,
+decode, KV-offload spill/restore — in a bounded in-process ring buffer.
+``/v1/traces`` on both servers exports the buffer as JSON;
+``scripts/trace_report.py`` renders a per-phase latency table from an export;
+the four per-phase Prometheus histograms (tracing/metrics.py) feed the
+dashboard's phase-breakdown panels. See docs/tracing.md.
+"""
+
+from production_stack_tpu.tracing.collector import (
+    Span,
+    SpanCollector,
+    configure_tracing,
+    current_context,
+    export_for_query,
+    get_collector,
+    reset_current,
+    set_current,
+)
+from production_stack_tpu.tracing.context import (
+    TRACEPARENT_HEADER,
+    SpanContext,
+    gen_span_id,
+    gen_trace_id,
+)
+from production_stack_tpu.tracing.metrics import (
+    decode_step_time_hist,
+    offload_restore_hist,
+    prefill_time_hist,
+    queue_time_hist,
+    render_phase_histograms,
+    reset_phase_histograms,
+)
+
+__all__ = [
+    "Span",
+    "SpanCollector",
+    "SpanContext",
+    "TRACEPARENT_HEADER",
+    "configure_tracing",
+    "current_context",
+    "decode_step_time_hist",
+    "export_for_query",
+    "gen_span_id",
+    "gen_trace_id",
+    "get_collector",
+    "offload_restore_hist",
+    "prefill_time_hist",
+    "queue_time_hist",
+    "render_phase_histograms",
+    "reset_current",
+    "reset_phase_histograms",
+    "set_current",
+]

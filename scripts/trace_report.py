@@ -1,0 +1,178 @@
+"""Per-phase latency report from a /v1/traces JSON export.
+
+Reads one or more trace exports (the payload of ``GET /v1/traces`` on the
+router or engine — or both, merged: the two halves of a routed request share
+one trace id) and renders a self-time attribution table: for every span name,
+how much wall time the stack spent IN that phase, excluding time attributed to
+its child spans. Self-times of a well-formed trace sum to the root span's
+duration, so gaps (network hops, scheduling turnaround) surface as parent
+self-time instead of silently vanishing — exactly the property the old
+two-pass engine-direct benchmark contrast lacked.
+
+Usage:
+    curl -s $ROUTER/v1/traces > r.json
+    curl -s $ENGINE/v1/traces > e.json
+    python scripts/trace_report.py r.json e.json
+
+``bench.py`` imports ``merge_exports`` / ``phase_table`` / ``render_table``
+to emit the same attribution from its in-run trace scrapes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Iterable, Optional
+
+
+def _spans_of(export) -> list[dict]:
+    """Accept a /v1/traces export, a {"traces": [...]} dict, a list of trace
+    groups, or a bare span list."""
+    if isinstance(export, dict):
+        export = export.get("traces", [])
+    spans: list[dict] = []
+    for item in export:
+        if isinstance(item, dict) and "spans" in item:
+            spans.extend(item["spans"])
+        elif isinstance(item, dict):
+            spans.append(item)
+    return spans
+
+
+def merge_exports(*exports) -> dict[str, list[dict]]:
+    """Merge exports (possibly from different processes) into
+    {trace_id: [span, ...]}, deduped by span id."""
+    by_trace: dict[str, dict[str, dict]] = {}
+    for ex in exports:
+        for s in _spans_of(ex):
+            by_trace.setdefault(s["trace_id"], {})[s["span_id"]] = s
+    return {t: list(ss.values()) for t, ss in by_trace.items()}
+
+
+def _percentile(vals: list[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(len(s) * q))]
+
+
+def trace_breakdown(spans: list[dict]) -> Optional[dict]:
+    """One trace's attribution: root duration, per-name self time, and the
+    share of the root covered by leaf phases."""
+    if not spans:
+        return None
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict[str, list[dict]] = {}
+    roots = []
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    root = max(roots, key=lambda s: s.get("duration_ms", 0.0))
+    # Restrict accounting to the chosen root's subtree: a partial trace
+    # (span ring wrapped mid-trace, or router/engine export windows
+    # misaligned across pods) can carry orphan chains whose parents were
+    # lost; counting those would push shares and leaf coverage past 100%
+    # and silently corrupt the table.
+    subtree: list[dict] = []
+    stack = [root]
+    while stack:
+        s = stack.pop()
+        subtree.append(s)
+        stack.extend(children.get(s["span_id"], []))
+    self_ms: dict[str, float] = {}
+    leaf_ms = 0.0
+    for s in subtree:
+        kids = children.get(s["span_id"], [])
+        own = max(
+            0.0,
+            s.get("duration_ms", 0.0) - sum(k.get("duration_ms", 0.0) for k in kids),
+        )
+        self_ms[s["name"]] = self_ms.get(s["name"], 0.0) + own
+        if not kids:
+            leaf_ms += s.get("duration_ms", 0.0)
+    e2e = root.get("duration_ms", 0.0)
+    return {
+        "trace_id": root["trace_id"],
+        "root": root["name"],
+        "e2e_ms": e2e,
+        "self_ms": self_ms,
+        "leaf_coverage": (leaf_ms / e2e) if e2e > 0 else 0.0,
+    }
+
+
+def phase_table(merged: dict[str, list[dict]]) -> dict:
+    """Aggregate attribution across traces.
+
+    Returns {"phases": {name: {count, p50_self_ms, p99_self_ms, total_ms,
+    share}}, "traces": N, "e2e_p50_ms": ..., "leaf_coverage_p50": ...} where
+    ``share`` is the phase's fraction of total root wall time."""
+    per_name: dict[str, list[float]] = {}
+    e2es: list[float] = []
+    coverages: list[float] = []
+    for spans in merged.values():
+        b = trace_breakdown(spans)
+        if b is None or b["e2e_ms"] <= 0:
+            continue
+        e2es.append(b["e2e_ms"])
+        coverages.append(b["leaf_coverage"])
+        for name, ms in b["self_ms"].items():
+            per_name.setdefault(name, []).append(ms)
+    total_e2e = sum(e2es)
+    phases = {}
+    for name, vals in sorted(
+        per_name.items(), key=lambda kv: -sum(kv[1])
+    ):
+        total = sum(vals)
+        phases[name] = {
+            "count": len(vals),
+            "p50_self_ms": round(_percentile(vals, 0.5), 2),
+            "p99_self_ms": round(_percentile(vals, 0.99), 2),
+            "total_ms": round(total, 2),
+            "share": round(total / total_e2e, 4) if total_e2e else 0.0,
+        }
+    return {
+        "phases": phases,
+        "traces": len(e2es),
+        "e2e_p50_ms": round(_percentile(e2es, 0.5), 2),
+        "leaf_coverage_p50": round(_percentile(coverages, 0.5), 4),
+    }
+
+
+def render_table(table: dict) -> str:
+    lines = [
+        f"traces: {table['traces']}   e2e p50: {table['e2e_p50_ms']} ms   "
+        f"leaf-phase coverage p50: {table['leaf_coverage_p50']:.1%}",
+        f"{'phase':<28} {'count':>6} {'p50 self ms':>12} "
+        f"{'p99 self ms':>12} {'share':>7}",
+    ]
+    for name, row in table["phases"].items():
+        lines.append(
+            f"{name:<28} {row['count']:>6} {row['p50_self_ms']:>12.2f} "
+            f"{row['p99_self_ms']:>12.2f} {row['share']:>6.1%}"
+        )
+    return "\n".join(lines)
+
+
+def report(paths: Iterable[str]) -> str:
+    exports = []
+    for p in paths:
+        with open(p) as f:
+            exports.append(json.load(f))
+    return render_table(phase_table(merge_exports(*exports)))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="Render a per-phase latency table from /v1/traces exports"
+    )
+    ap.add_argument("paths", nargs="+", help="JSON export file(s); exports "
+                    "from router and engine merge by trace id")
+    args = ap.parse_args()
+    print(report(args.paths))
+
+
+if __name__ == "__main__":
+    main()
