@@ -226,6 +226,11 @@ class EngineServer:
         # probes / router health checks pull the pod from rotation, new
         # generation requests are refused, and in-flight ones finish
         self.draining = False
+        # request-id -> (engine sequence ids, registered-at), for
+        # router-initiated aborts (POST /abort): a router that deadline-aborts
+        # a hung stream must be able to free this engine's scheduler slot and
+        # KV pages without relying on the TCP connection being noticed
+        self._live_requests: "dict[str, tuple[list[str], float]]" = {}
 
     # -- handlers -----------------------------------------------------------
 
@@ -233,6 +238,28 @@ class EngineServer:
         if self.draining:
             return web.Response(status=503, text="draining")
         return web.Response(text="")
+
+    async def abort(self, request: web.Request) -> web.Response:
+        """Router-initiated abort (POST /abort {"request_id": ...}): free the
+        scheduler slot and KV pages of a request whose client-side stream was
+        deadline-aborted. Closing the proxy connection only reaches an engine
+        that is actively writing; this endpoint reaches a hung one. Abort of
+        an unknown or already-finished request is a no-op (200, aborted=false)
+        so the router can fire-and-forget."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 - malformed abort is harmless
+            body = {}
+        req_id = body.get("request_id") or request.query.get("request_id")
+        if not req_id:
+            return web.json_response(
+                {"error": {"message": "request_id required"}}, status=400
+            )
+        entry = self._live_requests.pop(req_id, None)
+        for sid in entry[0] if entry else [req_id]:
+            self.engine.abort(sid)
+        logger.info("abort requested for %s (live=%s)", req_id, entry is not None)
+        return web.json_response({"request_id": req_id, "aborted": entry is not None})
 
     async def drain(self, timeout: float = 30.0) -> None:
         """Stop accepting generation work and wait for the engine to go
@@ -575,6 +602,16 @@ class EngineServer:
         # prompt's pages in the prefix cache at that point, so siblings share
         # the prompt KV instead of re-prefilling it n times.
         sub_ids = [req_id] if n == 1 else [f"{req_id}#{i}" for i in range(n)]
+        # register for POST /abort; engine.abort is idempotent, so a stale
+        # entry (rare engine-internal error path) only costs dict space.
+        # Bound growth by evicting the oldest entry ONLY when it is clearly a
+        # leak (hours old) — under legitimate >8k-concurrent load the oldest
+        # entry is a live long-running stream whose abortability must survive
+        if len(self._live_requests) > 8192:
+            oldest = next(iter(self._live_requests))
+            if time.monotonic() - self._live_requests[oldest][1] > 3600:
+                self._live_requests.pop(oldest)
+        self._live_requests[req_id] = (sub_ids, time.monotonic())
 
         def _gen(sid):
             kwargs = dict(
@@ -638,6 +675,7 @@ class EngineServer:
                 # one failed choice (or a client disconnect) must not leave
                 # its n-1 siblings generating — and holding KV pages — until
                 # their own completion
+                self._live_requests.pop(req_id, None)
                 for sid in sub_ids:
                     self.engine.abort(sid)
                 raise
@@ -690,6 +728,7 @@ class EngineServer:
                 time.perf_counter() - t_accept,
                 request_id=req_id, model=model, stream=False, n=n,
             )
+            self._live_requests.pop(req_id, None)
             return web.json_response(
                 {
                     "id": oid,
@@ -841,9 +880,11 @@ class EngineServer:
                 )
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
+            self._live_requests.pop(req_id, None)
             for sid in sub_ids:
                 self.engine.abort(sid)
             raise
+        self._live_requests.pop(req_id, None)
         _latency_hist.observe(time.perf_counter() - t_accept)
         _collector.record(
             "engine.request", trace_ctx, t_accept_wall,
@@ -1103,6 +1144,7 @@ class EngineServer:
             # neither
             r.add_get("/v1/traces", self.traces)
             r.add_post("/metrics/reset", self.metrics_reset)
+        r.add_post("/abort", self.abort)
         r.add_post("/tokenize", self.tokenize)
         r.add_post("/detokenize", self.detokenize)
         r.add_post("/v1/chat/completions", self.chat_completions)

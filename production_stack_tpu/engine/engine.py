@@ -515,6 +515,11 @@ class LLMEngine:
                 for s in self.scheduler.waiting + self.scheduler.running:
                     if s.seq_id == item[1] and not s.finished:
                         self.scheduler._finish(s, "abort")
+                        # deliver the terminal output: a router-initiated
+                        # abort (POST /abort) has a consumer still blocked on
+                        # out_q.get() — without this it would wait forever
+                        # even though the slot and pages are already freed
+                        self._emit(s, "")
             else:
                 self._inbox_accept(item)
 

@@ -30,6 +30,10 @@ from production_stack_tpu.router.request_service import (
     route_general_request,
     route_sleep_wakeup_request,
 )
+from production_stack_tpu.router.resilience import (
+    initialize_resilience,
+    render_resilience_metrics,
+)
 from production_stack_tpu.router.request_stats import (
     get_request_stats_monitor,
     initialize_request_stats_monitor,
@@ -99,6 +103,16 @@ class RouterApp:
                 decode_model_labels=parse_comma_separated(args.decode_model_labels),
             )
         await sd.start()
+        initialize_resilience(
+            retry_max_attempts=getattr(args, "retry_max_attempts", 3),
+            retry_backoff_base=getattr(args, "retry_backoff_base", 0.05),
+            retry_backoff_max=getattr(args, "retry_backoff_max", 2.0),
+            deadline_request=getattr(args, "deadline_request", 0.0),
+            deadline_ttft=getattr(args, "deadline_ttft", 0.0),
+            deadline_inter_chunk=getattr(args, "deadline_inter_chunk", 0.0),
+            breaker_failure_threshold=getattr(args, "breaker_failure_threshold", 5),
+            breaker_cooldown=getattr(args, "breaker_cooldown", 30.0),
+        )
         scraper = initialize_engine_stats_scraper(args.engine_stats_interval)
         await scraper.start()
         initialize_request_stats_monitor(args.request_stats_window)
@@ -311,10 +325,13 @@ class RouterApp:
         return web.json_response(payload)
 
     async def engines(self, request: web.Request) -> web.Response:
+        from production_stack_tpu.router.resilience import get_breaker_registry
+
         sd = get_service_discovery()
         out = []
         stats = get_engine_stats_scraper().get_engine_stats()
         rstats = get_request_stats_monitor().get_request_stats()
+        breakers = get_breaker_registry().states()
         for ep in sd.get_endpoint_info():
             d = {
                 "url": ep.url,
@@ -323,6 +340,9 @@ class RouterApp:
                 "sleep": ep.sleep,
                 "added": ep.added_timestamp,
             }
+            b = breakers.get(ep.url)
+            if b is not None:
+                d["breaker"] = b.state_name
             es = stats.get(ep.url)
             if es:
                 d["engine_stats"] = es.__dict__
@@ -330,7 +350,11 @@ class RouterApp:
             if rs:
                 d["request_stats"] = rs.__dict__
             out.append(d)
-        return web.json_response({"engines": out})
+        # active-check failures + open breakers: the pulled-from-rotation set
+        # (the breaker integration in service_discovery surfaces here)
+        return web.json_response(
+            {"engines": out, "unhealthy": sd.get_unhealthy_endpoint_urls()}
+        )
 
     async def version(self, request: web.Request) -> web.Response:
         return web.json_response({"version": __version__})
@@ -366,6 +390,11 @@ class RouterApp:
             gauge("vllm_router:engine_waiting_requests", es.num_queuing_requests, lab)
             gauge("vllm_router:gpu_cache_usage_perc", es.gpu_cache_usage_perc, lab)
             gauge("vllm_router:gpu_prefix_cache_hit_rate", es.gpu_prefix_cache_hit_rate, lab)
+        # failure-domain layer: vllm_router:retries_total,
+        # vllm_router:failovers_total, vllm_router:deadline_aborts_total,
+        # per-backend vllm_router:circuit_state (0=closed 1=half-open 2=open)
+        # and vllm_router:circuit_open_events_total
+        lines.extend(render_resilience_metrics())
         # per-hop TTFT breakdown (receive->route->backend-headers->first
         # chunk): attributes tail latency to a stage instead of "the stack".
         # One TYPE line per metric name (duplicates fail the whole scrape).
@@ -407,9 +436,11 @@ class RouterApp:
         """Clear the TTFT hop sample window (debug/bench endpoint) so a
         benchmark phase's hop quantiles describe only that phase."""
         from production_stack_tpu.router.request_service import reset_hop_samples
+        from production_stack_tpu.router.resilience import reset_counters
         from production_stack_tpu.tracing import get_collector
 
         reset_hop_samples()
+        reset_counters()
         # per-phase bench windows: traces too, so a phase's attribution table
         # describes only that phase's requests
         get_collector().reset()

@@ -67,9 +67,14 @@ class EngineStats:
 
 
 class EngineStatsScraper(metaclass=SingletonMeta):
+    # a snapshot older than this many scrape intervals is stale: load-aware
+    # routing must stop trusting a dead pod's last-good queue depth
+    STALE_INTERVALS = 3.0
+
     def __init__(self, scrape_interval: float = 15.0):
         self.scrape_interval = scrape_interval
         self.engine_stats: dict[str, EngineStats] = {}
+        self.last_success: dict[str, float] = {}  # url -> monotonic ts
         self._task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
@@ -84,6 +89,8 @@ class EngineStatsScraper(metaclass=SingletonMeta):
             self._task = None
 
     async def _loop(self) -> None:
+        import time
+
         from production_stack_tpu.router.service_discovery import get_service_discovery
 
         while True:
@@ -92,16 +99,36 @@ class EngineStatsScraper(metaclass=SingletonMeta):
                 results = await asyncio.gather(
                     *[self._scrape_one(ep.url) for ep in endpoints]
                 )
-                fresh = {
-                    ep.url: st for ep, st in zip(endpoints, results) if st is not None
-                }
-                self.engine_stats.update(fresh)
-                for url in list(self.engine_stats):
-                    if url not in {ep.url for ep in endpoints}:
-                        del self.engine_stats[url]
+                self.apply_scrape_results(
+                    [ep.url for ep in endpoints], results, time.monotonic()
+                )
             except Exception:
                 logger.exception("engine stats scrape failed")
             await asyncio.sleep(self.scrape_interval)
+
+    def apply_scrape_results(
+        self, urls: list[str], results: list[Optional[EngineStats]], now: float
+    ) -> None:
+        """Merge one scrape round. A failed scrape (None) keeps the previous
+        snapshot only within the staleness window — after STALE_INTERVALS
+        scrape intervals without a success the entry is DROPPED, so
+        load-aware routing stops trusting a dead pod's old queue depth."""
+        fresh = {url: st for url, st in zip(urls, results) if st is not None}
+        self.engine_stats.update(fresh)
+        for url in fresh:
+            self.last_success[url] = now
+        for url in list(self.engine_stats):
+            if url not in urls:
+                del self.engine_stats[url]
+                self.last_success.pop(url, None)
+        cutoff = now - self.STALE_INTERVALS * self.scrape_interval
+        for url in list(self.engine_stats):
+            if self.last_success.get(url, now) < cutoff:
+                logger.warning(
+                    "dropping stale engine stats for %s (no successful "
+                    "scrape in %.0fs)", url, now - self.last_success[url],
+                )
+                del self.engine_stats[url]
 
     async def _scrape_one(self, url: str) -> Optional[EngineStats]:
         from production_stack_tpu.router.request_service import get_client_session

@@ -100,6 +100,39 @@ def parse_args(argv=None):
                         "recording entirely")
     p.add_argument("--trace-buffer-size", type=int, default=4096,
                    help="span ring-buffer capacity (bounds tracer memory)")
+    # failure-domain layer (docs/failure-handling.md): retry/failover,
+    # deadlines, passive circuit breaking
+    p.add_argument("--retry-max-attempts", type=int, default=3,
+                   help="proxy attempt budget per request (connect-stage and "
+                        "pre-first-byte failures fail over to the routing "
+                        "logic's next choice; 1 = no retries)")
+    p.add_argument("--retry-backoff-base", type=float, default=0.05,
+                   help="base backoff seconds between proxy attempts "
+                        "(exponential with full jitter)")
+    p.add_argument("--retry-backoff-max", type=float, default=2.0,
+                   help="backoff cap in seconds")
+    p.add_argument("--deadline-request", type=float, default=0.0,
+                   help="seconds the ATTEMPT phase (connect + retries up to "
+                        "first byte) may take before the request 504s; 0 "
+                        "disables. Does not bound an already-streaming "
+                        "response")
+    p.add_argument("--deadline-ttft", type=float, default=0.0,
+                   help="seconds to wait for the backend's first response "
+                        "byte before aborting the engine-side request and "
+                        "failing over; 0 disables. NOTE: a non-streaming "
+                        "response's first byte arrives only when generation "
+                        "COMPLETES — set this above worst-case non-stream "
+                        "generation time (or serve long requests streamed)")
+    p.add_argument("--deadline-inter-chunk", type=float, default=0.0,
+                   help="max seconds between streamed chunks before the "
+                        "stream is aborted on the engine and terminated "
+                        "with an SSE error event; 0 disables")
+    p.add_argument("--breaker-failure-threshold", type=int, default=5,
+                   help="consecutive proxy failures that open a backend's "
+                        "circuit breaker (0 disables circuit breaking)")
+    p.add_argument("--breaker-cooldown", type=float, default=30.0,
+                   help="seconds an open breaker waits before admitting a "
+                        "half-open probe request")
     args = p.parse_args(argv)
     validate_args(args)
     return args
@@ -120,6 +153,14 @@ def validate_args(args) -> None:
             )
     if not 0.0 <= args.trace_sample_rate <= 1.0:
         raise ValueError("--trace-sample-rate must be in [0, 1]")
+    if args.retry_max_attempts < 1:
+        raise ValueError("--retry-max-attempts must be >= 1")
+    if args.retry_backoff_base < 0 or args.retry_backoff_max < 0:
+        raise ValueError("--retry-backoff-base/--retry-backoff-max must be >= 0")
+    for flag in ("deadline_request", "deadline_ttft", "deadline_inter_chunk",
+                 "breaker_cooldown"):
+        if getattr(args, flag) < 0:
+            raise ValueError(f"--{flag.replace('_', '-')} must be >= 0 (0 disables)")
     if args.trace_buffer_size < 1:
         raise ValueError("--trace-buffer-size must be >= 1")
     if args.routing_logic == "session" and not args.session_key:

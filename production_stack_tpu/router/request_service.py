@@ -12,15 +12,23 @@ too (SURVEY.md §4.3).
 
 from __future__ import annotations
 
+import asyncio
 import collections
 import json
 import time
 import uuid
-from typing import Optional
+from typing import Awaitable, Callable, Optional
 
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.router.resilience import (
+    count_deadline_abort,
+    count_failover,
+    count_retry,
+    get_breaker_registry,
+    get_retry_policy,
+)
 from production_stack_tpu.router.routing_logic import (
     DisaggregatedPrefillRouter,
     get_routing_logic,
@@ -63,9 +71,23 @@ latency_hist = Histogram(
 
 
 def record_hop_sample(recv_to_route: float, route_to_connect: float,
-                      connect_to_first: float) -> None:
-    _hop_samples.append((recv_to_route, route_to_connect, connect_to_first))
-    ttft_hist.observe((recv_to_route + route_to_connect + connect_to_first) / 1000)
+                      connect_to_first: float,
+                      ttft_s: Optional[float] = None) -> list:
+    """Append a TTFT hop sample and return it. The 4th slot is the request's
+    final outcome, tagged at proxy completion — a sample is recorded when the
+    first chunk arrives, but the stream may die later, and trace attribution
+    must distinguish completed from truncated streams.
+
+    ``ttft_s`` is the CLIENT-experienced TTFT for the histogram when it
+    differs from the hop sum: a failed-over request's hops describe the
+    successful attempt's stages, but its TTFT must still include the failed
+    attempts and backoff the client actually waited through."""
+    sample = [recv_to_route, route_to_connect, connect_to_first, "in_flight"]
+    _hop_samples.append(sample)
+    if ttft_s is None:
+        ttft_s = (recv_to_route + route_to_connect + connect_to_first) / 1000
+    ttft_hist.observe(ttft_s)
+    return sample
 
 
 def reset_hop_samples() -> None:
@@ -78,10 +100,11 @@ def reset_hop_samples() -> None:
 
 
 def get_hop_quantiles() -> dict:
-    """{hop: {p50, p99}} in ms over the sample window."""
+    """{hop: {p50, p99}} in ms over the sample window (the trailing outcome
+    tag is not a timing column)."""
     if not _hop_samples:
         return {}
-    cols = list(zip(*_hop_samples))
+    cols = list(zip(*_hop_samples))[:3]
     names = ("recv_to_route", "route_to_connect", "connect_to_first_chunk")
     out = {}
     for name, vals in zip(names, cols):
@@ -106,6 +129,10 @@ async def get_client_session() -> aiohttp.ClientSession:
 
 async def close_client_session() -> None:
     global _client_session
+    # in-flight fire-and-forget aborts would otherwise resurrect the session
+    # after close (abort_on_engine re-enters get_client_session)
+    for task in list(_abort_tasks):
+        task.cancel()
     if _client_session and not _client_session.closed:
         await _client_session.close()
     _client_session = None
@@ -114,6 +141,65 @@ async def close_client_session() -> None:
 def _filter_headers(headers) -> dict:
     hop = {"host", "content-length", "transfer-encoding", "connection"}
     return {k: v for k, v in headers.items() if k.lower() not in hop}
+
+
+class _RetryableProxyError(Exception):
+    """Connect-stage or pre-first-byte failure: no response bytes have
+    reached the client, so the request can safely fail over to another
+    backend. Mid-stream failures are NOT retryable — tokens already left."""
+
+    def __init__(self, reason: str, status: int = 502):
+        super().__init__(reason)
+        self.reason = reason
+        self.status = status
+
+
+async def abort_on_engine(backend_url: str, request_id: str) -> None:
+    """Best-effort engine-side abort (POST /abort): closing the proxy's TCP
+    connection only reaches a backend that is actively writing — a HUNG
+    engine would keep the scheduler slot and KV pages pinned forever. The
+    call is fire-and-forget: a plain-vLLM pod without /abort, or a dead pod,
+    must not add latency to the abort path."""
+    try:
+        session = await get_client_session()
+        async with session.post(
+            f"{backend_url}/abort",
+            json={"request_id": request_id},
+            timeout=aiohttp.ClientTimeout(total=2),
+        ):
+            pass
+    except Exception:  # noqa: BLE001 - abort is advisory
+        pass
+
+
+# strong refs for fire-and-forget abort tasks (a bare create_task could be
+# garbage-collected mid-flight); drained on close_client_session
+_abort_tasks: set = set()
+
+
+def spawn_abort(backend_url: str, request_id: str) -> "asyncio.Task":
+    """Fire-and-forget engine-side abort: the reclaim must not serialize into
+    the request path (a partitioned pod would add the abort call's full 2s
+    budget to every failover and delay the client's SSE error event). The
+    returned task is tracked so close_client_session can cancel stragglers
+    instead of letting them resurrect the shared session after close."""
+    task = asyncio.get_running_loop().create_task(
+        abort_on_engine(backend_url, request_id)
+    )
+    _abort_tasks.add(task)
+    task.add_done_callback(_abort_tasks.discard)
+    return task
+
+
+def _sse_error_event(message: str, code: int = 502) -> bytes:
+    """Terminal SSE error event (docs/failure-handling.md contract): a
+    mid-stream backend death must surface as an explicit `error` payload, not
+    a silently truncated 200. No [DONE] follows — its absence is how clients
+    distinguish an errored stream from a clean EOF. The leading blank line
+    forces an event boundary: the connection may have died MID-chunk, and
+    gluing this onto a partial `data:` line would make both unparseable."""
+    payload = {"error": {"message": message, "type": "upstream_error", "code": code}}
+    return f"\n\ndata: {json.dumps(payload)}\n\n".encode()
 
 
 async def process_request(
@@ -127,22 +213,133 @@ async def process_request(
     capture_body: Optional[object] = None,
     ts_recv: Optional[float] = None,
     trace_ctx=None,
+    pick_next: Optional[Callable[[set], Awaitable[Optional[str]]]] = None,
+    attempts_anchor: Optional[float] = None,
 ) -> web.StreamResponse:
     """Proxy `body` to backend and stream the response back, firing request
-    stats callbacks (parity request.py:54-138).
+    stats callbacks (parity request.py:54-138), with the failure-domain layer
+    wrapped around the attempt: connect-stage and pre-first-byte failures
+    retry with capped backoff against ``pick_next``'s next-choice endpoint
+    (excluding already-failed URLs), bounded by the attempt budget and the
+    per-request deadline; every outcome feeds the backend's circuit breaker.
 
     `capture_body(status, bytes)` — optional async callback fired with the full
     response once the proxy completes (semantic-cache store, post_request
     callbacks). ``ts_recv`` is the perf_counter when the router first saw the
     request, for the per-hop TTFT breakdown. ``trace_ctx`` is the router's
-    request-level span context; the proxy records a child span and propagates
-    a grandchild over ``traceparent`` so engine spans nest under the proxy."""
-    monitor = get_request_stats_monitor()
-    monitor.on_new_request(backend_url, request_id)
-    session = await get_client_session()
-    resp: Optional[web.StreamResponse] = None
-    captured: list[bytes] = []
+    request-level span context; each attempt records a child span and
+    propagates a grandchild over ``traceparent`` so engine spans nest under
+    the attempt that actually served them."""
+    policy = get_retry_policy()
+    breakers = get_breaker_registry()
     collector = get_collector()
+    # ``attempts_anchor`` lets a two-phase caller (disaggregated prefill)
+    # charge its phase-1 time against the same --deadline-request budget
+    # instead of granting the decode phase a fresh clock
+    t_attempts0 = attempts_anchor if attempts_anchor is not None else time.monotonic()
+    t_wall0 = time.time()
+    t_perf0 = time.perf_counter()
+    attempt = 0
+    tried: set[str] = set()
+    last_err: Optional[_RetryableProxyError] = None
+    try:
+        while True:
+            attempt += 1
+            tried.add(backend_url)
+            # retries forward an attempt-suffixed id: attempt 1's sequence may
+            # still be live on the engine (the abort is best-effort), and two
+            # live sequences with one seq_id would cross-wire their output
+            # queues. The client-visible X-Request-Id stays the original.
+            wire_id = request_id if attempt == 1 else f"{request_id}#r{attempt}"
+            try:
+                return await _proxy_attempt(
+                    request, body, backend_url, endpoint, request_id,
+                    wire_id=wire_id,
+                    attempt=attempt, capture_body=capture_body,
+                    ts_recv=ts_recv, trace_ctx=trace_ctx,
+                    policy=policy, breakers=breakers, t_attempts0=t_attempts0,
+                )
+            except _RetryableProxyError as e:
+                last_err = e
+                breakers.record_failure(backend_url)
+                logger.error(
+                    "backend %s failed for request %s (attempt %d/%d): %s",
+                    backend_url, request_id, attempt, policy.max_attempts, e.reason,
+                )
+            remaining = policy.remaining(t_attempts0)
+            if remaining is not None and remaining <= 0:
+                count_deadline_abort("request")
+                return web.json_response(
+                    {"error": f"request deadline exceeded after {attempt} "
+                              f"attempt(s): {last_err.reason}"},
+                    status=504,
+                )
+            if attempt >= policy.max_attempts:
+                break
+            nxt = None
+            if pick_next is not None:
+                try:
+                    nxt = await pick_next(tried)
+                except Exception:
+                    logger.exception("failover routing failed")
+            if nxt is None:
+                # no alternative endpoint: re-try the same backend only if
+                # its breaker still admits traffic, else give up now
+                if not breakers.allows(backend_url):
+                    break
+                nxt = backend_url
+            delay = policy.backoff(attempt)
+            if remaining is not None:
+                delay = min(delay, max(0.0, remaining))
+            count_retry()
+            if nxt != backend_url:
+                count_failover()
+                logger.warning(
+                    "failing request %s over: %s -> %s (attempt %d, backoff %.0f ms)",
+                    request_id, backend_url, nxt, attempt + 1, delay * 1000,
+                )
+            await asyncio.sleep(delay)
+            backend_url = nxt
+        return web.json_response(
+            {"error": f"backend error after {attempt} attempt(s): {last_err.reason}"},
+            status=last_err.status if last_err.status >= 500 else 502,
+        )
+    finally:
+        # fires on success, backend error, AND client disconnect
+        # (CancelledError): the router.request root span must record exactly
+        # once per request regardless of how many proxy attempts ran
+        if trace_ctx is not None:
+            start = t_wall0 - ((t_perf0 - ts_recv) if ts_recv else 0.0)
+            collector.record(
+                "router.request", trace_ctx, start,
+                time.perf_counter() - (ts_recv or t_perf0),
+                endpoint=endpoint, request_id=request_id, attempts=attempt,
+            )
+
+
+async def _proxy_attempt(
+    request: web.Request,
+    body: bytes,
+    backend_url: str,
+    endpoint: str,
+    request_id: str,
+    *,
+    wire_id: Optional[str] = None,
+    attempt: int,
+    capture_body,
+    ts_recv,
+    trace_ctx,
+    policy,
+    breakers,
+    t_attempts0: float,
+) -> web.StreamResponse:
+    """One proxy attempt. Raises _RetryableProxyError while failover is still
+    possible (nothing sent to the client); after the response is committed,
+    failures terminate the stream with the SSE error-event contract."""
+    monitor = get_request_stats_monitor()
+    session = await get_client_session()
+    collector = get_collector()
+    wire_id = wire_id or request_id
     proxy_ctx = trace_ctx.child() if trace_ctx is not None else None
     # Always forward X-Request-Id (router-generated when the client sent
     # none): the engine honors it (api_server req_id), so router and engine
@@ -154,82 +351,217 @@ async def process_request(
         for k, v in _filter_headers(request.headers).items()
         if k.lower() not in ("x-request-id", TRACEPARENT_HEADER)
     }
-    out_headers["X-Request-Id"] = request_id
+    out_headers["X-Request-Id"] = wire_id
     if proxy_ctx is not None:
         out_headers[TRACEPARENT_HEADER] = proxy_ctx.to_traceparent()
     t_wall = time.time()
     t_route = time.perf_counter()
-    proxy_attrs = {"backend": backend_url, "request_id": request_id}
+    proxy_attrs = {"backend": backend_url, "request_id": request_id,
+                   "attempt": attempt}
+    if wire_id != request_id:
+        proxy_attrs["wire_id"] = wire_id  # engine-side id for this attempt
+    outcome = "error"
+    hop_sample: Optional[list] = None
+    backend_resp: Optional[aiohttp.ClientResponse] = None
+    resp: Optional[web.StreamResponse] = None
+    monitor.on_new_request(backend_url, request_id)
+
+    # pre-first-byte budget: TTFT deadline, clamped by what's left of the
+    # per-request (attempt-phase) deadline
+    ttft_deadline_at: Optional[float] = None
+    if policy.deadline_ttft > 0:
+        ttft_deadline_at = time.monotonic() + policy.deadline_ttft
+    remaining = policy.remaining(t_attempts0)
+    if remaining is not None:
+        at = time.monotonic() + max(0.0, remaining)
+        ttft_deadline_at = min(ttft_deadline_at, at) if ttft_deadline_at else at
+
+    async def _bounded(awaitable, *, kind: str):
+        """Await within the pre-first-byte deadline; deadline expiry aborts
+        the engine-side request and converts to a retryable failure."""
+        if ttft_deadline_at is None:
+            return await awaitable
+        budget = ttft_deadline_at - time.monotonic()
+        try:
+            return await asyncio.wait_for(awaitable, max(budget, 0.001))
+        except asyncio.TimeoutError:
+            count_deadline_abort(kind)
+            spawn_abort(backend_url, wire_id)
+            raise _RetryableProxyError(
+                f"no first byte from {backend_url} within deadline "
+                f"({kind})", 504,
+            ) from None
+
     try:
-        async with session.post(
-            f"{backend_url}{endpoint}",
-            data=body,
-            headers=out_headers,
-        ) as backend_resp:
-            t_conn = time.perf_counter()
-            resp = web.StreamResponse(
-                status=backend_resp.status,
-                headers={
-                    **_filter_headers(backend_resp.headers),
-                    "X-Request-Id": request_id,
-                },
+        # ---- retryable stage: connect + headers + first chunk -------------
+        try:
+            backend_resp = await _bounded(
+                session.post(f"{backend_url}{endpoint}", data=body,
+                             headers=out_headers),
+                kind="ttft",
             )
-            await resp.prepare(request)
-            first = True
-            async for chunk in backend_resp.content.iter_any():
-                if first:
-                    monitor.on_request_response(backend_url, request_id)
-                    first = False
-                    t_first = time.perf_counter()
-                    record_hop_sample(
-                        (t_route - (ts_recv or t_route)) * 1000,
-                        (t_conn - t_route) * 1000,
-                        (t_first - t_conn) * 1000,
+        except (aiohttp.ClientError, ConnectionResetError, OSError) as e:
+            raise _RetryableProxyError(f"connect failed: {e}") from e
+        t_conn = time.perf_counter()
+        if backend_resp.status >= 500:
+            # a 5xx body is small and already formed; drain it (bounded — a
+            # backend that hangs after its error headers must not pin us)
+            try:
+                detail = (await asyncio.wait_for(backend_resp.read(), 2.0))[:200]
+            except Exception:  # noqa: BLE001 - body is best-effort detail
+                detail = b""
+            raise _RetryableProxyError(
+                f"backend returned {backend_resp.status}: "
+                f"{detail.decode(errors='replace')}",
+                backend_resp.status,
+            )
+        chunks = backend_resp.content.iter_any()
+        first_chunk: Optional[bytes] = None
+        try:
+            first_chunk = await _bounded(chunks.__anext__(), kind="ttft")
+        except StopAsyncIteration:
+            pass  # empty body (204s, HEAD-ish replies): still a success
+        except (aiohttp.ClientError, ConnectionResetError) as e:
+            raise _RetryableProxyError(f"died before first byte: {e}") from e
+
+        # ---- committed stage: headers are sent, no more failover ----------
+        resp = web.StreamResponse(
+            status=backend_resp.status,
+            headers={
+                **_filter_headers(backend_resp.headers),
+                "X-Request-Id": request_id,
+            },
+        )
+        await resp.prepare(request)
+        is_sse = "text/event-stream" in (
+            backend_resp.headers.get("Content-Type") or ""
+        )
+        stall_timeout = policy.deadline_inter_chunk or None
+        captured: list[bytes] = []
+        first = True
+        chunk = first_chunk
+        while chunk is not None:
+            if first:
+                monitor.on_request_response(backend_url, request_id)
+                first = False
+                t_first = time.perf_counter()
+                # hop columns are attempt-relative (stage costs stay honest:
+                # retry/backoff time of earlier attempts must not pollute the
+                # recv_to_route quantiles); the TTFT histogram still gets the
+                # full client-experienced window including failed attempts
+                hop_sample = record_hop_sample(
+                    (t_route - (ts_recv or t_route)) * 1000 if attempt == 1 else 0.0,
+                    (t_conn - t_route) * 1000,
+                    (t_first - t_conn) * 1000,
+                    ttft_s=t_first - (ts_recv or t_route),
+                )
+            else:
+                monitor.on_token(backend_url, request_id)
+            if capture_body is not None:
+                captured.append(chunk)
+            await resp.write(chunk)
+            try:
+                # per-chunk wait_for costs a Task per chunk, but only when
+                # the stall deadline is enabled. ClientTimeout(sock_read=…)
+                # would be cheaper but ALSO bounds the pre-first-byte gap,
+                # which must stay governed by the (longer) TTFT deadline —
+                # a slow prefill is not a stalled stream.
+                if stall_timeout:
+                    chunk = await asyncio.wait_for(
+                        chunks.__anext__(), stall_timeout
                     )
                 else:
-                    monitor.on_token(backend_url, request_id)
-                if capture_body is not None:
-                    captured.append(chunk)
-                await resp.write(chunk)
-            await resp.write_eof()
-            latency_hist.observe(
-                time.perf_counter() - (ts_recv or t_route)
-            )
-            proxy_attrs["status"] = backend_resp.status
-            if capture_body is not None:
-                await capture_body(backend_resp.status, b"".join(captured))
-            return resp
-    except (aiohttp.ClientError, ConnectionResetError) as e:
-        logger.error("backend %s failed for request %s: %s", backend_url, request_id, e)
-        proxy_attrs["error"] = str(e)
-        if resp is None or not resp.prepared:
-            return web.json_response({"error": f"backend error: {e}"}, status=502)
-        # headers already sent: terminate the stream instead of sending a
-        # second response on the same connection
-        try:
-            await resp.write_eof()
-        except Exception:
-            pass
+                    chunk = await chunks.__anext__()
+            except StopAsyncIteration:
+                chunk = None
+            except asyncio.TimeoutError:
+                # mid-stream stall: reclaim the engine slot and tell the
+                # client explicitly — never leave a silently-frozen 200
+                count_deadline_abort("inter_chunk")
+                spawn_abort(backend_url, wire_id)
+                backend_resp.close()
+                breakers.record_failure(backend_url)
+                outcome = "deadline_inter_chunk"
+                proxy_attrs["error"] = (
+                    f"stream stalled > {stall_timeout}s between chunks"
+                )
+                logger.error(
+                    "request %s stalled on %s (> %.1fs between chunks); aborted",
+                    request_id, backend_url, stall_timeout,
+                )
+                if is_sse:
+                    await resp.write(_sse_error_event(
+                        f"upstream stream stalled after {stall_timeout}s; aborted",
+                        504,
+                    ))
+                await resp.write_eof()
+                return resp
+            except (aiohttp.ClientError, ConnectionResetError) as e:
+                breakers.record_failure(backend_url)
+                outcome = "truncated"
+                proxy_attrs["error"] = str(e)
+                logger.error(
+                    "backend %s died mid-stream for request %s: %s",
+                    backend_url, request_id, e,
+                )
+                if is_sse:
+                    await resp.write(_sse_error_event(
+                        f"upstream connection lost mid-stream: {e}", 502,
+                    ))
+                try:
+                    await resp.write_eof()
+                except Exception:  # noqa: BLE001 - client may be gone too
+                    pass
+                return resp
+        await resp.write_eof()
+        latency_hist.observe(time.perf_counter() - (ts_recv or t_route))
+        proxy_attrs["status"] = backend_resp.status
+        outcome = "ok"
+        breakers.record_success(backend_url)
+        if capture_body is not None:
+            await capture_body(backend_resp.status, b"".join(captured))
         return resp
+    except _RetryableProxyError:
+        outcome = "retryable_error"
+        if backend_resp is not None:
+            backend_resp.close()
+        raise
+    except ConnectionResetError:
+        # CLIENT went away mid-write (headers already sent): backend-side
+        # resets are converted to _RetryableProxyError / truncated above, so
+        # a reset here is ours. Release the backend leg and reclaim the
+        # engine slot; there is nobody left to stream to.
+        outcome = "client_disconnect"
+        if backend_resp is not None:
+            backend_resp.close()
+        spawn_abort(backend_url, wire_id)
+        return resp
+    except asyncio.CancelledError:
+        # client disconnect: close the backend leg so an actively-writing
+        # engine notices; a hung one is covered by the abort call
+        outcome = "client_disconnect"
+        if backend_resp is not None:
+            backend_resp.close()
+        # shielded await over a TRACKED task: this handler is being torn
+        # down, so the abort must survive our cancellation — but it must
+        # also stay cancellable by close_client_session at shutdown, or it
+        # could resurrect the shared session after close
+        await asyncio.shield(spawn_abort(backend_url, wire_id))
+        raise
     finally:
-        # fires on success, backend error, AND client disconnect
-        # (CancelledError). Both spans record HERE so a disconnect cannot
-        # record the router.request root while dropping the router.proxy
+        # fires on every exit path so a disconnect cannot record the
+        # router.request root while dropping this attempt's router.proxy
         # span — that would orphan the engine subtree (parented under
         # proxy_ctx) out of the attribution and misattribute engine time
         # to the router
         monitor.on_request_complete(backend_url, request_id)
+        proxy_attrs["outcome"] = outcome
+        if hop_sample is not None:
+            hop_sample[3] = outcome
         collector.record(
             "router.proxy", proxy_ctx, t_wall,
             time.perf_counter() - t_route, **proxy_attrs,
         )
-        if trace_ctx is not None:
-            start = t_wall - ((t_route - ts_recv) if ts_recv else 0.0)
-            collector.record(
-                "router.request", trace_ctx, start,
-                time.perf_counter() - (ts_recv or t_route),
-                endpoint=endpoint, request_id=request_id,
-            )
 
 
 async def route_general_request(
@@ -285,6 +617,12 @@ async def route_general_request(
             {"error": f"no healthy endpoints for model {requested_model!r}"}, status=503
         )
 
+    # passive circuit breaking: open-breaker backends drop out of the
+    # candidate set (fail-static: an all-open set passes through unchanged,
+    # so a fully-tripped fleet degrades to "try anyway", never a hard 503)
+    candidates = endpoints
+    endpoints = get_breaker_registry().filter_endpoints(endpoints)
+
     engine_stats = get_engine_stats_scraper().get_engine_stats()
     request_stats = get_request_stats_monitor().get_request_stats()
     t_route0 = time.perf_counter()
@@ -295,6 +633,22 @@ async def route_general_request(
     except Exception as e:
         logger.exception("routing failed")
         return web.json_response({"error": f"routing failure: {e}"}, status=500)
+
+    async def pick_next(excluded: set) -> Optional[str]:
+        """Failover target: re-run the routing logic over the surviving
+        candidates (already-failed URLs excluded, open breakers excluded
+        WITHOUT the fail-static fallback — if every alternative is tripped,
+        surfacing the original error beats queueing on a known-bad pod)."""
+        pool = [ep for ep in candidates if ep.url not in excluded]
+        pool = get_breaker_registry().filter_endpoints(pool, fail_static=False)
+        if not pool:
+            return None
+        return await router.route_request(
+            pool,
+            get_engine_stats_scraper().get_engine_stats(),
+            get_request_stats_monitor().get_request_stats(),
+            request, request_json,
+        )
 
     curr_time = time.time()
     get_collector().record(
@@ -312,15 +666,22 @@ async def route_general_request(
     return await process_request(
         request, body, server_url, endpoint, request_id,
         is_streaming=is_streaming, capture_body=capture_body, ts_recv=ts_recv,
-        trace_ctx=trace_ctx,
+        trace_ctx=trace_ctx, pick_next=pick_next,
     )
 
 
 async def send_request_to_prefiller(
     session: aiohttp.ClientSession, url: str, endpoint: str, payload: dict,
-    request_id: str, trace_ctx=None,
-) -> dict:
-    """Phase 1: run prefill with max_tokens=1 (parity request.py:307-325)."""
+    request_id: str, trace_ctx=None, timeout: Optional[float] = None,
+) -> "tuple[int, dict]":
+    """Phase 1: run prefill with max_tokens=1 (parity request.py:307-325).
+    ``timeout`` bounds the whole phase — a hung prefiller must convert to a
+    failover, not pin the request (and its KV pages) forever.
+
+    Returns ``(status, body)`` for non-5xx responses; raises
+    _RetryableProxyError for 5xx so only genuine backend failures enter the
+    retry/breaker path — a 400 (client's fault) must pass through verbatim,
+    not trip every healthy prefiller's breaker."""
     headers = {"X-Request-Id": request_id}
     if trace_ctx is not None:
         headers[TRACEPARENT_HEADER] = trace_ctx.to_traceparent()
@@ -328,9 +689,20 @@ async def send_request_to_prefiller(
         f"{url}{endpoint}",
         json=payload,
         headers=headers,
+        timeout=aiohttp.ClientTimeout(total=timeout or None),
     ) as resp:
-        resp.raise_for_status()
-        return await resp.json()
+        if resp.status >= 500:
+            detail = (await resp.read())[:200]
+            raise _RetryableProxyError(
+                f"prefiller returned {resp.status}: "
+                f"{detail.decode(errors='replace')}",
+                resp.status,
+            )
+        try:
+            body = await resp.json()
+        except Exception:  # noqa: BLE001 - non-JSON 4xx body
+            body = {"error": (await resp.text())[:500]}
+        return resp.status, body
 
 
 async def route_disaggregated_prefill_request(
@@ -345,6 +717,11 @@ async def route_disaggregated_prefill_request(
     endpoints = [ep for ep in get_service_discovery().get_endpoint_info() if not ep.sleep]
     if not endpoints:
         return web.json_response({"error": "no endpoints"}, status=503)
+    policy = get_retry_policy()
+    breakers = get_breaker_registry()
+    # no set-wide pre-filter here: route_prefill/route_decode breaker-filter
+    # per ROLE internally, so a tripped prefiller degrades fail-static within
+    # the prefill pool instead of re-homing prefill onto decode pods
     prefill_url = router.route_prefill(endpoints)
     decode_url = router.route_decode(endpoints)
     monitor = get_request_stats_monitor()
@@ -356,28 +733,105 @@ async def route_disaggregated_prefill_request(
     prefill_json["stream"] = False
     prefill_json.setdefault("kv_transfer_params", {})["request_id"] = request_id
 
-    t0 = time.time()
-    monitor.on_new_request(prefill_url, request_id)
+    t_start = time.time()
+    t_attempts0 = time.monotonic()  # --deadline-request anchor, both phases
     logger.info(
         "Routing request %s for model %s to prefill=%s decode=%s at %f",
-        request_id, request_json.get("model"), prefill_url, decode_url, t0,
+        request_id, request_json.get("model"), prefill_url, decode_url, t_start,
     )
-    prefill_ctx = trace_ctx.child() if trace_ctx is not None else None
-    try:
-        await send_request_to_prefiller(
-            session, prefill_url, endpoint, prefill_json, request_id,
-            trace_ctx=prefill_ctx,
-        )
-        monitor.on_request_response(prefill_url, request_id)
-        monitor.on_request_complete(prefill_url, request_id)
-        logger.info("Prefill of %s done in %.3fs (TTFT)", request_id, time.time() - t0)
-        get_collector().record(
-            "router.disagg_prefill", prefill_ctx, t0, time.time() - t0,
-            backend=prefill_url, request_id=request_id,
-        )
-    except aiohttp.ClientError as e:
-        monitor.on_request_complete(prefill_url, request_id)
-        return web.json_response({"error": f"prefill failed: {e}"}, status=502)
+
+    def _phase_timeout() -> Optional[float]:
+        """Per-attempt prefill timeout: the TTFT deadline clamped by what is
+        left of the per-request (attempt-phase) deadline."""
+        t = policy.deadline_ttft if policy.deadline_ttft > 0 else None
+        rem = policy.remaining(t_attempts0)
+        if rem is not None:
+            t = min(t, max(rem, 0.001)) if t else max(rem, 0.001)
+        return t
+
+    # phase-1 failover: a failed/hung prefiller retries against another
+    # prefiller (already-failed URLs excluded), same budget/backoff/deadline
+    # as the general proxy path
+    attempt = 0
+    tried: set = set()
+    while True:
+        attempt += 1
+        tried.add(prefill_url)
+        t0 = time.time()
+        monitor.on_new_request(prefill_url, request_id)
+        prefill_ctx = trace_ctx.child() if trace_ctx is not None else None
+        try:
+            status, prefill_body = await send_request_to_prefiller(
+                session, prefill_url, endpoint, prefill_json, request_id,
+                trace_ctx=prefill_ctx,
+                timeout=_phase_timeout(),
+            )
+            if status >= 400:
+                # 4xx: the CLIENT's fault and the prefiller is alive —
+                # forward verbatim; retrying it against other prefillers
+                # would trip every healthy breaker on bad client traffic
+                monitor.on_request_complete(prefill_url, request_id)
+                breakers.record_success(prefill_url)
+                return web.json_response(prefill_body, status=status)
+            monitor.on_request_response(prefill_url, request_id)
+            monitor.on_request_complete(prefill_url, request_id)
+            breakers.record_success(prefill_url)
+            logger.info("Prefill of %s done in %.3fs (TTFT)", request_id, time.time() - t0)
+            get_collector().record(
+                "router.disagg_prefill", prefill_ctx, t0, time.time() - t0,
+                backend=prefill_url, request_id=request_id, attempt=attempt,
+            )
+            break
+        except (_RetryableProxyError, aiohttp.ClientError, asyncio.TimeoutError,
+                ConnectionResetError) as e:
+            monitor.on_request_complete(prefill_url, request_id)
+            breakers.record_failure(prefill_url)
+            if isinstance(e, asyncio.TimeoutError):
+                count_deadline_abort("ttft")
+                spawn_abort(prefill_url, request_id)
+            get_collector().record(
+                "router.disagg_prefill", prefill_ctx, t0, time.time() - t0,
+                backend=prefill_url, request_id=request_id, attempt=attempt,
+                error=str(e), outcome="retryable_error",
+            )
+            logger.error(
+                "prefill on %s failed for request %s (attempt %d/%d): %s",
+                prefill_url, request_id, attempt, policy.max_attempts, e,
+            )
+            remaining = policy.remaining(t_attempts0)
+            if remaining is not None and remaining <= 0:
+                count_deadline_abort("request")
+                return web.json_response(
+                    {"error": f"request deadline exceeded after {attempt} "
+                              f"prefill attempt(s): {e}"},
+                    status=504,
+                )
+            # untried endpoints only, and ROLE-correct: when the deployment
+            # has prefill-labeled pods, failover must stay within them —
+            # _pick's label fallback would otherwise silently run prefill on
+            # a decode pod, breaking the disaggregation invariant. With no
+            # labeled pods anywhere (label-less test rigs) any pod is fair.
+            pool = [ep for ep in endpoints if ep.url not in tried]
+            if any(ep.model_label in router.prefill_labels for ep in endpoints):
+                pool = [ep for ep in pool
+                        if ep.model_label in router.prefill_labels]
+            if attempt >= policy.max_attempts or not pool:
+                return web.json_response(
+                    {"error": f"prefill failed after {attempt} attempt(s): {e}"},
+                    status=502,
+                )
+            count_retry()
+            count_failover()
+            delay = policy.backoff(attempt)
+            if remaining is not None:
+                delay = min(delay, max(0.0, remaining))
+            await asyncio.sleep(delay)
+            prefill_url = router.route_prefill(pool)
+
+    async def pick_next_decode(excluded: set) -> Optional[str]:
+        pool = [ep for ep in endpoints if ep.url not in excluded]
+        pool = breakers.filter_endpoints(pool, fail_static=False)
+        return router.route_decode(pool) if pool else None
 
     decode_json = dict(request_json)
     decode_json["max_tokens"] = orig_max_tokens
@@ -390,7 +844,8 @@ async def route_disaggregated_prefill_request(
     return await process_request(
         request, body, decode_url, endpoint, request_id,
         is_streaming=bool(request_json.get("stream", False)),
-        trace_ctx=trace_ctx, ts_recv=ts_recv,
+        trace_ctx=trace_ctx, ts_recv=ts_recv, pick_next=pick_next_decode,
+        attempts_anchor=t_attempts0,
     )
 
 

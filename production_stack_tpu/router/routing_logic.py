@@ -38,6 +38,16 @@ class RoutingInterface(metaclass=SingletonMeta):
         request_json: Optional[dict] = None,
     ) -> str: ...
 
+    @staticmethod
+    def breaker_filtered(endpoints: list[EndpointInfo]) -> list[EndpointInfo]:
+        """Passive-circuit-breaker consultation: drop endpoints whose breaker
+        is open (fail-static — an all-open set passes through unchanged).
+        Idempotent, so request_service pre-filtering composes with routing
+        implementations that call this themselves."""
+        from production_stack_tpu.router.resilience import get_breaker_registry
+
+        return get_breaker_registry().filter_endpoints(endpoints)
+
 
 def _qps_routing(endpoints: list[EndpointInfo], request_stats: dict[str, Any]) -> str:
     """Lowest-QPS endpoint (parity :59-81)."""
@@ -223,9 +233,14 @@ class DisaggregatedPrefillRouter(RoutingInterface):
         self._rr = {"prefill": 0, "decode": 0}
 
     def _pick(self, endpoints: list[EndpointInfo], labels: list[str], kind: str) -> str:
-        pool = sorted(
-            ep.url for ep in endpoints if ep.model_label in labels
-        ) or sorted(ep.url for ep in endpoints)
+        # breaker-aware even for direct route_prefill/route_decode callers —
+        # but the breaker filter runs AFTER label selection so fail-static is
+        # per ROLE: when every prefill-labeled pod is tripped, keep trying
+        # the tripped prefillers rather than silently re-homing prefill
+        # traffic onto decode-labeled pods
+        role = [ep for ep in endpoints if ep.model_label in labels] or list(endpoints)
+        role = self.breaker_filtered(role)
+        pool = sorted(ep.url for ep in role)
         url = pool[self._rr[kind] % len(pool)]
         self._rr[kind] += 1
         return url

@@ -98,7 +98,16 @@ class ServiceDiscovery(ABC):
         return names
 
     def get_unhealthy_endpoint_urls(self) -> list[str]:
-        return []
+        # passive circuit breaking feeds the health surface for every
+        # discovery kind: a backend with an open breaker is unhealthy even
+        # when no active health loop is configured
+        return self._breaker_open_urls()
+
+    @staticmethod
+    def _breaker_open_urls() -> list[str]:
+        from production_stack_tpu.router.resilience import get_breaker_registry
+
+        return get_breaker_registry().open_urls()
 
 
 class StaticServiceDiscovery(ServiceDiscovery):
@@ -142,6 +151,8 @@ class StaticServiceDiscovery(ServiceDiscovery):
             self._task = None
 
     async def _health_loop(self) -> None:
+        from production_stack_tpu.router.resilience import get_breaker_registry
+
         while True:
             try:
                 unhealthy: set[str] = set()
@@ -150,6 +161,13 @@ class StaticServiceDiscovery(ServiceDiscovery):
                 ):
                     if not await is_model_healthy(url, model, mtype):
                         unhealthy.add(url)
+                    else:
+                        # active-probe success fast-tracks an OPEN breaker to
+                        # half-open (skipping the cooldown) but does NOT close
+                        # it or reset the failure streak: a backend can pass
+                        # the 1-token dummy probe while failing real traffic,
+                        # so only a data-plane success may close the breaker
+                        get_breaker_registry().record_probe_success(url)
                 if unhealthy != self.unhealthy:
                     logger.warning("unhealthy endpoints: %s", sorted(unhealthy))
                 self.unhealthy = unhealthy
@@ -158,7 +176,7 @@ class StaticServiceDiscovery(ServiceDiscovery):
             await asyncio.sleep(self.health_check_interval)
 
     def get_unhealthy_endpoint_urls(self) -> list[str]:
-        return sorted(self.unhealthy)
+        return sorted(set(self.unhealthy) | set(self._breaker_open_urls()))
 
     async def set_sleep_label(self, url: str, sleep: bool) -> None:
         if sleep:
@@ -304,6 +322,14 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
             async with self._lock:
                 if name in self.endpoints:
                     logger.info("Removing engine %s", name)
+                    # drop the pod's breaker with it: a replacement pod that
+                    # reuses the IP must start closed, not inherit the
+                    # corpse's open state
+                    from production_stack_tpu.router.resilience import (
+                        get_breaker_registry,
+                    )
+
+                    get_breaker_registry().forget(self.endpoints[name].url)
                     del self.endpoints[name]
             return
         models = await self._get_model_names(pod_ip)

@@ -3,8 +3,23 @@ accelerators — the keystone test fixture.
 
 Parity: src/tests/perftest/fake-openai-server.py:1-170 in /root/reference
 (streams tokens at --speed with injectable --ttft, tracks running requests),
-extended with /metrics in the engine's vllm:* format, sleep/wake, and optional
-kv-transfer query params so disaggregated-prefill flows are testable.
+extended with /metrics in the engine's vllm:* format, sleep/wake, optional
+kv-transfer query params so disaggregated-prefill flows are testable, and
+fault injection for the router's failure-domain layer (tests/test_chaos.py,
+scripts/chaos_check.py):
+
+- ``--fail-rate P``      each generation request 500s with probability P
+- ``--fail-first-n N``   the first N generation requests 500, then recover
+- ``--fail-after-chunks N``  streams N chunks then drops the connection
+                         (mid-stream truncation)
+- ``--hang``             accepts the request, never sends headers (hung
+                         engine; only an abort or a router deadline frees it)
+- ``--hang-after-chunks N``  streams N chunks then stalls forever
+- ``POST /abort``        cancels an in-flight request by X-Request-Id, like
+                         the real engine's abort endpoint
+
+SIGTERM drains like the real engine (api_server graceful drain): /health
+flips to 503, new generation requests are refused, in-flight streams finish.
 """
 
 from __future__ import annotations
@@ -12,6 +27,8 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import random
+import signal
 import time
 import uuid
 
@@ -30,11 +47,24 @@ STATE = {
     "running": 0,
     "total": 0,
     "sleeping": False,
+    "draining": False,
+    "served": 0,            # generation requests seen (drives --fail-first-n)
+    "inflight": {},         # req_id -> handler asyncio.Task (for /abort)
 }
 
 
-def make_app(model: str, speed: float, ttft: float, model_label: str | None = None):
+def make_app(model: str, speed: float, ttft: float, model_label: str | None = None,
+             faults: dict | None = None):
+    faults = faults or {}
+    fail_rate = float(faults.get("fail_rate", 0.0))
+    fail_first_n = int(faults.get("fail_first_n", 0))
+    fail_after_chunks = faults.get("fail_after_chunks")
+    hang = bool(faults.get("hang", False))
+    hang_after_chunks = faults.get("hang_after_chunks")
+
     async def health(request):
+        if STATE["draining"]:
+            return web.Response(status=503, text="draining")
         return web.Response(text="")
 
     async def models(request):
@@ -78,6 +108,11 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     async def _generate(request, chat: bool):
         if STATE["sleeping"]:
             return web.json_response({"error": "sleeping"}, status=503)
+        if STATE["draining"]:
+            return web.json_response(
+                {"error": {"message": "engine is draining for shutdown"}},
+                status=503,
+            )
         body = await request.json()
         max_tokens = int(body.get("max_tokens", 16))
         stream = bool(body.get("stream", False))
@@ -86,6 +121,17 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         if uid:
             # visible marker for tests asserting user-id header propagation
             print(f"x-user-id={uid}", flush=True)
+        # fault injection: 500s fire BEFORE a slot is held (connect-stage
+        # failure from the router's point of view)
+        STATE["served"] += 1
+        if fail_first_n and STATE["served"] <= fail_first_n:
+            return web.json_response(
+                {"error": {"message": "injected failure (fail-first-n)"}}, status=500
+            )
+        if fail_rate and random.random() < fail_rate:
+            return web.json_response(
+                {"error": {"message": "injected failure (fail-rate)"}}, status=500
+            )
         # distributed tracing, same span model as the real engine
         # (engine.request > queue/prefill/decode) so router e2e tests can
         # assert full-stack trace propagation without a TPU
@@ -94,6 +140,9 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         t_accept = time.time()
         STATE["running"] += 1
         STATE["total"] += 1
+        # registered while holding a slot so POST /abort can cancel this
+        # handler and free the slot, like the real engine's abort endpoint
+        STATE["inflight"][req_id] = asyncio.current_task()
         created = int(time.time())
         oid = ("chatcmpl-" if chat else "cmpl-") + req_id
 
@@ -113,6 +162,11 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                 )
 
         try:
+            if hang:
+                # hung engine: the slot stays pinned until /abort (or process
+                # death) — exactly the failure the router's TTFT deadline +
+                # engine abort must reclaim
+                await asyncio.Event().wait()
             t_q = time.time()
             _phase("engine.queue", t_accept, t_q - t_accept)
             queue_time_hist.observe(t_q - t_accept)
@@ -146,6 +200,16 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             )
             await resp.prepare(request)
             for i in range(max_tokens):
+                if fail_after_chunks is not None and i >= int(fail_after_chunks):
+                    # mid-stream truncation: drop the TCP connection without
+                    # a chunked terminator, so the proxy sees a payload error
+                    request.transport.close()
+                    return resp
+                if hang_after_chunks is not None and i >= int(hang_after_chunks):
+                    # mid-stream stall: chunks stop flowing but the
+                    # connection stays up — only the router's inter-chunk
+                    # deadline (or /abort) ends this
+                    await asyncio.Event().wait()
                 delta = {"content": "Hello "} if chat else None
                 choice = (
                     {"index": 0, "delta": delta, "finish_reason": None}
@@ -162,10 +226,24 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             return resp
         finally:
             STATE["running"] -= 1
+            STATE["inflight"].pop(req_id, None)
             collector.record(
                 "engine.request", trace_ctx, t_accept,
                 time.time() - t_accept, request_id=req_id, model=model,
             )
+
+    async def abort(request):
+        """Router-initiated abort, same contract as the real engine's
+        POST /abort: cancel the in-flight handler, freeing the slot."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            body = {}
+        rid = body.get("request_id") or request.query.get("request_id")
+        task = STATE["inflight"].pop(rid, None)
+        if task is not None:
+            task.cancel()
+        return web.json_response({"request_id": rid, "aborted": task is not None})
 
     async def sleep(request):
         STATE["sleeping"] = True
@@ -192,11 +270,32 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     app.router.add_get("/v1/traces", traces)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat)
+    app.router.add_post("/abort", abort)
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/tokenize", tokenize)
     return app
+
+
+async def _serve_until_sigterm(app, port: int) -> None:
+    """Run the app; on SIGTERM/SIGINT drain like the real engine: /health
+    flips 503 (readiness pulls the pod), in-flight requests get a bounded
+    window to finish, then the server exits cleanly."""
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, port=port, shutdown_timeout=1.0)
+    await site.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    STATE["draining"] = True
+    deadline = time.time() + 5.0
+    while STATE["running"] > 0 and time.time() < deadline:
+        await asyncio.sleep(0.1)
+    await runner.cleanup()
 
 
 def main():
@@ -206,11 +305,29 @@ def main():
     p.add_argument("--speed", type=float, default=100.0, help="tokens per second")
     p.add_argument("--ttft", type=float, default=0.0, help="injected TTFT seconds")
     p.add_argument("--model-label", default=None)
+    # fault injection (router failure-domain tests)
+    p.add_argument("--fail-rate", type=float, default=0.0,
+                   help="probability a generation request 500s")
+    p.add_argument("--fail-first-n", type=int, default=0,
+                   help="first N generation requests 500, then recover")
+    p.add_argument("--fail-after-chunks", type=int, default=None,
+                   help="drop the connection after N streamed chunks")
+    p.add_argument("--hang", action="store_true",
+                   help="accept generation requests but never respond")
+    p.add_argument("--hang-after-chunks", type=int, default=None,
+                   help="stall the stream after N chunks (connection stays up)")
     args = p.parse_args()
-    web.run_app(
-        make_app(args.model, args.speed, args.ttft, args.model_label),
-        port=args.port, print=None,
+    app = make_app(
+        args.model, args.speed, args.ttft, args.model_label,
+        faults={
+            "fail_rate": args.fail_rate,
+            "fail_first_n": args.fail_first_n,
+            "fail_after_chunks": args.fail_after_chunks,
+            "hang": args.hang,
+            "hang_after_chunks": args.hang_after_chunks,
+        },
     )
+    asyncio.run(_serve_until_sigterm(app, args.port))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Chaos smoke for the router's failure-domain layer (docs/failure-handling.md).
+
+Launches three fake engines — one ``--fail-rate 1.0`` (every request 500s),
+one ``--hang`` (accepts requests, never responds), one healthy — behind a
+router with retry/failover, a TTFT deadline, and passive circuit breaking
+enabled, then drives a request run through the router and asserts:
+
+- zero client-visible 5xx (every failure failed over to the healthy engine),
+- no request consumed more proxy attempts than the retry budget (checked
+  against the router's /v1/traces span export),
+- both broken backends' circuit breakers are open by the end (checked
+  against vllm_router:circuit_state on /metrics).
+
+Importable as ``run_chaos()`` (tests/test_chaos.py wires it into tier-1) or
+runnable standalone:
+
+    python scripts/chaos_check.py --num-requests 200
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import re
+import sys
+
+import requests
+
+# allow running as a plain script from the repo root
+sys.path.insert(0, ".")
+
+from production_stack_tpu.testing.procs import (  # noqa: E402
+    free_port,
+    start_proc,
+    stop_proc,
+    wait_healthy,
+)
+
+CIRCUIT_RE = re.compile(r'vllm_router:circuit_state\{backend="([^"]+)"\} (\d+)')
+
+
+def run_chaos(
+    num_requests: int = 200,
+    retry_budget: int = 3,
+    ttft_deadline: float = 1.0,
+    breaker_threshold: int = 3,
+    max_tokens: int = 2,
+) -> dict:
+    """Run the chaos scenario; returns a summary dict (see keys below).
+    Raises nothing itself — callers assert on the summary."""
+    fakes, urls = [], []
+    modes = [["--fail-rate", "1.0"], ["--hang"], []]
+    try:
+        for extra in modes:
+            port = free_port()
+            fakes.append(start_proc(
+                ["-m", "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", "fake/model",
+                 "--speed", "500"] + extra
+            ))
+            urls.append(f"http://127.0.0.1:{port}")
+        fail_url, hang_url, healthy_url = urls
+        router_port = free_port()
+        router = start_proc([
+            "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["fake/model"] * len(urls)),
+            "--engine-stats-interval", "1",
+            "--retry-max-attempts", str(retry_budget),
+            "--retry-backoff-base", "0.01",
+            "--deadline-ttft", str(ttft_deadline),
+            "--deadline-inter-chunk", "2.0",
+            "--breaker-failure-threshold", str(breaker_threshold),
+            # longer than any sane run: an opened breaker must still be open
+            # at the end for the assertion to be meaningful
+            "--breaker-cooldown", "300",
+            "--trace-buffer-size", "16384",
+            "--enable-debug-endpoints",
+        ])
+        fakes.append(router)
+        base = f"http://127.0.0.1:{router_port}"
+        for proc, url in zip(fakes[:-1], urls):
+            wait_healthy(f"{url}/health", proc, timeout=30)
+        wait_healthy(f"{base}/health", router, timeout=30)
+
+        sess = requests.Session()
+        statuses: collections.Counter = collections.Counter()
+        for _ in range(num_requests):
+            r = sess.post(
+                f"{base}/v1/completions",
+                json={"model": "fake/model", "prompt": "x",
+                      "max_tokens": max_tokens},
+                timeout=60,
+            )
+            statuses[r.status_code] += 1
+
+        metrics = sess.get(f"{base}/metrics", timeout=10).text
+        circuit = {m.group(1): int(m.group(2))
+                   for m in CIRCUIT_RE.finditer(metrics)}
+        traces = sess.get(
+            f"{base}/v1/traces", params={"limit": "16384"}, timeout=10
+        ).json()
+        attempts_per_request: collections.Counter = collections.Counter()
+        for trace in traces.get("traces", []):
+            for span in trace["spans"]:
+                if span["name"] == "router.proxy":
+                    attempts_per_request[span["attrs"].get("request_id")] += 1
+
+        def _counter(name: str) -> float:
+            m = re.search(rf"^{re.escape(name)} ([0-9.]+)$", metrics, re.M)
+            return float(m.group(1)) if m else 0.0
+
+        return {
+            "statuses": dict(statuses),
+            "client_5xx": sum(n for s, n in statuses.items() if s >= 500),
+            "circuit_state": circuit,
+            "fail_url": fail_url,
+            "hang_url": hang_url,
+            "healthy_url": healthy_url,
+            "max_attempts_observed": max(attempts_per_request.values(), default=0),
+            "traced_requests": len(attempts_per_request),
+            "retry_budget": retry_budget,
+            "retries_total": _counter("vllm_router:retries_total"),
+            "failovers_total": _counter("vllm_router:failovers_total"),
+        }
+    finally:
+        for p in fakes:
+            stop_proc(p)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser("chaos-check")
+    p.add_argument("--num-requests", type=int, default=200)
+    p.add_argument("--retry-budget", type=int, default=3)
+    p.add_argument("--ttft-deadline", type=float, default=1.0)
+    p.add_argument("--breaker-threshold", type=int, default=3)
+    args = p.parse_args()
+    s = run_chaos(
+        num_requests=args.num_requests,
+        retry_budget=args.retry_budget,
+        ttft_deadline=args.ttft_deadline,
+        breaker_threshold=args.breaker_threshold,
+    )
+    print(json.dumps(s, indent=2))
+    failures = []
+    if s["client_5xx"]:
+        failures.append(f"{s['client_5xx']} client-visible 5xx")
+    if s["max_attempts_observed"] > s["retry_budget"]:
+        failures.append(
+            f"a request used {s['max_attempts_observed']} proxy attempts "
+            f"(budget {s['retry_budget']})"
+        )
+    from production_stack_tpu.router.resilience import OPEN
+
+    for label in ("fail_url", "hang_url"):
+        if s["circuit_state"].get(s[label]) != OPEN:
+            failures.append(f"breaker for {label}={s[label]} is not open")
+    if failures:
+        print("CHAOS CHECK FAILED: " + "; ".join(failures))
+        return 1
+    print("CHAOS CHECK PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

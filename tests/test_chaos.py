@@ -1,0 +1,200 @@
+"""Chaos tests for the router failure-domain layer — fast tier-1 (NOT marked
+slow): failover regressions must be caught on every run, not just in the
+nightly slow suite. Fake engines with fault injection stand in for broken
+pods (production_stack_tpu/testing/fake_engine.py --fail-rate/--hang/
+--hang-after-chunks/--fail-first-n); scripts/chaos_check.py provides the
+three-engine scenario harness."""
+
+import json
+import os
+import re
+import sys
+import time
+
+import requests
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir, "scripts")
+)
+import chaos_check  # noqa: E402
+
+from production_stack_tpu.router.resilience import OPEN  # noqa: E402
+from production_stack_tpu.testing.procs import (  # noqa: E402
+    free_port,
+    start_proc,
+    stop_proc,
+    wait_healthy,
+)
+
+RUNNING_RE = re.compile(r"vllm:num_requests_running\{[^}]*\} (\d+)")
+
+
+def _start_fake(extra, model="fake/model"):
+    port = free_port()
+    proc = start_proc(
+        ["-m", "production_stack_tpu.testing.fake_engine",
+         "--port", str(port), "--model", model, "--speed", "500"] + extra
+    )
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _start_router(urls, extra):
+    port = free_port()
+    proc = start_proc([
+        "-m", "production_stack_tpu.router.app",
+        "--port", str(port),
+        "--static-backends", ",".join(urls),
+        "--static-models", ",".join(["fake/model"] * len(urls)),
+        "--engine-stats-interval", "1",
+    ] + extra)
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _running_count(url: str) -> int:
+    m = RUNNING_RE.search(requests.get(f"{url}/metrics", timeout=5).text)
+    return int(m.group(1)) if m else -1
+
+
+def test_chaos_run_zero_client_5xx():
+    """Acceptance: three fake engines (one --fail-rate 1.0, one --hang, one
+    healthy), a 200-request run completes with zero client-visible 5xx,
+    every request's trace shows at most retry_budget proxy attempts, and
+    both broken backends' breakers are open at the end."""
+    s = chaos_check.run_chaos(
+        num_requests=200, retry_budget=3, ttft_deadline=1.0,
+        breaker_threshold=3,
+    )
+    assert s["client_5xx"] == 0, s["statuses"]
+    assert s["statuses"].get(200, 0) == 200, s["statuses"]
+    assert s["traced_requests"] > 0
+    assert s["max_attempts_observed"] <= s["retry_budget"], s
+    assert s["circuit_state"].get(s["fail_url"]) == OPEN, s["circuit_state"]
+    assert s["circuit_state"].get(s["hang_url"]) == OPEN, s["circuit_state"]
+    # the healthy backend's breaker (if it ever saw traffic) must be closed
+    assert s["circuit_state"].get(s["healthy_url"], 0) != OPEN
+    # the run actually exercised the layer
+    assert s["retries_total"] > 0
+    assert s["failovers_total"] > 0
+
+
+def test_inter_chunk_stall_aborts_engine_and_sends_sse_error():
+    """Acceptance: a stream stalled past the inter-chunk timeout is aborted
+    on the engine (scheduler slot freed, verified via /metrics running-count)
+    and the client receives a terminal SSE error event, not a silent
+    truncation (and no [DONE], so truncation is distinguishable)."""
+    fake, fake_url = _start_fake(["--hang-after-chunks", "2"])
+    router = None
+    try:
+        wait_healthy(f"{fake_url}/health", fake, timeout=30)
+        router, base = _start_router(
+            [fake_url], ["--deadline-inter-chunk", "0.5"]
+        )
+        wait_healthy(f"{base}/health", router, timeout=30)
+        r = requests.post(
+            f"{base}/v1/chat/completions",
+            json={"model": "fake/model",
+                  "messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 16, "stream": True},
+            stream=True, timeout=30,
+        )
+        assert r.status_code == 200
+        lines = [l for l in r.iter_lines() if l.startswith(b"data: ")]
+        assert lines, "no SSE events received"
+        last = json.loads(lines[-1][len(b"data: "):])
+        assert "error" in last, lines[-1]
+        assert "stall" in last["error"]["message"]
+        assert last["error"]["type"] == "upstream_error"
+        assert not any(b"[DONE]" in l for l in lines)
+        # at least one real content chunk preceded the stall
+        assert any(b"choices" in l for l in lines[:-1])
+        # the engine-side abort freed the scheduler slot
+        deadline = time.time() + 5
+        while time.time() < deadline and _running_count(fake_url) != 0:
+            time.sleep(0.1)
+        assert _running_count(fake_url) == 0
+    finally:
+        if router is not None:
+            stop_proc(router)
+        stop_proc(fake)
+
+
+def test_ttft_deadline_fails_over_from_hung_engine_and_frees_slot():
+    """A hung engine (accepts the request, never responds) is abandoned at
+    the TTFT deadline, aborted engine-side, and the request fails over to
+    the healthy replica — the client sees a clean 200."""
+    hung, hung_url = _start_fake(["--hang"])
+    healthy, healthy_url = _start_fake([])
+    router = None
+    try:
+        wait_healthy(f"{hung_url}/health", hung, timeout=30)
+        wait_healthy(f"{healthy_url}/health", healthy, timeout=30)
+        router, base = _start_router(
+            [hung_url, healthy_url],
+            ["--deadline-ttft", "0.5", "--retry-backoff-base", "0.01"],
+        )
+        wait_healthy(f"{base}/health", router, timeout=30)
+        for _ in range(4):
+            r = requests.post(
+                f"{base}/v1/completions",
+                json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                timeout=30,
+            )
+            assert r.status_code == 200, r.text
+        deadline = time.time() + 5
+        while time.time() < deadline and _running_count(hung_url) != 0:
+            time.sleep(0.1)
+        assert _running_count(hung_url) == 0, "abort did not free the hung slot"
+    finally:
+        if router is not None:
+            stop_proc(router)
+        stop_proc(hung)
+        stop_proc(healthy)
+
+
+def test_fail_n_then_recover_closes_breaker_again():
+    """fail-N-then-recover: the backend 500s its first N requests (breaker
+    opens), recovers, and after the cooldown a half-open probe closes the
+    breaker — traffic returns without a restart."""
+    # fail-first-n == breaker threshold: the breaker opens exactly as the
+    # backend recovers, so the first half-open probe succeeds
+    flaky, flaky_url = _start_fake(["--fail-first-n", "2"])
+    healthy, healthy_url = _start_fake([])
+    router = None
+    try:
+        wait_healthy(f"{flaky_url}/health", flaky, timeout=30)
+        wait_healthy(f"{healthy_url}/health", healthy, timeout=30)
+        router, base = _start_router(
+            [flaky_url, healthy_url],
+            ["--breaker-failure-threshold", "2",
+             "--breaker-cooldown", "1",
+             "--retry-backoff-base", "0.01"],
+        )
+        wait_healthy(f"{base}/health", router, timeout=30)
+        for _ in range(8):
+            r = requests.post(
+                f"{base}/v1/completions",
+                json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                timeout=30,
+            )
+            assert r.status_code == 200, r.text
+        # wait out the cooldown, then drive enough traffic that a half-open
+        # probe lands on the recovered backend and closes its breaker
+        time.sleep(1.2)
+        for _ in range(8):
+            assert requests.post(
+                f"{base}/v1/completions",
+                json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                timeout=30,
+            ).status_code == 200
+        metrics = requests.get(f"{base}/metrics", timeout=5).text
+        m = re.search(
+            rf'vllm_router:circuit_state\{{backend="{re.escape(flaky_url)}"\}} (\d+)',
+            metrics,
+        )
+        assert m, metrics
+        assert int(m.group(1)) != OPEN
+    finally:
+        if router is not None:
+            stop_proc(router)
+        stop_proc(flaky)
+        stop_proc(healthy)

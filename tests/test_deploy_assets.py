@@ -130,11 +130,39 @@ class TestObservability:
                 assert t["expr"]
 
     def test_dashboard_metric_names_exported(self):
-        """Dashboard router metrics must match names the router exports."""
+        """Dashboard router metrics must match names the router exports
+        (app.py renders them directly or via resilience.py)."""
         dash = _load("observability/tpu-stack-dashboard.json")
-        app = _load("production_stack_tpu/router/app.py")
+        exported = _load("production_stack_tpu/router/app.py") + _load(
+            "production_stack_tpu/router/resilience.py"
+        )
         for name in set(re.findall(r"vllm_router:[a-z_]+", dash)):
-            assert name in app, f"dashboard references unexported metric {name}"
+            assert name in exported, f"dashboard references unexported metric {name}"
+
+    def test_dashboard_failure_domain_panels(self):
+        """The failure-domain panels (PR-2) must chart exactly the metric
+        names the resilience layer renders, next to the PR-1 phase panels."""
+        dash = json.loads(_load("observability/tpu-stack-dashboard.json"))
+        titles = {p["title"]: p for p in dash["panels"]}
+        for want in (
+            "Proxy retries / failovers (rate)",
+            "Circuit breaker state (per backend)",
+            "Deadline aborts (rate)",
+        ):
+            assert want in titles, f"missing dashboard panel {want!r}"
+        exprs = " ".join(
+            t["expr"] for name in titles for t in titles[name]["targets"]
+        )
+        resilience = _load("production_stack_tpu/router/resilience.py")
+        for metric in (
+            "vllm_router:retries_total",
+            "vllm_router:failovers_total",
+            "vllm_router:deadline_aborts_total",
+            "vllm_router:circuit_state",
+            "vllm_router:circuit_open_events_total",
+        ):
+            assert metric in exprs, f"dashboard does not chart {metric}"
+            assert metric in resilience, f"{metric} not rendered by resilience.py"
 
     def test_prom_adapter_and_stack_values(self):
         adapter = yaml.safe_load(_load("observability/prom-adapter.yaml"))

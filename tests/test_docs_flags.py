@@ -17,9 +17,12 @@ def _known_flags() -> set:
     add_engine_args(p)
     for a in p._actions:
         flags.update(a.option_strings)
-    # router + benchmark flags: only REGISTERED flags count — a flag name
-    # quoted in help text or an error message must not satisfy the guard
+    # router + benchmark + fake-engine flags: only REGISTERED flags count — a
+    # flag name quoted in help text or an error message must not satisfy the
+    # guard (the fake engine is a first-party CLI: its fault-injection flags
+    # are documented in docs/failure-handling.md)
     for rel in (("production_stack_tpu", "router", "parser.py"),
+                ("production_stack_tpu", "testing", "fake_engine.py"),
                 ("benchmarks", "multi_round_qa.py")):
         src = REPO.joinpath(*rel).read_text()
         flags.update(re.findall(r'add_argument\(\s*"(--[a-z0-9-]+)"', src))

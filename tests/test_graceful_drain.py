@@ -7,11 +7,17 @@ shutdown handling + probes; we own the engine, so it is first-party."""
 import json
 import signal
 import threading
+import time
 
 import pytest
 import requests
 
-from production_stack_tpu.testing.procs import free_port, start_proc, wait_healthy
+from production_stack_tpu.testing.procs import (
+    free_port,
+    start_proc,
+    stop_proc,
+    wait_healthy,
+)
 
 pytestmark = pytest.mark.slow
 
@@ -97,3 +103,76 @@ def test_engine_drains_in_flight_stream_on_sigterm():
         assert proc.wait(timeout=60) == 0, "engine did not exit cleanly"
     finally:
         proc.kill()
+
+
+def test_router_breaker_and_health_stop_routing_to_draining_engine():
+    """Drain under the failure-domain layer: SIGTERM flips the engine's
+    /health to 503 and new generation requests get refused — the router's
+    breaker (fed by the 503s) plus the active health loop must pull the pod
+    and fail requests over to the surviving replica with ZERO client-visible
+    errors across the whole transition."""
+    engine_port = free_port()
+    engine = start_proc([
+        "-m", "production_stack_tpu.engine.api_server",
+        "--model", "llama-debug", "--port", str(engine_port),
+        "--max-model-len", "256", "--num-pages", "64", "--page-size", "8",
+    ])
+    engine_url = f"http://127.0.0.1:{engine_port}"
+    fake_port = free_port()
+    fake = start_proc([
+        "-m", "production_stack_tpu.testing.fake_engine",
+        "--port", str(fake_port), "--model", "llama-debug", "--speed", "500",
+    ])
+    fake_url = f"http://127.0.0.1:{fake_port}"
+    router = None
+    try:
+        wait_healthy(f"{fake_url}/health", fake, timeout=30)
+        wait_healthy(f"{engine_url}/health", engine, timeout=180)
+        router_port = free_port()
+        router = start_proc([
+            "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            "--static-backends", f"{engine_url},{fake_url}",
+            "--static-models", "llama-debug,llama-debug",
+            "--engine-stats-interval", "1",
+            "--retry-max-attempts", "3",
+            "--retry-backoff-base", "0.01",
+            "--breaker-failure-threshold", "1",
+            "--static-backend-health-checks",
+            "--health-check-interval", "0.5",
+        ])
+        base = f"http://127.0.0.1:{router_port}"
+        wait_healthy(f"{base}/health", router, timeout=30)
+
+        def ask():
+            return requests.post(
+                f"{base}/v1/completions",
+                json={"model": "llama-debug", "prompt": "hi",
+                      "max_tokens": 2, "temperature": 0.0},
+                timeout=60,
+            )
+
+        # both backends serving
+        for _ in range(4):
+            assert ask().status_code == 200
+
+        engine.send_signal(signal.SIGTERM)
+        # drain window: the engine 503s new generation work while /health is
+        # 503, then exits; every request across the transition must succeed
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            r = ask()
+            assert r.status_code == 200, r.text
+            if engine.poll() is not None:
+                break
+            time.sleep(0.3)
+        # after the engine is gone, traffic flows to the fake exclusively
+        for _ in range(4):
+            assert ask().status_code == 200
+        unhealthy = requests.get(f"{base}/metrics", timeout=5).text
+        assert "vllm_router:circuit_state" in unhealthy
+    finally:
+        if router is not None:
+            stop_proc(router)
+        engine.kill()
+        stop_proc(fake)

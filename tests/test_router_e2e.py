@@ -5,6 +5,7 @@ the same verification method as the reference's tests/e2e/test-routing.py
 
 import json
 import re
+import signal
 import time
 
 import pytest
@@ -156,6 +157,91 @@ class TestDisaggregatedPrefill:
         assert m, f"no disagg routing line in log:\n{log[-2000:]}"
         assert m.group(1) == urls[0] and m.group(2) == urls[1]
         assert "Prefill of" in log  # TTFT logged
+
+
+class TestFailover:
+    """Failure-domain layer e2e (docs/failure-handling.md): a lost or
+    draining backend must not surface as client 5xx while healthy replicas
+    of the same model exist."""
+
+    def test_killed_backend_fails_over_without_client_errors(self):
+        fakes, urls = _start_fakes(2)
+        router, base = _start_router(
+            urls,
+            extra=["--retry-max-attempts", "3", "--retry-backoff-base", "0.01",
+                   "--breaker-failure-threshold", "2"],
+        )
+        try:
+            for _ in range(4):
+                assert requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                    timeout=15,
+                ).status_code == 200
+            # hard-kill one backend (no drain, no FIN handshake grace)
+            fakes[0].kill()
+            fakes[0].wait(timeout=10)
+            for _ in range(10):
+                r = requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                    timeout=15,
+                )
+                assert r.status_code == 200, r.text
+            # the dead backend's breaker is open on the router's /metrics
+            metrics = requests.get(f"{base}/metrics", timeout=5).text
+            m = re.search(
+                rf'vllm_router:circuit_state\{{backend="{re.escape(urls[0])}"\}} (\d+)',
+                metrics,
+            )
+            assert m and int(m.group(1)) == 2, metrics
+            # …and on the /engines health surface (discovery's unhealthy set
+            # includes breaker-open backends)
+            listing = requests.get(f"{base}/engines", timeout=5).json()
+            assert urls[0] in listing["unhealthy"]
+        finally:
+            log = stop_proc(router)
+            for p in fakes:
+                stop_proc(p)
+        assert "failing request" in log  # failover log line
+
+    def test_sigterm_drain_shifts_traffic_and_inflight_failover(self):
+        """SIGTERM'd engine flips /health to 503 (graceful drain): the
+        breaker/health path stops routing to it and in-flight/new requests
+        fail over — zero client-visible errors across the drain."""
+        fakes, urls = _start_fakes(2)
+        router, base = _start_router(
+            urls,
+            extra=["--retry-max-attempts", "3", "--retry-backoff-base", "0.01",
+                   "--breaker-failure-threshold", "1",
+                   "--static-backend-health-checks",
+                   "--health-check-interval", "0.5"],
+        )
+        try:
+            for _ in range(4):
+                assert requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                    timeout=15,
+                ).status_code == 200
+            fakes[0].send_signal(signal.SIGTERM)
+            # the draining engine 503s new work, then exits; every client
+            # request across the transition must still be a 200
+            for _ in range(12):
+                r = requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                    timeout=15,
+                )
+                assert r.status_code == 200, r.text
+                time.sleep(0.1)
+        finally:
+            log = stop_proc(router)
+            for p in fakes:
+                stop_proc(p)
+        routed = _routed_endpoints(log)
+        # traffic ended up pinned to the survivor
+        assert routed[-1] == urls[1]
 
 
 class TestExperimentalFeatures:

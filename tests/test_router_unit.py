@@ -7,7 +7,7 @@ from dataclasses import dataclass, field
 
 import pytest
 
-from production_stack_tpu.router.engine_stats import EngineStats
+from production_stack_tpu.router.engine_stats import EngineStats, EngineStatsScraper
 from production_stack_tpu.router.hashtrie import HashTrie
 from production_stack_tpu.router.parser import parse_args
 from production_stack_tpu.router.pii import check_pii_content, redact
@@ -224,3 +224,163 @@ def test_singleton_meta():
 
     assert Foo() is Foo()
     SingletonMeta._instances.pop(Foo, None)
+
+
+# -- failure-domain layer (router/resilience.py) -----------------------------
+
+
+def test_circuit_breaker_state_machine():
+    from production_stack_tpu.router.resilience import (
+        CLOSED, HALF_OPEN, OPEN, CircuitBreaker,
+    )
+
+    b = CircuitBreaker(failure_threshold=3, cooldown=10.0)
+    assert b.allow(now=0.0) and b.state == CLOSED
+    b.record_failure(now=0.0)
+    b.record_failure(now=0.0)
+    assert b.state == CLOSED  # below threshold
+    b.record_failure(now=0.0)
+    assert b.state == OPEN and b.open_events == 1
+    assert not b.allow(now=5.0)  # cooling down
+    assert b.allow(now=10.5)  # cooldown elapsed: half-open probe admitted
+    assert b.state == HALF_OPEN
+    b.record_failure(now=11.0)  # probe failed: re-open, cooldown restarts
+    assert b.state == OPEN and b.opened_at == 11.0 and b.open_events == 2
+    assert b.allow(now=21.5)
+    b.record_success()  # probe succeeded: closed, failure streak reset
+    assert b.state == CLOSED and b.consecutive_failures == 0
+
+
+def test_circuit_breaker_probe_success_only_half_opens():
+    """An active health-probe success fast-tracks an OPEN breaker to
+    half-open but must not close it or erase the failure streak — a backend
+    can pass the 1-token dummy probe while failing real traffic."""
+    from production_stack_tpu.router.resilience import (
+        CLOSED, HALF_OPEN, OPEN, CircuitBreaker,
+    )
+
+    b = CircuitBreaker(failure_threshold=2, cooldown=1000.0)
+    b.record_failure(now=0.0)
+    b.record_failure(now=0.0)
+    assert b.state == OPEN
+    b.record_probe_success()
+    assert b.state == HALF_OPEN
+    assert b.consecutive_failures == 2  # data-plane evidence retained
+    b.record_failure(now=1.0)  # the next real request still decides
+    assert b.state == OPEN
+    b.record_probe_success()
+    b.record_success()  # only a data-plane success closes
+    assert b.state == CLOSED and b.consecutive_failures == 0
+    # probe success on a closed breaker is a no-op
+    b.record_probe_success()
+    assert b.state == CLOSED
+
+
+def test_circuit_breaker_disabled_by_zero_threshold():
+    from production_stack_tpu.router.resilience import CLOSED, CircuitBreaker
+
+    b = CircuitBreaker(failure_threshold=0)
+    for _ in range(50):
+        b.record_failure()
+    assert b.state == CLOSED and b.allow()
+
+
+def test_breaker_registry_filter_fail_static():
+    from production_stack_tpu.router.resilience import BreakerRegistry
+
+    reg = BreakerRegistry(failure_threshold=1, cooldown=1000.0)
+    eps = [FakeEndpoint("http://a"), FakeEndpoint("http://b")]
+    assert reg.filter_endpoints(eps) == eps
+    reg.record_failure("http://a")
+    assert [ep.url for ep in reg.filter_endpoints(eps)] == ["http://b"]
+    reg.record_failure("http://b")
+    # every breaker open: fail-static passes the set through unchanged so a
+    # fully-tripped fleet degrades to "try anyway", never a synthesized 503 …
+    assert reg.filter_endpoints(eps) == eps
+    # … while the failover path (fail_static=False) gets the honest answer
+    assert reg.filter_endpoints(eps, fail_static=False) == []
+    assert reg.open_urls() == ["http://a", "http://b"]
+    reg.forget("http://a")  # replacement pod at the same URL starts closed
+    assert reg.allows("http://a")
+
+
+def test_retry_policy_backoff_capped_with_jitter():
+    from production_stack_tpu.router.resilience import RetryPolicy
+
+    p = RetryPolicy(backoff_base=0.1, backoff_max=0.5)
+    for attempt in range(1, 12):
+        for _ in range(20):
+            assert 0.0 <= p.backoff(attempt) <= 0.5
+
+
+def test_retry_policy_deadline_remaining():
+    from production_stack_tpu.router.resilience import RetryPolicy
+
+    p = RetryPolicy(deadline_request=1.0)
+    assert abs(p.remaining(100.0, now=100.4) - 0.6) < 1e-9
+    assert p.remaining(100.0, now=102.0) < 0
+    assert RetryPolicy().remaining(100.0) is None  # 0 disables
+
+
+def test_resilience_metrics_render():
+    from production_stack_tpu.router import resilience
+
+    resilience._registry = resilience.BreakerRegistry(failure_threshold=1)
+    resilience.reset_counters()
+    resilience.count_retry()
+    resilience.count_failover()
+    resilience.count_deadline_abort("ttft")
+    resilience.get_breaker_registry().record_failure("http://bad")
+    text = "\n".join(resilience.render_resilience_metrics())
+    assert "vllm_router:retries_total 1" in text
+    assert "vllm_router:failovers_total 1" in text
+    assert 'vllm_router:deadline_aborts_total{kind="ttft"} 1' in text
+    assert f'vllm_router:circuit_state{{backend="http://bad"}} {resilience.OPEN}' in text
+    assert 'vllm_router:circuit_open_events_total{backend="http://bad"} 1' in text
+    resilience._registry = None
+    resilience.reset_counters()
+
+
+def test_parser_resilience_validation():
+    base = ["--static-backends", "http://a", "--static-models", "m"]
+    with pytest.raises(ValueError):
+        parse_args(base + ["--retry-max-attempts", "0"])
+    with pytest.raises(ValueError):
+        parse_args(base + ["--deadline-ttft", "-1"])
+    with pytest.raises(ValueError):
+        parse_args(base + ["--breaker-cooldown", "-5"])
+    args = parse_args(base + [
+        "--retry-max-attempts", "4", "--deadline-ttft", "2.5",
+        "--breaker-failure-threshold", "7",
+    ])
+    assert args.retry_max_attempts == 4
+    assert args.deadline_ttft == 2.5
+    assert args.breaker_failure_threshold == 7
+
+
+def test_engine_stats_staleness_drops_dead_pod():
+    """A backend whose scrapes start failing keeps its last-good snapshot
+    only for STALE_INTERVALS x scrape_interval, then it is dropped — stale
+    queue depth must not steer load-aware routing."""
+    SingletonMeta._instances.pop(EngineStatsScraper, None)
+    s = EngineStatsScraper(scrape_interval=10.0)
+    urls = ["http://a", "http://b"]
+    ok = EngineStats(num_running_requests=5)
+    s.apply_scrape_results(urls, [ok, ok], now=0.0)
+    assert set(s.get_engine_stats()) == {"http://a", "http://b"}
+    # http://a starts failing its scrapes; within the window it survives
+    s.apply_scrape_results(urls, [None, ok], now=10.0)
+    s.apply_scrape_results(urls, [None, ok], now=20.0)
+    assert "http://a" in s.get_engine_stats()
+    # past 3x the scrape interval with no success: dropped
+    s.apply_scrape_results(urls, [None, ok], now=31.0)
+    assert "http://a" not in s.get_engine_stats()
+    assert "http://b" in s.get_engine_stats()
+    # recovery re-admits it immediately
+    s.apply_scrape_results(urls, [ok, ok], now=40.0)
+    assert "http://a" in s.get_engine_stats()
+    # an endpoint removed from discovery is dropped with its timestamp
+    s.apply_scrape_results(["http://b"], [ok], now=50.0)
+    assert set(s.get_engine_stats()) == {"http://b"}
+    assert "http://a" not in s.last_success
+    SingletonMeta._instances.pop(EngineStatsScraper, None)
