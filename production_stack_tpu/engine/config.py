@@ -76,6 +76,16 @@ class EngineConfig:
     # stale pool + in-register chunk K/V and commits all layers with one
     # batched scatter after the layer scan (avoids per-layer pool copies)
     kv_write_mode: str = "post"
+    # decode-kernel memory pipeline tuning (threaded into the model config;
+    # ops/pallas/paged_attention.py). decode_pages_per_block: KV pages per
+    # packed grid cell (0 = auto: ~128 slots, ~512 for >=128-page buckets).
+    # decode_prefetch_pages: depth of the kernel's VMEM page-copy ring — how
+    # many page DMAs stay in flight ahead of compute (0 = auto: up to 8
+    # within a ~2 MB VMEM budget per pool array). Retune with
+    # scripts/profile_decode.py, which reports achieved HBM GB/s per
+    # (batch, context, page_size) bucket.
+    decode_pages_per_block: int = 0
+    decode_prefetch_pages: int = 0
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     # sequence/context parallelism: long prefill chunks run ring attention

@@ -78,6 +78,14 @@ class LLMEngine:
                     "kv_write_mode=%s unsupported for this model family; "
                     "keeping 'pre'", cfg.kv_write_mode,
                 )
+        # decode-kernel pipeline tuning rides the model config the same way
+        # attn_impl does (the kernel call sites live in the model forwards)
+        for knob in ("decode_pages_per_block", "decode_prefetch_pages"):
+            val = getattr(cfg, knob, 0)
+            if val and any(
+                f.name == knob for f in dataclasses.fields(model_cfg)
+            ):
+                model_cfg = dataclasses.replace(model_cfg, **{knob: val})
         self.model_cfg = model_cfg
         self.tokenizer = load_tokenizer(
             cfg.tokenizer or (cfg.model if "/" in cfg.model or cfg.model.startswith(".") else None)
@@ -195,6 +203,10 @@ class LLMEngine:
             spec_k=cfg.speculative_k,
             spec_ngram=cfg.speculative_ngram,
         )
+        # this loop dispatches run-ahead prefills behind in-flight chains
+        # (_runahead_prefills), which is what licenses the scheduler's
+        # one-extra-burst chaining floor past the admission-wait budget
+        self.scheduler.runahead_available = True
         self._inbox: queue_mod.Queue = queue_mod.Queue()
         # prefill dispatches whose results were never fetched (skip-fetch
         # optimization); a deferred device error taints these sequences

@@ -582,6 +582,11 @@ class ModelRunner:
         stays bounded. Returns ``(ks, vs)``: per-page ``[L, page, KH, D]``
         host arrays."""
         n = len(pids)
+        if n == 0:
+            # REPLICATED multi-host dispatch surface: an unguarded empty call
+            # would raise (pids[-1]) on whichever process hit it and desync
+            # the follower set — return without touching the device
+            return [], []
         bucket = 1
         while bucket < n:
             bucket <<= 1
@@ -606,6 +611,8 @@ class ModelRunner:
         the last (id, data) lane, so the duplicate scatter rewrites the same
         value — deterministic."""
         n = len(pids)
+        if n == 0:
+            return  # see get_pages: empty calls must be no-ops, not errors
         bucket = 1
         while bucket < n:
             bucket <<= 1

@@ -214,6 +214,15 @@ class Scheduler:
         # qps 2.0). Cap (bursts-1)*burst_seconds by this budget whenever an
         # arrival could actually start, so worst-case wait stays ~100 ms.
         self.chain_wait_budget_s = 0.1
+        # whether the driving engine loop can dispatch run-ahead prefills
+        # behind an in-flight chain (LLMEngine sets this True — it owns
+        # _runahead_prefills). The one-extra-burst chaining floor below the
+        # wait budget is ONLY justified by run-ahead (it starts an
+        # arrival's prefill DURING the chain); a driver without that path —
+        # the safe default for a bare scheduler — or a batch run-ahead
+        # cannot serve (logprobs dispatches fetch whole-chain) falls back
+        # to bursts=1 when a single burst already exceeds the budget.
+        self.runahead_available = False
 
     # -- api ----------------------------------------------------------------
 
@@ -453,20 +462,30 @@ class Scheduler:
                 # COULD start immediately (free seats + pages), never chain
                 # deeper than the wait budget — the expected cap above lets
                 # sparse traffic (rate <= ~1/s) keep half-second chains, and
-                # whoever arrives mid-chain eats the remainder whole. The
-                # floor is ONE extra burst even when a single burst exceeds
-                # the budget (long-context decode can run ~0.5 s/burst):
-                # chained dispatches are what enable run-ahead prefill
+                # whoever arrives mid-chain eats the remainder whole. When a
+                # single burst exceeds the budget (long-context decode can
+                # run ~0.5 s/burst) a ONE-extra-burst floor survives ONLY if
+                # run-ahead prefill can actually serve an arrival during the
+                # chain: chained dispatches enable run-ahead
                 # (engine._runahead_prefills), which starts an arrival's
-                # prefill — and emits its first token — DURING the chain, so
-                # a 2-burst chain beats an unchained burst of the same
-                # length for exactly the arrival this cap protects. The
-                # enforced worst case is max(budget, one extra burst).
-                cap = 1 + max(
-                    1,
-                    int(self.chain_wait_budget_s
-                        / max(self.burst_seconds, 1e-4)),
+                # prefill — and emits its first token — mid-chain, so a
+                # 2-burst chain then beats an unchained burst of the same
+                # length for exactly the arrival this cap protects. Without
+                # run-ahead (engine has none, or the batch wants logprobs —
+                # that path fetches whole-chain and dispatches nothing
+                # behind it), the floor would make an arrival with admission
+                # OPEN wait a full extra burst for nothing: fall back to an
+                # unchained dispatch instead.
+                extra = int(
+                    self.chain_wait_budget_s / max(self.burst_seconds, 1e-4)
                 )
+                if extra < 1:
+                    runahead_ok = self.runahead_available and not any(
+                        s.params.logprobs is not None for s in decoding
+                    )
+                    cap = 2 if runahead_ok else 1
+                else:
+                    cap = 1 + extra
                 bursts = min(bursts, cap)
             if bursts > 1:
                 # min_tokens: the EOS ban is fixed for everything one dispatch

@@ -134,11 +134,14 @@ class KVTransferReceiver:
                     elif self.device_endpoint is not None and self.staging is not None:
                         addr, uuid = hdr["assignments"][0]
                         try:
-                            # pull probes the producer address first: the
-                            # XLA transfer pull is lazy and would "succeed"
-                            # against a dead producer (hanging only on
-                            # first use, uninterruptibly) — the TCP blob
-                            # fallback contract needs the failure HERE
+                            # pull probes the producer address on every
+                            # call AND materializes the arrays inside this
+                            # timed thread: the XLA transfer pull is lazy
+                            # and would otherwise "succeed" against a dead
+                            # producer (hanging only on first use,
+                            # uninterruptibly) — the TCP blob fallback
+                            # contract needs the failure HERE, before
+                            # staging.put publishes the page
                             k_dev, v_dev = await asyncio.wait_for(
                                 asyncio.to_thread(
                                     self.device_endpoint.pull,
@@ -340,7 +343,6 @@ class DeviceKVEndpoint:
         self.leaked_offers = 0
         self.cap_evicted_offers = 0
         self._dead_addrs: dict[str, float] = {}    # addr -> retry-after
-        self._probed_addrs: dict[str, float] = {}  # addr -> probe-valid-until
 
     # Retirement policy for fixed offers: there is no per-offer release
     # handshake (the consumer's ack proves only its LEADER pulled; its
@@ -414,37 +416,33 @@ class DeviceKVEndpoint:
             self._dead_addrs[addr] = time_mod.monotonic() + self.DEAD_ADDR_TTL
             self._conns.pop(addr, None)
 
-    PROBE_TTL = 30.0
-
     def _probe_addr(self, addr: str) -> None:
         """Fail fast on an unreachable producer. The XLA transfer pull is
         LAZY: connect()+pull() against a dead address "succeed" and the
-        returned arrays only hang when first consumed — and that hang is not
-        interruptible from Python, so materialize-with-timeout cannot back a
-        fallback path either. A plain TCP probe catches the realistic
-        failure (producer pod gone) before any page is staged; probes cache
-        per address for PROBE_TTL."""
+        returned arrays only hang when first consumed. A plain TCP probe
+        catches the realistic failure (producer pod gone) before any page is
+        staged. Probes run on EVERY pull — a local connect is ~ms against a
+        page transfer's tens of ms, and a cached probe verdict (the old
+        30 s TTL) let a producer that died after its probe hand back lazy
+        arrays that only hung once a consumer touched them."""
         import socket
-        import time as time_mod
 
-        now = time_mod.monotonic()
-        with self._lock:
-            if self._probed_addrs.get(addr, 0.0) > now:
-                return
         host, _, port = addr.rpartition(":")
         try:
             socket.create_connection((host or "127.0.0.1", int(port)),
                                      timeout=3.0).close()
         except OSError as e:
             raise ConnectionError(f"kv producer {addr} unreachable: {e}") from e
-        with self._lock:
-            self._probed_addrs[addr] = now + self.PROBE_TTL
 
     def pull(self, addr: str, uuid: int, shape, dtype):
-        """Pull a page's (k, v) device arrays from the producer at ``addr``.
-        The returned arrays are lazy; reachability is probed first (see
-        _probe_addr) so a dead producer raises here and the caller's TCP
-        blob fallback engages."""
+        """Pull a page's (k, v) device arrays from the producer at ``addr``
+        and MATERIALIZE them before returning: reachability is probed first
+        (see _probe_addr) and the block_until_ready runs inside whatever
+        timed thread the caller wrapped around this call, so a producer that
+        dies mid-transfer is caught here — before staging.put publishes a
+        page that would hang its first consumer (that hang is not
+        interruptible; the caller's timeout leaks this worker thread, the
+        lesser evil against a wedged engine loop)."""
         import time as time_mod
 
         import jax
@@ -469,6 +467,7 @@ class DeviceKVEndpoint:
             sharding=jax.sharding.SingleDeviceSharding(dev),
         )
         k_dev, v_dev = conn.pull(uuid, [sds, sds])
+        jax.block_until_ready((k_dev, v_dev))
         self.pulled_pages += 1
         return k_dev, v_dev
 

@@ -58,6 +58,8 @@ class Gemma2Config:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"           # same contract as LlamaConfig.attn_impl
     kv_write_mode: str = "post"       # same contract as LlamaConfig.kv_write_mode
+    decode_pages_per_block: int = 0   # same contract as LlamaConfig
+    decode_prefetch_pages: int = 0
 
     @property
     def tie_word_embeddings(self) -> bool:
@@ -281,6 +283,8 @@ def forward(
                 window=window, sm_scale=sm_scale,
                 logit_softcap=cfg.attn_logit_softcap,
                 interpret=cfg.attn_impl == "pallas_interpret",
+                pages_per_block=cfg.decode_pages_per_block or None,
+                prefetch_pages=cfg.decode_prefetch_pages or None,
                 **cur_kw, **layer_kw,
             )
             if mesh is not None and mesh.devices.size > 1:

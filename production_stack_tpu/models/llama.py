@@ -76,6 +76,11 @@ class LlamaConfig:
     # after the scan (donated pools update in place — no per-layer copies;
     # measured -26% per decode burst on v5e).
     kv_write_mode: str = "post"
+    # decode-kernel memory pipeline tuning (0 = kernel auto; see
+    # ops/pallas/paged_attention.py and engine/config.py): pages per packed
+    # grid cell, and DMA-ring depth (page copies kept in flight)
+    decode_pages_per_block: int = 0
+    decode_prefetch_pages: int = 0
 
     @staticmethod
     def from_hf_config(cfg: dict) -> "LlamaConfig":
@@ -569,6 +574,8 @@ def forward(
             pallas_kw = dict(
                 window=cfg.sliding_window,
                 interpret=cfg.attn_impl == "pallas_interpret",
+                pages_per_block=cfg.decode_pages_per_block or None,
+                prefetch_pages=cfg.decode_prefetch_pages or None,
                 **cur_kw,
             )
             if stream_pools:

@@ -45,6 +45,8 @@ class OPTConfig:
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"  # same contract as LlamaConfig.attn_impl
     kv_write_mode: str = "post"  # same contract as LlamaConfig.kv_write_mode
+    decode_pages_per_block: int = 0  # same contract as LlamaConfig
+    decode_prefetch_pages: int = 0
 
     # uniform accessors used by the runner/engine (OPT has no GQA)
     @property
@@ -183,6 +185,8 @@ def forward(
                 interpret=cfg.attn_impl == "pallas_interpret",
                 k_cur=k[:, 0].astype(kp.dtype) if post_write else None,
                 v_cur=v[:, 0].astype(vp.dtype) if post_write else None,
+                pages_per_block=cfg.decode_pages_per_block or None,
+                prefetch_pages=cfg.decode_prefetch_pages or None,
             )[:, None]
         elif post_write:
             kc, vc = gather_kv_pages(kp, vp, page_table)

@@ -1,26 +1,50 @@
-"""Pallas TPU kernel: ragged paged attention for the decode step.
+"""Pallas TPU kernel: ragged paged attention for the decode step (v2).
 
 Why a kernel (SURVEY.md §7 hard part #1): the XLA reference path
 (ops/attention.py paged_attention_decode) gathers each sequence's pages into a
 contiguous [B, S, KH, D] tensor in HBM *before* attending — that copy is pure
-HBM-bandwidth waste in the bandwidth-bound decode regime. This kernel instead
-streams each page HBM->VMEM exactly once, using the page table as a
-scalar-prefetch argument so the block index map can chase page indirection,
-and Pallas's grid pipeline double-buffers the page fetches behind the online-
-softmax compute.
+HBM-bandwidth waste in the bandwidth-bound decode regime. This kernel streams
+each page HBM->VMEM exactly once instead.
 
-Layout: grid = (B, max_pages); for each sequence the page axis is innermost,
-so the (m, l, acc) VMEM scratch persists across that sequence's pages (same
-output block revisited) — the classic flash-decode accumulation. Query/kv
-heads stay packed [KH, G, D] so all heads of a page are one batched MXU call.
+v2 restructures the memory pipeline around two ideas (docs/benchmarking.md
+"Hardware ceilings": page-scattered reads measured 14-30 GB/s vs ~200 GB/s
+contiguous — the decode-step floor for long-context QA):
+
+1. **Ragged packed grid.** v1 ran grid = (B, max_pages_bucket): a 50-page
+   sequence in a 256-page bucket still executed ~200 dead grid cells whose
+   index map clamped to the last page (refetch + masked compute). v2 derives
+   each sequence's LIVE block count from ``kv_lens`` (and the sliding
+   window) on the host side, packs all live (sequence, block) cells into a
+   1D grid, and pads with no-op cells whose index maps alias the last live
+   cell (no DMA, no compute). Decode cost therefore scales with the batch's
+   REAL total context, not with B x bucket — which is what makes
+   mixed-length decode batches (the multi-round-QA shape) cheap.
+
+2. **Deep page prefetch.** v1 fetched N pages per cell as N separate small
+   BlockSpec inputs, so at most one cell's worth of page DMAs overlapped
+   compute and per-cell pipeline overhead dominated at small pages (876
+   tok/s at page 16 vs 1,501 at 128 on v5e). v2 leaves the pools in HBM
+   (``memory_space=ANY``) and drives a manually multi-buffered VMEM ring of
+   page copies with ``pltpu.make_async_copy``: R page DMAs stay in flight
+   across cell boundaries (R = ``prefetch_pages``), so the HBM pipeline
+   stays full regardless of page size or cell shape.
+
+Layout within a cell is unchanged from v1: query/kv heads stay packed
+[KH, G, D] so all heads of a page are one batched MXU call, and the
+(m, l, acc) VMEM scratch persists across a sequence's consecutive cells —
+the classic flash-decode accumulation.
 
 Sliding-window attention (Mistral, Gemma-2's even layers) is handled by
-remapping the page axis: the index map starts fetching at the first page
-containing a visible KV slot (``(kv_len - window) // page_size``), so a
-4096-window sequence at 128k context streams ~window bytes, not ~context
-bytes. The window arrives as a scalar-prefetch operand, so per-layer window
-sizes (Gemma-2 interleaves local/global) ride the decoder's layer scan.
-Logit softcapping (Gemma-2) is a static transform on the scores.
+starting each sequence's live range at the first page containing a visible
+KV slot (``(kv_len - window) // page_size``), so a 4096-window sequence at
+128k context streams ~window bytes, not ~context bytes. The window arrives
+as a scalar-prefetch operand, so per-layer window sizes (Gemma-2
+interleaves local/global) ride the decoder's layer scan. Logit softcapping
+(Gemma-2) is a static transform on the scores.
+
+Measure the achieved page-streaming HBM GB/s with
+``scripts/profile_decode.py`` (per (batch, context, page_size) bucket, plus
+a mixed-length case that checks cost scales with real ``kv_lens``).
 
 Equivalent role in the reference: vLLM's CUDA PagedAttention decode kernel
 (executed inside the engine image; configured by
@@ -47,83 +71,140 @@ def _decode_kernel(
     win_ref,     # [1] int32 window size (huge = full causal)
     cl_ref,      # [B] int32 valid current-window entries (has_cur mode)
     layer_ref,   # [1] int32 layer index into the stacked pools
-    # blocks
-    q_ref,       # [1, NH, D]
-    *refs,       # N x (k_ref, v_ref) [1, 1, page_size, KH, D] each,
-                 # [k_cur_ref, v_cur_ref ([1, C, KH, D]),] o_ref, m/l/acc
+    seq_ref,     # [C_CELLS] int32 packed cell -> batch row
+    blk_ref,     # [C_CELLS] int32 packed cell -> block index within the row
+    cells_ref,   # [B] int32 live cell count per row (>= 1)
+    livepg_ref,  # [B] int32 live page count per row (the packing's source
+                 # of truth — the kernel must never re-derive it)
+    total_ref,   # [1] int32 total live cells
+    # inputs
+    q_ref,       # [1, NH, D] (current cell's row)
+    kp_hbm,      # [L, P, page_size, KH, D], memory_space=ANY (stays in HBM)
+    vp_hbm,
+    *refs,       # [k_cur_ref, v_cur_ref ([1, C, KH, D]),] o_ref,
+                 # k_buf/v_buf ([R, page, KH, D] VMEM ring), ksem/vsem,
+                 # m/l/acc scratch
     sm_scale: float,
     kv_heads: int,
     logit_softcap: float | None,
     has_cur: bool,
     pages_per_block: int,
+    prefetch: int,
 ):
-    N = pages_per_block
-    kv_refs = refs[: 2 * N]  # k0, v0, k1, v1, ...
-    rest = refs[2 * N:]
     if has_cur:
         # write-after-attend mode: the last cl_ref[b] tokens' pool slots are
         # stale; their K/V arrive in-register (a fused burst accumulates up
-        # to C of them) and fold in on the last grid step
-        k_cur_ref, v_cur_ref, o_ref, m_ref, l_ref, acc_ref = rest
+        # to C of them) and fold in on the row's last live cell
+        (k_cur_ref, v_cur_ref, o_ref, k_buf, v_buf, ksem, vsem,
+         m_ref, l_ref, acc_ref) = refs
     else:
-        o_ref, m_ref, l_ref, acc_ref = rest
-    b = pl.program_id(0)
-    p = pl.program_id(1)
-    page_size = kv_refs[0].shape[2]
+        o_ref, k_buf, v_buf, ksem, vsem, m_ref, l_ref, acc_ref = refs
+    N = pages_per_block
+    R = prefetch
+    page_size = k_buf.shape[1]
+    max_pages = pt_ref.shape[1]
+    n_cells = seq_ref.shape[0]
     NH, D = q_ref.shape[1], q_ref.shape[2]
     KH = kv_heads
     G = NH // KH
+    lyr = layer_ref[0]
 
-    @pl.when(p == 0)
+    c = pl.program_id(0)
+    total = total_ref[0]
+    live = c < total
+    b = seq_ref[c]
+    p = blk_ref[c]
+
+    def _copies(g):
+        """DMA descriptors (and their go/no-go predicate) for global
+        page-stream index g = cell*N + i. A page is fetched iff its cell is
+        live and it lies inside its row's live page range (livepg_ref, the
+        same array the host packed the grid from) — the SAME predicate
+        gates start and wait, so semaphore counts always pair."""
+        cc = jnp.minimum(g // N, n_cells - 1)
+        bb = seq_ref[cc]
+        pi = blk_ref[cc] * N + g % N  # page offset within the live range
+        lo_pg = jnp.maximum(lens_ref[bb] - win_ref[0], 0) // page_size
+        ok = (g < total * N) & (pi < livepg_ref[bb])
+        pid = pt_ref[bb, jnp.minimum(lo_pg + pi, max_pages - 1)]
+        s = g % R
+        kcp = pltpu.make_async_copy(kp_hbm.at[lyr, pid], k_buf.at[s], ksem.at[s])
+        vcp = pltpu.make_async_copy(vp_hbm.at[lyr, pid], v_buf.at[s], vsem.at[s])
+        return ok, kcp, vcp
+
+    def _start(g):
+        ok, kcp, vcp = _copies(g)
+
+        @pl.when(ok)
+        def _():
+            kcp.start()
+            vcp.start()
+
+    @pl.when(live & (p == 0))
     def _():
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(c == 0)
+    def _():
+        # warm-up: fill the ring; steady state below tops it off with copy
+        # g+R-1 as it consumes copy g, so R page DMAs stay in flight
+        for g in range(R - 1):
+            _start(jnp.int32(g))
 
     kv_len = lens_ref[b]
     # paged slots hold positions < paged_end; in has_cur mode the final
     # cl_ref[b] slots (the in-register window) are stale in the pool
     paged_end = kv_len - cl_ref[b] if has_cur else kv_len
     lo = jnp.maximum(kv_len - win_ref[0], 0)   # first visible KV slot
+    lo_pg = lo // page_size
 
-    # N pages per grid cell (unrolled): each page is its own input block with
-    # the single-page layout — same compute per page as the N=1 kernel, but
-    # the grid (and its per-cell pipeline overhead, the reason small pages
-    # used to decode slower) shrinks N-fold. No cross-page reshapes or lane
-    # slicing, which Mosaic rejects for these layouts.
     for i in range(N):
-        # this sub-block's first slot
-        start = (lo // page_size + p * N + i) * page_size
 
-        @pl.when(start < paged_end)
-        def _(k_ref=kv_refs[2 * i], v_ref=kv_refs[2 * i + 1], start=start):
-            q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
-            k = k_ref[0, 0].astype(jnp.float32).transpose(1, 0, 2)  # [KH, page, D]
-            v = v_ref[0, 0].astype(jnp.float32).transpose(1, 0, 2)
-            # batched over KH: [KH, G, D] x [KH, page, D] -> [KH, G, page]
-            scores = lax.dot_general(
-                q, k, (((2,), (2,)), ((0,), (0,))), preferred_element_type=jnp.float32
-            )
-            if logit_softcap is not None:
-                scores = logit_softcap * jnp.tanh(scores / logit_softcap)
-            idx = start + lax.broadcasted_iota(jnp.int32, (1, 1, page_size), 2)
-            visible = (idx >= lo) & (idx < paged_end)
-            scores = jnp.where(visible, scores, NEG_INF)
+        @pl.when(live)
+        def _(i=i):
+            g = c * N + i
+            _start(g + R - 1)
+            ok, kcp, vcp = _copies(g)
 
-            m_prev, l_prev = m_ref[...], l_ref[...]
-            m_new = jnp.maximum(m_prev, scores.max(axis=-1))
-            alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
-            pij = jnp.exp(scores - m_new[..., None])
-            pij = jnp.where(visible, pij, 0.0)
-            m_ref[...] = m_new
-            l_ref[...] = l_prev * alpha + pij.sum(axis=-1)
-            # [KH, G, page] x [KH, page, D] -> [KH, G, D]
-            pv = lax.dot_general(
-                pij, v, (((2,), (1,)), ((0,), (0,))), preferred_element_type=jnp.float32
-            )
-            acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+            @pl.when(ok)
+            def _():
+                kcp.wait()
+                vcp.wait()
+                s = g % R
+                q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
+                k = k_buf[s].astype(jnp.float32).transpose(1, 0, 2)  # [KH, page, D]
+                v = v_buf[s].astype(jnp.float32).transpose(1, 0, 2)
+                # batched over KH: [KH, G, D] x [KH, page, D] -> [KH, G, page]
+                scores = lax.dot_general(
+                    q, k, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+                if logit_softcap is not None:
+                    scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+                start = (lo_pg + p * N + i) * page_size
+                idx = start + lax.broadcasted_iota(
+                    jnp.int32, (1, 1, page_size), 2
+                )
+                visible = (idx >= lo) & (idx < paged_end)
+                scores = jnp.where(visible, scores, NEG_INF)
 
-    @pl.when(p == pl.num_programs(1) - 1)
+                m_prev, l_prev = m_ref[...], l_ref[...]
+                m_new = jnp.maximum(m_prev, scores.max(axis=-1))
+                alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+                pij = jnp.exp(scores - m_new[..., None])
+                pij = jnp.where(visible, pij, 0.0)
+                m_ref[...] = m_new
+                l_ref[...] = l_prev * alpha + pij.sum(axis=-1)
+                # [KH, G, page] x [KH, page, D] -> [KH, G, D]
+                pv = lax.dot_general(
+                    pij, v, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+                acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+
+    @pl.when(live & (p == cells_ref[b] - 1))
     def _():
         m_prev, l_prev, acc = m_ref[...], l_ref[...], acc_ref[...]
         if has_cur:
@@ -160,7 +241,10 @@ def _decode_kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("sm_scale", "logit_softcap", "interpret", "pages_per_block"),
+    static_argnames=(
+        "sm_scale", "logit_softcap", "interpret", "pages_per_block",
+        "prefetch_pages",
+    ),
 )
 def ragged_paged_attention_decode(
     q: jnp.ndarray,          # [B, NH, D]
@@ -177,6 +261,7 @@ def ragged_paged_attention_decode(
     v_cur: jnp.ndarray | None = None,
     cur_lens: jnp.ndarray | None = None,  # [B] valid window entries (1..C)
     pages_per_block: int | None = None,
+    prefetch_pages: int | None = None,
     layer: jnp.ndarray | int | None = None,  # index into stacked pools
 ) -> jnp.ndarray:
     """Decode attention over paged KV, streaming pages HBM->VMEM.
@@ -189,7 +274,7 @@ def ragged_paged_attention_decode(
     for the whole burst. [B, KH, D] k_cur means C=1 (single current token).
     Returns [B, NH, D] in q.dtype. Matches
     ops/attention.paged_attention_decode (the XLA oracle) — tests assert
-    equivalence.
+    equivalence (atol 2e-5 in f32, 3e-2 in bf16).
 
     Stacked pools + ``layer``: passing the whole [L, P, page, KH, D] pool
     and a (traced) layer index lets the per-layer scan stream pages straight
@@ -198,11 +283,21 @@ def ragged_paged_attention_decode(
     at ~1.5 ms/step on v5e), because XLA cannot fuse a slice into a
     pallas_call operand.
 
-    ``pages_per_block``: pages fetched per grid cell, each as its own input
-    block (auto: ~128 KV slots per cell). The per-cell pipeline overhead is
-    what made small pages slow (876 tok/s at page 16 vs 1,501 at 128 on
-    v5e, engine/config.py) — grouping fetches recovers the throughput while
-    keeping page_size (the prefix-cache sharing granule) fine.
+    ``pages_per_block``: pages processed per packed grid cell (auto: ~128 KV
+    slots per cell, ~512 for >=128-page buckets). With the v2 DMA ring this
+    mostly sets grid-bookkeeping granularity, not pipeline depth.
+
+    ``prefetch_pages``: depth of the VMEM page-copy ring — how many page
+    DMAs stay in flight ahead of compute (auto: up to 8, bounded by a ~2 MB
+    per-array VMEM budget). This is what keeps the HBM pipeline full at
+    small pages; v1's per-cell BlockSpec fetches were the measured
+    876 -> 1,501 tok/s page-16-vs-128 cliff (engine/config.py).
+
+    The grid itself is RAGGED: live (sequence, block) cells pack to the
+    front of a 1D grid sized for the bucket's worst case, and trailing dead
+    cells alias the last live cell's indices (no DMA, no compute) — so a
+    50-page sequence in a 256-page bucket costs ~50 pages of work, and a
+    mixed-length batch costs the sum of its REAL contexts.
     """
     B, NH, D = q.shape
     if k_pages.ndim == 4:  # single-layer pools: free leading-axis view
@@ -218,15 +313,21 @@ def ragged_paged_attention_decode(
         k_cur = k_cur[:, None]  # [B, KH, D] -> C=1 window
         v_cur = v_cur[:, None]
     if pages_per_block is None:
-        # ~128 KV slots per cell for the short-context buckets this was
-        # tuned on; long-context buckets (>=128 pages, e.g. 9k-token QA
-        # histories in a 256-page bucket) quadruple the cell count and the
-        # per-cell pipeline overhead was measured dominating the step
-        # (~40 ms/step at B=32 x 256 pages) — target ~512 slots there
+        # ~128 KV slots of bookkeeping per cell for short-context buckets;
+        # long-context buckets (>=128 pages) use ~512 — with the DMA ring
+        # the cell size no longer bounds fetch depth, it only amortizes the
+        # per-cell grid/index-map overhead
         target = 512 if max_pages >= 128 else 128
         pages_per_block = max(1, min(target // page_size, max_pages))
     N = max(1, min(pages_per_block, max_pages))
     n_blocks = -(-max_pages // N)
+    n_cells = B * n_blocks
+    if prefetch_pages is None:
+        # ring depth: up to 8 pages in flight, bounded by ~2 MB of VMEM per
+        # pool array (k and v each get a ring this size)
+        slot_bytes = page_size * KH * D * jnp.dtype(k_pages.dtype).itemsize
+        prefetch_pages = max(2, min(8, (2 << 20) // max(slot_bytes, 1)))
+    R = max(2, int(prefetch_pages))
     win = (
         jnp.full((1,), 2**30, jnp.int32)
         if window is None
@@ -239,29 +340,40 @@ def ragged_paged_attention_decode(
     )
     lyr = jnp.asarray(layer, jnp.int32).reshape(1)
 
-    def kv_index(i):
-        def index(b, p, pt, lens, w, _cl, l):
-            # start fetching at the first page with a visible slot so
-            # windowed layers stream ~window bytes regardless of context
-            lo_page = jnp.maximum(lens[b] - w[0], 0) // page_size
-            return (
-                l[0],
-                pt[b, jnp.minimum(lo_page + p * N + i, max_pages - 1)],
-                0, 0, 0,
-            )
+    # ragged cell maps: pack each row's live blocks (pages holding visible,
+    # non-stale KV slots) into a 1D grid; every row keeps >= 1 cell so
+    # padded rows (kv_len 0) still initialize + write their (zero) output
+    lens32 = seq_lens.astype(jnp.int32)
+    pe = lens32 - cl if has_cur else lens32
+    lo_pg = jnp.maximum(lens32 - win[0], 0) // page_size
+    live_pg = jnp.maximum(-(-jnp.maximum(pe, 0) // page_size) - lo_pg, 0)
+    cells = jnp.clip(-(-live_pg // N), 1, n_blocks).astype(jnp.int32)
+    cs = jnp.cumsum(cells).astype(jnp.int32)       # [B] end cell per row
+    starts = cs - cells                            # [B] first cell per row
+    cidx = jnp.arange(n_cells, dtype=jnp.int32)
+    total = cs[B - 1]
+    row = jnp.minimum(
+        jnp.searchsorted(cs, cidx, side="right").astype(jnp.int32), B - 1
+    )
+    dead = cidx >= total
+    # dead cells alias the LAST live cell (row B-1's final block): index
+    # maps repeat, so the pipeline neither fetches nor writes for them
+    seq_of = jnp.where(dead, B - 1, row)
+    blk_of = jnp.where(dead, cells[B - 1] - 1, cidx - starts[row])
+    total_arr = cs[B - 1:]
 
-        return index
+    def row3(c, pt, lens, w, _cl, l, so, bo, ce, lp, tot):
+        return (so[c], 0, 0)
 
-    row = lambda b, p, pt, lens, w, _cl, l: (b, 0, 0)
-    row4 = lambda b, p, pt, lens, w, _cl, l: (b, 0, 0, 0)
-    in_specs = [pl.BlockSpec((1, NH, D), row)]
-    operands = [q]
-    for i in range(N):
-        in_specs += [
-            pl.BlockSpec((1, 1, page_size, KH, D), kv_index(i)),
-            pl.BlockSpec((1, 1, page_size, KH, D), kv_index(i)),
-        ]
-        operands += [k_pages, v_pages]
+    def row4(c, pt, lens, w, _cl, l, so, bo, ce, lp, tot):
+        return (so[c], 0, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, NH, D), row3),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    operands = [q, k_pages, v_pages]
     if has_cur:
         C = k_cur.shape[1]
         in_specs += [
@@ -271,11 +383,15 @@ def ragged_paged_attention_decode(
         operands += [k_cur, v_cur]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
-        grid=(B, n_blocks),
+        num_scalar_prefetch=10,
+        grid=(n_cells,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, NH, D), row),
+        out_specs=pl.BlockSpec((1, NH, D), row3),
         scratch_shapes=[
+            pltpu.VMEM((R, page_size, KH, D), k_pages.dtype),
+            pltpu.VMEM((R, page_size, KH, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((R,)),
+            pltpu.SemaphoreType.DMA((R,)),
             pltpu.VMEM((KH, G), jnp.float32),
             pltpu.VMEM((KH, G), jnp.float32),
             pltpu.VMEM((KH, G, D), jnp.float32),
@@ -284,6 +400,7 @@ def ragged_paged_attention_decode(
     kernel = functools.partial(
         _decode_kernel, sm_scale=scale, kv_heads=KH,
         logit_softcap=logit_softcap, has_cur=has_cur, pages_per_block=N,
+        prefetch=R,
     )
     return pl.pallas_call(
         kernel,
@@ -298,8 +415,9 @@ def ragged_paged_attention_decode(
             transcendentals=B * NH * max_pages * page_size,
         ),
     )(
-        page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), win, cl,
-        lyr, *operands,
+        page_table.astype(jnp.int32), lens32, win, cl, lyr,
+        seq_of, blk_of, cells, live_pg.astype(jnp.int32), total_arr,
+        *operands,
     )
 
 
@@ -318,6 +436,8 @@ def ragged_paged_attention_decode_sharded(
     k_cur: jnp.ndarray | None = None,
     v_cur: jnp.ndarray | None = None,
     cur_lens: jnp.ndarray | None = None,
+    pages_per_block: int | None = None,
+    prefetch_pages: int | None = None,
     layer: jnp.ndarray | int | None = None,
 ) -> jnp.ndarray:
     """The decode kernel on a multi-device mesh via manual shard_map.
@@ -363,7 +483,9 @@ def ragged_paged_attention_decode_sharded(
         return ragged_paged_attention_decode(
             q, kp, vp, pt, lens, window,
             sm_scale=scale, logit_softcap=logit_softcap, interpret=interpret,
-            k_cur=kc, v_cur=vc, cur_lens=cl, layer=l[0],
+            k_cur=kc, v_cur=vc, cur_lens=cl,
+            pages_per_block=pages_per_block, prefetch_pages=prefetch_pages,
+            layer=l[0],
         )
 
     head = P("dp", "tp", None)

@@ -515,6 +515,20 @@ async def _proxy_attempt(
                 return resp
         await resp.write_eof()
         latency_hist.observe(time.perf_counter() - (ts_recv or t_route))
+        if hop_sample is None:
+            # no body chunk ever arrived (204s / empty non-streaming
+            # replies): the request still completed, and the engine-side
+            # histograms count it — record a TTFT-equals-latency sample so
+            # the router and engine /metrics distributions keep covering
+            # the SAME request population (a request must never appear in
+            # the router's latency histogram but not its TTFT one)
+            t_done = time.perf_counter()
+            hop_sample = record_hop_sample(
+                (t_route - (ts_recv or t_route)) * 1000 if attempt == 1 else 0.0,
+                (t_conn - t_route) * 1000,
+                (t_done - t_conn) * 1000,
+                ttft_s=t_done - (ts_recv or t_route),
+            )
         proxy_attrs["status"] = backend_resp.status
         outcome = "ok"
         breakers.record_success(backend_url)

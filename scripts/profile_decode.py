@@ -1,0 +1,229 @@
+"""Decode-kernel memory-pipeline microbenchmark (the page-streaming floor).
+
+Measures, per (batch, context, page_size) bucket, what the ragged paged
+attention decode kernel actually achieves against HBM:
+
+- ``hbm_gb_s``  — achieved page-streaming bandwidth: visible KV bytes the
+  step must read (sum over rows of their REAL context, k+v) / wall time.
+- ``tok_s``     — kernel-level decode tokens/sec (batch rows per call).
+- the same numbers for the XLA gather path (``--impl xla`` / ``both``) —
+  the pre-kernel baseline that materializes a contiguous [B, S] copy.
+- ``contiguous_gb_s`` — a dense-copy ceiling on the same chip, so the
+  scattered numbers have an upper bound next to them (round 5 measured
+  ~200 GB/s contiguous vs 14-30 GB/s page-scattered; this script is how
+  that pair gets re-measured after kernel changes).
+
+The ``mixed`` case runs one bucket twice — every row at the bucket's full
+context vs. most rows short — and checks that step cost scales with the
+batch's real ``kv_lens``, not the bucket (the v2 ragged grid's whole
+point). On TPU the check is asserted (exit 1 on failure); under
+``--interpret``/CPU timings are interpreter noise, so it only smoke-tests
+numerics vs the XLA oracle.
+
+Run on the serving chip before retuning ``decode_pages_per_block`` /
+``decode_prefetch_pages`` (engine/config.py); docs/benchmarking.md
+"Hardware ceilings" records the measured pair per round.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.ops.attention import paged_attention_decode
+from production_stack_tpu.ops.pallas.paged_attention import (
+    ragged_paged_attention_decode,
+)
+from production_stack_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".cache", "xla")
+)
+
+# llama-3.2-1b-class attention shape (the serving flagship on one chip)
+NH, KH, D = 32, 8, 64
+
+
+def _scattered_case(rng, B, max_pages, page_size, lens, dtype):
+    """Pools + a deliberately scattered page table: pages of a row are
+    strided across the pool (worst-case DMA locality, the serving steady
+    state after churn), not the fresh-allocation contiguous layout."""
+    P = B * max_pages + 8
+    kp = jnp.asarray(rng.randn(P, page_size, KH, D), dtype)
+    vp = jnp.asarray(rng.randn(P, page_size, KH, D), dtype)
+    pt = (
+        np.arange(B * max_pages, dtype=np.int32)
+        .reshape(max_pages, B)
+        .T.copy()  # row b owns pages b, B+b, 2B+b, ... (stride B)
+    )
+    q = jnp.asarray(rng.randn(B, NH, D), dtype)
+    return q, kp, vp, jnp.asarray(pt), jnp.asarray(lens, jnp.int32)
+
+
+def _time(fn, reps):
+    fn()  # compile
+    np.asarray(fn())  # post-donation/relayout settle + sync
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    np.asarray(out)  # host fetch = the only reliable sync on tunneled chips
+    return (time.perf_counter() - t0) / reps
+
+
+def _visible_bytes(lens, page_size, dtype):
+    pages = -(-np.maximum(np.asarray(lens), 0) // page_size)
+    return int(pages.sum()) * page_size * KH * D * np.dtype(dtype).itemsize * 2
+
+
+def bench_bucket(rng, B, ctx, page_size, dtype, reps, impl, interpret,
+                 lens=None, tag=""):
+    max_pages = -(-ctx // page_size)
+    if lens is None:
+        lens = np.full((B,), ctx, np.int32)
+    q, kp, vp, pt, lens_d = _scattered_case(rng, B, max_pages, page_size,
+                                            lens, dtype)
+    if impl == "pallas":
+        fn = lambda: ragged_paged_attention_decode(
+            q, kp, vp, pt, lens_d, interpret=interpret
+        )
+    else:
+        fn = lambda: paged_attention_decode(q, kp, vp, pt, lens_d)
+    dt = _time(fn, reps)
+    nbytes = _visible_bytes(lens, page_size, dtype)
+    return {
+        "tag": tag or f"B{B}_ctx{ctx}_page{page_size}",
+        "impl": impl,
+        "batch": B,
+        "context": ctx,
+        "page_size": page_size,
+        "kv_lens": sorted(set(int(x) for x in lens)),
+        "step_ms": round(dt * 1000, 3),
+        "visible_kv_mb": round(nbytes / 1e6, 1),
+        "hbm_gb_s": round(nbytes / dt / 1e9, 2),
+        "tok_s": round(B / dt, 1),
+    }
+
+
+def contiguous_ceiling(dtype, on_tpu):
+    """Dense-copy bandwidth on the same chip: the number the scattered
+    streams are measured against."""
+    mb = 512 if on_tpu else 4
+    n = mb * (1 << 20) // np.dtype(dtype).itemsize
+    x = jnp.arange(n, dtype=jnp.int32).astype(dtype)
+    f = jax.jit(lambda a: a * 1 + 1)
+    np.asarray(f(x))
+    t0 = time.perf_counter()
+    reps = 4
+    for _ in range(reps):
+        y = f(x)
+    np.asarray(y[:8])
+    dt = (time.perf_counter() - t0) / reps
+    # read + write of the whole buffer per iteration
+    return round(2 * x.nbytes / dt / 1e9, 2)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--impl", choices=["pallas", "xla", "both"], default="both")
+    ap.add_argument("--reps", type=int, default=0, help="0 = auto per backend")
+    ap.add_argument("--batch", type=int, default=0)
+    ap.add_argument("--contexts", default="", help="comma list, e.g. 1024,16384")
+    ap.add_argument("--page-sizes", default="", help="comma list, e.g. 16,64,128")
+    ap.add_argument("--interpret", action="store_true",
+                    help="force interpret mode (implied off-TPU)")
+    ap.add_argument("--json", default="", help="write full results here too")
+    args = ap.parse_args()
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    interpret = args.interpret or not on_tpu
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    reps = args.reps or (16 if on_tpu else 2)
+    B = args.batch or (16 if on_tpu else 2)
+    contexts = (
+        [int(c) for c in args.contexts.split(",") if c]
+        or ([1024, 4096, 16384] if on_tpu else [64, 128])
+    )
+    page_sizes = (
+        [int(p) for p in args.page_sizes.split(",") if p]
+        or ([16, 64, 128] if on_tpu else [8, 16])
+    )
+    impls = ["pallas", "xla"] if args.impl == "both" else [args.impl]
+    rng = np.random.RandomState(0)
+
+    results = {"platform": jax.default_backend(), "interpret": interpret,
+               "buckets": [], "mixed": {}}
+    results["contiguous_gb_s"] = contiguous_ceiling(dtype, on_tpu)
+    print(f"contiguous_copy_gb_s {results['contiguous_gb_s']}")
+
+    for page_size in page_sizes:
+        for ctx in contexts:
+            for impl in impls:
+                r = bench_bucket(rng, B, ctx, page_size, dtype, reps, impl,
+                                 interpret)
+                results["buckets"].append(r)
+                print(json.dumps(r))
+
+    # --- mixed-length case: cost must track real kv_lens, not the bucket ---
+    ctx = max(contexts)
+    page_size = page_sizes[-1] if len(page_sizes) == 1 else sorted(page_sizes)[1]
+    short = max(page_size, ctx // 8)
+    mixed_lens = np.full((B,), short, np.int32)
+    mixed_lens[: max(1, B // 8)] = ctx  # a few long rows, mostly short
+    full = bench_bucket(rng, B, ctx, page_size, dtype, reps, "pallas",
+                        interpret, tag="mixed_full")
+    mixed = bench_bucket(rng, B, ctx, page_size, dtype, reps, "pallas",
+                         interpret, lens=mixed_lens, tag="mixed_ragged")
+    byte_ratio = mixed["visible_kv_mb"] / max(full["visible_kv_mb"], 1e-9)
+    time_ratio = mixed["step_ms"] / max(full["step_ms"], 1e-9)
+    results["mixed"] = {
+        "full": full, "ragged": mixed,
+        "byte_ratio": round(byte_ratio, 3),
+        "time_ratio": round(time_ratio, 3),
+    }
+    print(json.dumps(results["mixed"]))
+
+    # numerics smoke for the ragged case (cheap everywhere, the only
+    # meaningful mixed-case signal under the interpreter)
+    q, kp, vp, pt, lens_d = _scattered_case(
+        np.random.RandomState(1), B, -(-ctx // page_size), page_size,
+        mixed_lens, dtype,
+    )
+    ref = paged_attention_decode(q, kp, vp, pt, lens_d)
+    out = ragged_paged_attention_decode(q, kp, vp, pt, lens_d,
+                                        interpret=interpret)
+    tol = 3e-2 if dtype == jnp.bfloat16 else 2e-5
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=tol, rtol=tol,
+    )
+    print("mixed_case_numerics OK")
+
+    ok = True
+    if on_tpu and not args.interpret:
+        # ragged scaling check: a mostly-short batch in a full-context
+        # bucket must run much closer to its byte share than to the
+        # bucket's cost. Allow generous slack over the pure byte ratio for
+        # fixed per-step overhead (dispatch, warm-up, q/out traffic).
+        limit = min(1.0, byte_ratio * 2 + 0.15)
+        ok = time_ratio <= limit
+        print(f"mixed_scaling {'OK' if ok else 'FAIL'} "
+              f"time_ratio={time_ratio:.3f} byte_ratio={byte_ratio:.3f} "
+              f"limit={limit:.3f}")
+    else:
+        print("mixed_scaling SKIPPED (interpret-mode timings are not real)")
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

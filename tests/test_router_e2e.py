@@ -318,6 +318,40 @@ class TestStackSurface:
         assert "vllm_router:current_qps" in text
         assert "vllm_router:cpu_usage_perc" in text
 
+    def test_non_streaming_lands_in_both_histograms(self, stack):
+        """Router TTFT and e2e-latency histograms must cover the SAME
+        request population as the engine's: a non-streaming request (whose
+        whole body arrives as one chunk — or as none, for empty replies)
+        has to land in both, not just the streaming first-byte path."""
+        base, _ = stack
+
+        def counts():
+            text = requests.get(f"{base}/metrics", timeout=5).text
+            out = {}
+            for line in text.splitlines():
+                for key, name in (
+                    ("ttft", "vllm_router:time_to_first_token_seconds_count"),
+                    ("latency", "vllm_router:e2e_request_latency_seconds_count"),
+                ):
+                    if line.startswith(name):
+                        out[key] = int(float(line.rsplit(" ", 1)[1]))
+            return out
+
+        c0 = counts()
+        r = requests.post(
+            f"{base}/v1/completions",
+            json={"model": "fake/model", "prompt": "hist", "max_tokens": 2},
+            timeout=15,
+        )
+        assert r.status_code == 200
+        c1 = counts()
+        d_ttft = c1.get("ttft", 0) - c0.get("ttft", 0)
+        d_lat = c1.get("latency", 0) - c0.get("latency", 0)
+        assert d_ttft >= 1, (c0, c1)
+        assert d_lat >= 1, (c0, c1)
+        # same population: the request incremented both equally
+        assert d_ttft == d_lat, (c0, c1)
+
     def test_streaming_through_router(self, stack):
         base, _ = stack
         r = requests.post(

@@ -177,6 +177,50 @@ def test_chain_depth_grows_on_quiescent_streak():
     assert batch.bursts == 1
 
 
+def test_chain_floor_requires_runahead_when_burst_exceeds_budget():
+    """When a SINGLE burst already exceeds the 100 ms chain-wait budget
+    (long-context decode ~0.5 s/burst) and admission is OPEN, the one-extra-
+    burst floor is only justified by run-ahead prefill (it starts an arrival
+    DURING the chain). Without run-ahead — engine has none, or the batch
+    wants logprobs — an arrival would wait a full extra burst for nothing,
+    so the dispatch must fall back to bursts=1."""
+    def quiesced(**kw):
+        s = _mk_scheduler(**kw)
+        dec = Sequence("dec", prompt_ids=[1] * 8,
+                       params=SamplingParams(max_tokens=512, ignore_eos=True))
+        s.add(dec)
+        _drive(s, steps=1)
+        s.burst_seconds = 0.5   # one burst >> chain_wait_budget_s (0.1)
+        s.arrival_rate = 0.0    # admission OPEN, quiescent
+        return s
+
+    # run-ahead available (LLMEngine sets this): the floor keeps one
+    # extra burst
+    sched = quiesced()
+    sched.runahead_available = True
+    assert sched.schedule().bursts == 2
+    # a driver without the run-ahead path (bare-scheduler default): no
+    # chaining past the budget
+    assert quiesced().schedule().bursts == 1
+    # logprobs batches fetch whole-chain (no run-ahead dispatch behind
+    # them), so they get no floor either
+    sched = _mk_scheduler()
+    sched.runahead_available = True
+    dec = Sequence("dec", prompt_ids=[1] * 8,
+                   params=SamplingParams(max_tokens=512, ignore_eos=True,
+                                         logprobs=2))
+    sched.add(dec)
+    _drive(sched, steps=1)
+    sched.burst_seconds = 0.5
+    sched.arrival_rate = 0.0
+    assert sched.schedule().bursts == 1
+    # blocked admission is unaffected: chaining still engages in full
+    sched = quiesced(max_num_seqs=1)
+    sched.add(Sequence("blocked", prompt_ids=[2] * 8,
+                       params=SamplingParams(max_tokens=4, ignore_eos=True)))
+    assert sched.schedule().bursts == 3
+
+
 def test_runahead_prefill_is_disjoint_from_chain():
     """schedule_prefill_runahead plans prefill work ONLY for sequences
     outside the in-flight chain, admitting fresh arrivals; chunk accounting
