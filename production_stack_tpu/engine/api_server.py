@@ -196,6 +196,25 @@ def _parse_logit_bias(raw, vocab_size: "Optional[int]" = None) -> "Optional[dict
     return out
 
 
+def _shed_response(retry_after_s: float, message: str) -> web.Response:
+    """Load-shed contract (docs/failure-handling.md): an overloaded engine
+    answers 429 with a Retry-After hint instead of queueing the request into
+    unbounded TTFT. The shed-aware router treats this as an immediate
+    failover signal that must NOT trip the circuit breaker."""
+    retry = max(1, int(-(-retry_after_s // 1)))  # ceil, floor 1 s
+    return web.json_response(
+        {
+            "error": {
+                "message": message,
+                "type": "overloaded_error",
+                "code": 429,
+            }
+        },
+        status=429,
+        headers={"Retry-After": str(retry)},
+    )
+
+
 def _usage(out) -> dict:
     return {
         "prompt_tokens": out.prompt_tokens,
@@ -216,11 +235,12 @@ class EngineServer:
         self.cfg = cfg
         self.engine = engine or LLMEngine(cfg)
         try:
-            self._engine_accepts_trace = "trace" in inspect.signature(
-                self.engine.generate
-            ).parameters
+            gen_params = inspect.signature(self.engine.generate).parameters
+            self._engine_accepts_trace = "trace" in gen_params
+            self._engine_accepts_shed_exempt = "shed_exempt" in gen_params
         except (TypeError, ValueError):
             self._engine_accepts_trace = False
+            self._engine_accepts_shed_exempt = False
         self.start_time = time.time()
         # graceful drain (SIGTERM): /health flips to 503 so readiness
         # probes / router health checks pull the pod from rotation, new
@@ -332,6 +352,12 @@ class EngineServer:
         emit("num_requests_swapped", "gauge", s.get("num_requests_swapped", 0))
         emit("num_preemptions_total", "counter",
              s.get("num_preemptions_total", 0))
+        # overload surface: saturation state + load sheds (admission control)
+        emit("engine_saturated", "gauge", s.get("engine_saturated", 0),
+             "1 while the waiting queue is at its max_waiting_seqs bound")
+        emit("num_requests_shed_total", "counter",
+             s.get("num_requests_shed_total", 0),
+             "generation requests shed with 429 (queue full or queue deadline)")
         emit("gpu_cache_usage_perc", "gauge", s["gpu_cache_usage_perc"])
         emit("gpu_prefix_cache_hit_rate", "gauge", s["gpu_prefix_cache_hit_rate"])
         emit("gpu_prefix_cache_hits_total", "counter", s["gpu_prefix_cache_hits_total"])
@@ -388,6 +414,23 @@ class EngineServer:
 
         lines.extend(render_phase_histograms(f'model_name="{m}"'))
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def stats(self, request: web.Request) -> web.Response:
+        """JSON engine state snapshot (saturation, queue depths, KV pool,
+        shed counters) — the machine-readable twin of /metrics for
+        autoscalers and the router's shed-aware logic (docs/failure-handling
+        overload section)."""
+        s = dict(self.engine.stats())
+        s["saturation"] = {
+            "saturated": bool(s.get("engine_saturated", 0)),
+            "max_waiting_seqs": getattr(self.cfg, "max_waiting_seqs", 0),
+            "queue_deadline_s": getattr(self.cfg, "queue_deadline_s", 0.0),
+            "retry_after_s": getattr(
+                self.engine, "shed_retry_after", lambda: 1.0
+            )(),
+            "draining": self.draining,
+        }
+        return web.json_response(s)
 
     async def traces(self, request: web.Request) -> web.Response:
         """Span ring-buffer export (read-only debug surface; docs/tracing.md).
@@ -511,6 +554,21 @@ class EngineServer:
             )
         if self.engine.is_sleeping:
             return web.json_response({"error": "engine is sleeping"}, status=503)
+        # admission control: a full waiting queue sheds HERE, before any
+        # scheduler state exists for the request — a clean 429 + Retry-After
+        # the router can fail over on (duck-typed: fakes/tests may lack it)
+        saturated = getattr(self.engine, "saturated", None)
+        if saturated is not None and saturated():
+            # event-loop-owned counter (the engine thread owns requests_shed;
+            # two writers on one dict slot would drop increments)
+            if hasattr(self.engine, "api_requests_shed"):
+                self.engine.api_requests_shed += 1
+            retry = getattr(self.engine, "shed_retry_after", lambda: 1.0)()
+            return _shed_response(
+                retry,
+                f"engine saturated: {self.engine.scheduler.num_waiting()} "
+                "requests already waiting",
+            )
         model = body.get("model", self.cfg.name)
         lora_name = None
         if model != self.cfg.name:
@@ -625,7 +683,24 @@ class EngineServer:
             # so the trace follows one representative sequence
             if self._engine_accepts_trace and sid == sub_ids[0]:
                 kwargs["trace"] = trace_ctx
+            # parallel-sampling siblings (choice > 0) launch only after
+            # choice 0's first output — their request is mid-flight, so they
+            # are exempt from engine-side load shedding (choice 0's own shed
+            # still 429s the whole request cleanly and aborts them)
+            if self._engine_accepts_shed_exempt and sid != sub_ids[0]:
+                kwargs["shed_exempt"] = True
             return self.engine.generate(sid, **kwargs)
+
+        def _shed_whole_request() -> web.Response:
+            """Queue-deadline shed before any output: abort every choice and
+            answer 429 + Retry-After for the request as a whole."""
+            self._live_requests.pop(req_id, None)
+            for sid in sub_ids:
+                self.engine.abort(sid)
+            return _shed_response(
+                getattr(self.engine, "shed_retry_after", lambda: 1.0)(),
+                "request shed: queue deadline exceeded before dispatch",
+            )
 
         t_submit = time.perf_counter()
         if n == 1:
@@ -679,6 +754,11 @@ class EngineServer:
                 for sid in sub_ids:
                     self.engine.abort(sid)
                 raise
+            if any(r[2] == "shed" for r in results):
+                # the request never produced a token, so a clean 429 +
+                # Retry-After is still an honest answer (any non-shed
+                # siblings are aborted — the request sheds whole)
+                return _shed_whole_request()
             choices, lasts = [], []
             for i, full, finish_reason, last, tok_ids, lp_entries in results:
                 lasts.append(last)
@@ -741,6 +821,38 @@ class EngineServer:
                 headers={"X-Request-Id": req_id},
             )
 
+        merged = _tag_stream(0, gen) if n == 1 else _merge_streams(gens)
+        # queue-deadline shedding: when the engine may still shed queued
+        # requests, defer the response headers until the first engine output
+        # arrives — a shed then converts to a clean 429 + Retry-After, where
+        # committed 200 headers would force the error into the SSE stream.
+        # Engines that cannot shed queued work keep the immediate-headers
+        # behavior unchanged.
+        first_item = None
+        if getattr(self.engine, "can_shed_queued", lambda: False)():
+            try:
+                first_item = await merged.__anext__()
+            except StopAsyncIteration:
+                first_item = None
+            except (Exception, asyncio.CancelledError):
+                self._live_requests.pop(req_id, None)
+                for sid in sub_ids:
+                    self.engine.abort(sid)
+                raise
+            if (
+                first_item is not None
+                and first_item[1].finished
+                and first_item[1].finish_reason == "shed"
+            ):
+                await merged.aclose()  # cancel _merge_streams pump tasks now
+                return _shed_whole_request()
+
+        async def _chain_first(first, agen):
+            if first is not None:
+                yield first
+            async for item in agen:
+                yield item
+
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -766,14 +878,10 @@ class EngineServer:
             parsers = [StreamingToolParser(tool_style) for _ in range(n)]
             tool_idx = [0] * n
         try:
-            if n == 1:
-                merged = _tag_stream(0, gen)
-            else:
-                merged = _merge_streams(gens)
             lp_offsets = [0] * n
             t_first_out = None
             hop_done = False
-            async for i, out in merged:
+            async for i, out in _chain_first(first_item, merged):
                 lasts[i] = out
                 if i == 0 and t_first_out is None:
                     t_first_out = time.perf_counter()
@@ -1136,6 +1244,7 @@ class EngineServer:
         r.add_get("/version", self.version)
         r.add_get("/v1/models", self.models)
         r.add_get("/metrics", self.metrics)
+        r.add_get("/stats", self.stats)
         if self.cfg.enable_debug_endpoints:
             # unauthenticated debug surfaces — benchmark/debug runs only.
             # /v1/traces is read-only but exposes request ids and timings;

@@ -22,6 +22,16 @@ class EngineConfig:
     port: int = 8100
     max_num_seqs: int = 64
     max_model_len: int = 4096
+    # engine-side admission control (overload survival): bound on the waiting
+    # queue — at or past it new generation requests are SHED with 429 +
+    # Retry-After instead of queued into unbounded TTFT (0 = unbounded,
+    # matching vLLM). Export: vllm:engine_saturated / num_requests_shed_total.
+    max_waiting_seqs: int = 0
+    # per-request queue deadline: a request still undispatched after this many
+    # seconds is shed (429) by the engine loop (0 = never shed by age)
+    queue_deadline_s: float = 0.0
+    # Retry-After seconds advertised on shed responses
+    shed_retry_after_s: float = 1.0
     # KV page size (tokens). Larger pages mean fewer (bigger) page DMAs per
     # decode step: measured on v5e (llama-3.2-1b class, B=16, 1k ctx, with
     # deferred-burst KV + stacked-pool streaming) decode runs 1037 tok/s at
@@ -130,14 +140,24 @@ class EngineConfig:
     # KV offload (LMCache-equivalent) wiring
     kv_offload_cpu_gb: float = 0.0
     # cap on pages moved per offload operation (one spill batch at eviction,
-    # one restore chain at prefix match); 0 = unbounded. On PCIe-attached
-    # hosts (~10-30 GB/s) unbounded is right; on network-attached chips
-    # (axon tunnel ~10-40 MB/s measured) a 9k-token history is ~300 MB and
-    # RECOMPUTING it (~9.7k tok/s chunked prefill) beats restoring it ~30x,
-    # so the cap bounds the engine-loop stall and the prefix recomputes past
-    # it. Spill overflow beyond the cap is dropped + reported evicted (the
+    # one restore chain at prefix match); 0 = unbounded, -1 (default) = AUTO:
+    # the engine probes host<->device link bandwidth at startup
+    # (engine/linkprobe.py) and derives the cap — 0 on PCIe-class links
+    # (~10-30 GB/s, unbounded is right), a few pages on network-attached
+    # chips (axon tunnel ~10-40 MB/s measured), where a 9k-token history is
+    # ~300 MB and RECOMPUTING it (~9.7k tok/s chunked prefill) beats
+    # restoring it ~30x — the cap bounds the engine-loop stall and the
+    # prefix recomputes past it. The measured bandwidth and chosen cap are
+    # exported on /metrics (vllm:kv_offload_link_bandwidth_bytes_per_sec,
+    # vllm:kv_offload_max_io_pages); an explicit >= 0 value skips the probe.
+    # Spill overflow beyond the cap is dropped + reported evicted (the
     # global KV index stays truthful).
-    kv_offload_max_io_pages: int = 0
+    kv_offload_max_io_pages: int = -1
+    # proactive-spill high watermark (fraction of the page pool): past this
+    # usage the scheduler spills the coldest evictable pages to the offload
+    # tier ahead of eviction, so allocation storms at >100% occupancy free
+    # slots without blocking device fetches (0 or >=1 disables)
+    kv_spill_watermark: float = 0.9
     kv_offload_dir: Optional[str] = None
     kv_offload_disk_gb: float = 16.0
     kv_remote_url: Optional[str] = None

@@ -141,9 +141,33 @@ class LLMEngine:
                 self.runner, max_loras=cfg.max_loras, max_rank=cfg.max_lora_rank
             )
         self._offload = self._make_offload_connector(cfg)
+        # offload I/O budget: explicit >= 0 is honored verbatim; the -1
+        # default auto-derives from a startup link-bandwidth probe (0 on
+        # PCIe-class links) — both the measurement and the chosen cap are
+        # exported on /metrics. No offload configured -> nothing to cap.
+        self.kv_link_bandwidth_bytes_per_s: Optional[float] = None
+        self._max_io_pages = cfg.kv_offload_max_io_pages
+        if self._max_io_pages < 0:
+            if self._offload is not None:
+                from production_stack_tpu.engine.linkprobe import (
+                    derive_max_io_pages,
+                    probe_link_bandwidth,
+                )
+
+                bw = probe_link_bandwidth()
+                self.kv_link_bandwidth_bytes_per_s = bw
+                self._max_io_pages = derive_max_io_pages(bw, page_bytes)
+                logger.info(
+                    "kv offload link probe: %s MB/s -> max_io_pages=%d",
+                    "?" if bw is None else f"{bw / 1e6:.1f}",
+                    self._max_io_pages,
+                )
+            else:
+                self._max_io_pages = 0
         self.kv = KVPageManager(
             num_pages, cfg.page_size, offload=self._offload,
-            max_io_pages=cfg.kv_offload_max_io_pages,
+            max_io_pages=self._max_io_pages,
+            spill_watermark=cfg.kv_spill_watermark,
         )
         # disaggregated prefill (SURVEY.md §2.3): producer pushes finished
         # prefill KV to the decode peer; consumer receives into its store
@@ -202,6 +226,8 @@ class LLMEngine:
             decode_pipeline=cfg.decode_pipeline,
             spec_k=cfg.speculative_k,
             spec_ngram=cfg.speculative_ngram,
+            max_waiting_seqs=cfg.max_waiting_seqs,
+            queue_deadline_s=cfg.queue_deadline_s,
         )
         # this loop dispatches run-ahead prefills behind in-flight chains
         # (_runahead_prefills), which is what licenses the scheduler's
@@ -233,6 +259,14 @@ class LLMEngine:
         self.spec_draft_tokens = 0     # drafts proposed (rounds * spec_k)
         self.spec_accepted_tokens = 0  # drafts the target accepted
         self.num_preemptions = 0
+        # load-shed accounting (admission control). Single writer per
+        # counter — a shared `dict[k] += 1` from two threads drops
+        # increments (load/add/store is not atomic): requests_shed is
+        # mutated ONLY on the engine device thread (_inbox_accept /
+        # _shed_expired), api_requests_shed ONLY on the aiohttp event loop
+        # (the API-layer fast-path 429); stats() sums them
+        self.requests_shed = {"queue_full": 0, "queue_deadline": 0}
+        self.api_requests_shed = 0
         # admission instrumentation: arrival -> first prefill dispatch, in ms
         # (the piece of TTFT a chained decode dispatch can inflate — an
         # arrival mid-chain waits for the whole chain before its prefill).
@@ -255,6 +289,41 @@ class LLMEngine:
             "wait": 0.0, "schedule": 0.0, "step": 0.0, "apply": 0.0,
             "emit": 0.0, "chain_dispatch": 0.0, "chain_fetch": 0.0,
         }
+
+    # -- admission control / load shedding ----------------------------------
+
+    def saturated(self) -> bool:
+        """Waiting queue at its configured bound — the API layer should shed
+        new generation work with 429 + Retry-After instead of queueing it."""
+        return self.scheduler.saturated()
+
+    def shed_retry_after(self) -> float:
+        return max(0.0, self.cfg.shed_retry_after_s)
+
+    def can_shed_queued(self) -> bool:
+        """Whether already-accepted requests may still shed after submission
+        (queue deadline, or the engine-side authoritative queue bound in
+        _inbox_accept) — the API layer then defers response headers until
+        the first engine output so a shed converts to a clean 429 instead of
+        a committed 200."""
+        return (
+            self.scheduler.queue_deadline_s > 0
+            or self.scheduler.max_waiting_seqs > 0
+        )
+
+    def _shed_expired(self) -> None:
+        """Shed waiting requests past the queue deadline: finish with reason
+        'shed' and emit the terminal output so the consumer (blocked on its
+        output queue) converts it to a 429 instead of hanging. shed_exempt
+        sequences (parallel-sampling siblings, see Sequence.shed_exempt) are
+        skipped: their request is mid-stream — shedding one choice could
+        never surface as a clean 429."""
+        for s in self.scheduler.expired_waiting():
+            if s.shed_exempt:
+                continue
+            self.scheduler._finish(s, "shed")
+            self.requests_shed["queue_deadline"] += 1
+            self._emit(s, "")
 
     def _recent_arrival_rate(self, window: float = 1.0) -> float:
         """Arrivals/sec over the trailing ``window`` seconds."""
@@ -451,6 +520,7 @@ class LLMEngine:
         params: Optional[SamplingParams] = None,
         lora_name: Optional[str] = None,
         trace: Optional[object] = None,
+        shed_exempt: bool = False,
     ) -> AsyncIterator[RequestOutput]:
         params = params or SamplingParams()
         if lora_name and self.lora is None:
@@ -479,6 +549,7 @@ class LLMEngine:
         seq = Sequence(
             seq_id=seq_id, prompt_ids=list(prompt_token_ids), params=params,
             lora_slot=lora_slot, cache_salt=cache_salt, trace=trace,
+            shed_exempt=shed_exempt,
         )
         self._inbox.put(seq)
         try:
@@ -544,7 +615,20 @@ class LLMEngine:
             seq.finished = True
             self._emit(seq, "", error=True)
             return
-        self.scheduler.add(seq)
+        sched = self.scheduler
+        # authoritative queue bound: the API layer's saturation check races
+        # a burst of arrivals (it reads scheduler state the inbox hasn't
+        # drained into yet), so the bound is ENFORCED here on the device
+        # thread — same free-seat projection (scheduler.saturated).
+        # shed_exempt sequences (parallel-sampling siblings of an admitted,
+        # mid-flight request — see Sequence.shed_exempt) bypass it:
+        # admission control gates requests, not choices.
+        if sched.saturated() and not seq.shed_exempt:
+            sched._finish(seq, "shed")
+            self.requests_shed["queue_full"] += 1
+            self._emit(seq, "")
+            return
+        sched.add(seq)
 
     def _run_loop(self) -> None:
         logger.info("engine loop started (model=%s)", self.cfg.name)
@@ -555,6 +639,7 @@ class LLMEngine:
                 continue
             t_sec = time.perf_counter()
             self._drain_inbox(block=not self.scheduler.has_work())
+            self._shed_expired()  # queue-deadline load shedding
             # adaptive chain depth inputs: the scheduler caps chained bursts
             # so the expected number of arrivals stuck waiting behind a chain
             # stays below ~half a request (scheduler.schedule)
@@ -1303,7 +1388,8 @@ class LLMEngine:
             self.runner.reset_kv()  # replicated in multi-host
             self.kv = KVPageManager(
                 self.kv.num_pages, self.kv.page_size, offload=self._offload,
-                max_io_pages=self.cfg.kv_offload_max_io_pages,
+                max_io_pages=self._max_io_pages,
+                spill_watermark=self.cfg.kv_spill_watermark,
             )
             self.scheduler.kv = self.kv
             self._sleeping = False
@@ -1322,6 +1408,16 @@ class LLMEngine:
             "num_requests_waiting": self.scheduler.num_waiting(),
             "num_requests_swapped": self.scheduler.num_swapped(),
             "num_preemptions_total": self.scheduler.preemptions_total,
+            "num_requests_shed_total": (
+                sum(self.requests_shed.values()) + self.api_requests_shed
+            ),
+            "num_requests_shed_queue_full_total": (
+                self.requests_shed["queue_full"] + self.api_requests_shed
+            ),
+            "num_requests_shed_queue_deadline_total": (
+                self.requests_shed["queue_deadline"]
+            ),
+            "engine_saturated": int(self.saturated()),
             "gpu_cache_usage_perc": self.kv.usage(),
             "gpu_prefix_cache_hits_total": self.kv.prefix_hits,
             "gpu_prefix_cache_queries_total": self.kv.prefix_queries,
@@ -1366,6 +1462,15 @@ class LLMEngine:
             out["kv_transfer_pinned_offer_bytes"] = ep.pinned_offer_bytes()
             out["kv_transfer_leaked_offers_total"] = ep.leaked_offers
             out["kv_transfer_cap_evicted_offers_total"] = ep.cap_evicted_offers
+        # eviction-policy observability (hot-prefix protection): total page
+        # evictions, evictions that hit a page with a nonzero reuse count
+        # (hot-set casualties — the "protected-page evictions" panel), and
+        # pages spilled ahead of eviction by the high-watermark path
+        out["kv_evicted_pages_total"] = self.kv.evicted_pages_total
+        out["kv_evicted_hot_pages_total"] = self.kv.evicted_hot_pages_total
+        out["kv_proactive_spilled_pages_total"] = (
+            self.kv.proactive_spilled_pages_total
+        )
         if self._offload is not None:
             o = self._offload.stats()
             out["kv_offload_hit_pages_total"] = self.kv.offload_hits
@@ -1373,4 +1478,16 @@ class LLMEngine:
             out["kv_offload_loaded_pages_total"] = o["loaded_pages"]
             out["kv_offload_cpu_bytes"] = o["cpu_bytes"]
             out["kv_offload_disk_bytes"] = o["disk_bytes"]
+            # permanent KV loss at the bottom local tier (satellite: was a
+            # silent drop) — nonzero means blobs left the hierarchy entirely
+            out["kv_offload_dropped_evictions_total"] = o.get(
+                "dropped_evictions", 0
+            )
+            # offload I/O budget provenance: the active cap and, when the
+            # startup probe chose it, the measured link bandwidth
+            out["kv_offload_max_io_pages"] = self.kv.max_io_pages
+            if self.kv_link_bandwidth_bytes_per_s is not None:
+                out["kv_offload_link_bandwidth_bytes_per_sec"] = round(
+                    self.kv_link_bandwidth_bytes_per_s
+                )
         return out

@@ -15,7 +15,8 @@ enabled by helm/templates/deployment-vllm-multi.yaml:137-141 in /root/reference.
 from __future__ import annotations
 
 import hashlib
-from collections import OrderedDict
+import heapq
+import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -45,20 +46,42 @@ def prefix_hashes(
 class PageInfo:
     ref_count: int = 0
     hash: Optional[bytes] = None  # set once the page is full + hashable
+    hits: int = 0                 # times served from the prefix cache
+    depth: int = 0                # page index in its prefix chain (0 = head)
+    last_used: float = 0.0        # monotonic, refreshed on every cache hit
+    offloaded: bool = False       # blob already saved to the offload tier
 
 
 class KVPageManager:
-    """Reference-counted page allocator with an LRU prefix cache.
+    """Reference-counted page allocator with a hot-prefix-protecting cache.
 
     - ``allocate(n)`` / ``free(pages)``: plain paged allocation.
     - ``match_prefix(tokens)``: longest cached page-aligned prefix -> shared
-      (ref-counted) pages. Cached pages with ref_count 0 live in an LRU pool
-      and are evicted only when a fresh allocation needs them.
+      (ref-counted) pages. Cached pages with ref_count 0 live in an evictable
+      pool and are reclaimed only when a fresh allocation needs them.
+
+    Eviction is NOT pure LRU. Free order puts a finished sequence's chain
+    HEAD pages into the pool before its tail, so LRU evicted the most
+    shareable pages first — measured at 107% page-pool occupancy the prefix
+    hit rate collapsed to 0.24 with ~2/3 of every prompt recomputed. Instead
+    every evictable page carries a reuse score (hit count decayed by recency,
+    plus a shared-prefix head bonus) and eviction takes the COLDEST page
+    first: one-shot tails churn while hot shared prefixes stay resident, so
+    >100% occupancy degrades smoothly. ``proactive_spill`` additionally
+    copies the coldest evictable pages to the offload tier once usage
+    crosses ``spill_watermark`` — the eventual eviction then frees the slot
+    without a blocking device fetch, heading off the allocation-stall
+    preemption storms of a spill done at the last possible moment.
     """
+
+    # hotness half-life: a page's accumulated hits decay with time since its
+    # last use, so a prefix that stops being requested eventually loses its
+    # protection instead of pinning pool space forever
+    HIT_DECAY_S = 600.0
 
     def __init__(
         self, num_pages: int, page_size: int, offload=None,
-        max_io_pages: int = 0,
+        max_io_pages: int = 0, spill_watermark: float = 0.9,
     ):
         self.num_pages = num_pages
         self.page_size = page_size
@@ -67,17 +90,99 @@ class KVPageManager:
         # recompute beats restore past a few pages, and an uncapped spill
         # batch stalls the engine loop for the whole fetch.
         self.max_io_pages = max_io_pages
+        # usage fraction past which proactive spill engages (0 or >=1 disable)
+        self.spill_watermark = spill_watermark
         self.pages = [PageInfo() for _ in range(num_pages)]
         self.free_list: list[int] = list(range(num_pages - 1, -1, -1))
         self.hash_to_page: dict[bytes, int] = {}
-        # pages with ref_count==0 but still holding reusable KV, LRU order
-        self.evictable: OrderedDict[int, None] = OrderedDict()
+        # pages with ref_count==0 but still holding reusable KV. Victim
+        # selection goes through a lazy min-heap keyed by reuse score; the
+        # token map invalidates stale heap entries (a page re-referenced and
+        # re-freed gets a fresh entry, the old one is skipped on pop).
+        self.evictable: dict[int, None] = {}
+        self._evict_heap: list[tuple[float, int, int]] = []  # (score, token, pid)
+        self._heap_token: dict[int, int] = {}
+        self._token_counter = 0
+        self._heap_refreshed_at = time.monotonic()
+        # unspilled-work flag gating proactive_spill's candidate scan
+        self._spill_dirty = False
         self.prefix_queries = 0
         self.prefix_hits = 0  # counted in pages
         self.offload_hits = 0  # pages restored from the offload tiers
+        self.evicted_pages_total = 0
+        # pages evicted DESPITE a nonzero hit count — hot-prefix casualties;
+        # a rising rate means the pool is too small for the hot set
+        self.evicted_hot_pages_total = 0
+        self.proactive_spilled_pages_total = 0
         # KVOffloadConnector (kvoffload/connector.py): spill evicted pages to
         # host DRAM/disk/remote and restore them on later prefix matches
         self.offload = offload
+
+    # -- eviction policy ----------------------------------------------------
+
+    def _evict_score(self, info: PageInfo) -> float:
+        """Reuse score; eviction takes the LOWEST first. Hits (decayed by
+        time since last use) dominate, so any recently-hit page outlives
+        every cold one; among cold pages the head bonus (1/(1+depth)) makes
+        chain TAILS go first — a chain can only restore/re-share from its
+        head, so a surviving head keeps value a surviving tail does not."""
+        age = max(0.0, time.monotonic() - info.last_used)
+        return info.hits * 0.5 ** (age / self.HIT_DECAY_S) + 1.0 / (1.0 + info.depth)
+
+    def _make_evictable(self, pid: int) -> None:
+        info = self.pages[pid]
+        self._token_counter += 1
+        self._heap_token[pid] = self._token_counter
+        heapq.heappush(
+            self._evict_heap, (self._evict_score(info), self._token_counter, pid)
+        )
+        self.evictable[pid] = None
+        # stale entries (page re-referenced then re-freed) are normally
+        # purged on pop — but a pool running BELOW capacity never pops, and
+        # a hot prefix cycling through the pool would leak one tuple per
+        # hit forever. Compact when stale entries dominate (amortized O(1);
+        # AFTER registering pid so the rebuild includes it).
+        if len(self._evict_heap) > 2 * len(self.evictable) + 64:
+            self._refresh_heap(time.monotonic())
+        if info.hash is not None and not info.offloaded:
+            self._spill_dirty = True
+
+    def _remove_evictable(self, pid: int) -> None:
+        del self.evictable[pid]
+        self._heap_token.pop(pid, None)  # stale heap entries skip on pop
+
+    def _refresh_heap(self, now: float) -> None:
+        """Rebuild the heap with CURRENT scores. Entries carry the score
+        computed when the page entered the pool; recency decay since then is
+        invisible to the heap ordering, so an abandoned hot prefix would
+        otherwise keep its stale high score (and its protection) forever.
+        One O(E) rebuild per HIT_DECAY_S bounds the staleness to a single
+        half-life — exactly the granularity the decay is meant to act at."""
+        self._evict_heap = []
+        self._heap_token.clear()
+        for pid in self.evictable:
+            self._token_counter += 1
+            self._heap_token[pid] = self._token_counter
+            self._evict_heap.append(
+                (self._evict_score(self.pages[pid]), self._token_counter, pid)
+            )
+        heapq.heapify(self._evict_heap)
+        self._heap_refreshed_at = now
+
+    def _pop_coldest(self) -> int:
+        """Pop the lowest-score evictable page (lazy heap: entries whose page
+        left the pool since push are skipped; scores older than one decay
+        half-life are refreshed wholesale first)."""
+        now = time.monotonic()
+        if now - self._heap_refreshed_at > self.HIT_DECAY_S:
+            self._refresh_heap(now)
+        while self._evict_heap:
+            _, token, pid = heapq.heappop(self._evict_heap)
+            if self._heap_token.get(pid) == token:
+                del self._heap_token[pid]
+                del self.evictable[pid]
+                return pid
+        raise AssertionError("evictable pool and heap out of sync")
 
     # -- allocation ---------------------------------------------------------
 
@@ -94,25 +199,35 @@ class KVPageManager:
         for _ in range(n):
             if self.free_list:
                 pid = self.free_list.pop()
-            else:  # evict oldest reusable page
-                pid, _ = self.evictable.popitem(last=False)
+            else:  # evict the coldest reusable page (reuse-score policy)
+                pid = self._pop_coldest()
                 info = self.pages[pid]
+                self.evicted_pages_total += 1
+                if info.hits > 0:
+                    self.evicted_hot_pages_total += 1
                 if info.hash is not None:
-                    if self.offload is not None:  # spill KV before slot reuse
-                        spill.append((pid, info.hash))
+                    # already-offloaded pages (proactive spill / earlier
+                    # restore) skip the spill batch — their blob is in the
+                    # tier, so the slot frees with zero device I/O
+                    if self.offload is not None and not info.offloaded:
+                        spill.append((pid, info.hash, info.depth))
                     self.hash_to_page.pop(info.hash, None)
                     info.hash = None
+                info.hits = 0
+                info.depth = 0
+                info.offloaded = False
             self.pages[pid].ref_count = 1
             out.append(pid)
         if spill:
             # batched: one device fetch for the whole eviction set, not one
             # ~100 ms host<->device round trip per page (connector.save_pages).
-            # Over budget, the OLDEST evictions spill — eviction order is
-            # free order, i.e. a sequence's HEAD pages first, and a prefix
+            # Over budget, chain HEADS spill (lowest depth first) — a prefix
             # chain can only restore from its head (the tail past the cap
             # recomputes, or re-shares if still in HBM). The rest are
             # dropped + reported evicted so the global KV index stays
             # truthful.
+            spill.sort(key=lambda t: t[2])
+            spill = [(pid, h) for pid, h, _ in spill]
             cap = self.max_io_pages
             if cap and len(spill) > cap:
                 dropped = spill[cap:]
@@ -142,9 +257,50 @@ class KVPageManager:
             assert info.ref_count >= 0, f"double free of page {pid}"
             if info.ref_count == 0:
                 if info.hash is not None:
-                    self.evictable[pid] = None  # keep KV for reuse
+                    self._make_evictable(pid)  # keep KV for reuse
                 else:
                     self.free_list.append(pid)
+
+    def proactive_spill(self) -> int:
+        """Copy the coldest evictable pages' KV to the offload tier while
+        they are still cache-resident, once usage crosses the high
+        watermark. The pages stay matchable in HBM; their eventual eviction
+        then frees the slot with no blocking device fetch (allocate skips
+        ``offloaded`` pages), so an allocation storm at >100% occupancy no
+        longer stalls the engine loop into a preemption storm. Bounded per
+        call by ``max_io_pages`` (64 when unbounded); cheap no-op until the
+        watermark is crossed AND unspilled evictable work exists. The
+        watermark is measured against the TRULY-free list (``usage()`` counts
+        evictable pages as free, and a pool full of cached-but-evictable KV
+        is exactly the state to pre-spill): free slots below
+        (1 - watermark) of the pool means the next allocation burst must
+        evict."""
+        if (
+            self.offload is None
+            or not self._spill_dirty
+            or not 0.0 < self.spill_watermark < 1.0
+            or len(self.free_list) > (1.0 - self.spill_watermark) * self.num_pages
+        ):
+            return 0
+        cap = self.max_io_pages or 64
+        # O(E log cap) selection, not a full sort: this runs on the scheduler
+        # step path whenever the watermark holds and unspilled work exists
+        unspilled = [
+            pid for pid in self.evictable
+            if self.pages[pid].hash is not None and not self.pages[pid].offloaded
+        ]
+        cands = heapq.nsmallest(
+            cap, ((self._evict_score(self.pages[pid]), pid) for pid in unspilled)
+        )
+        batch = [(pid, self.pages[pid].hash) for _, pid in cands]
+        self._spill_dirty = len(unspilled) > len(batch)
+        if not batch:
+            return 0
+        self.offload.save_pages(batch)
+        for pid, _ in batch:
+            self.pages[pid].offloaded = True
+        self.proactive_spilled_pages_total += len(batch)
+        return len(batch)
 
     # -- prefix cache -------------------------------------------------------
 
@@ -158,15 +314,18 @@ class KVPageManager:
         """
         hashes = prefix_hashes(tokens, self.page_size, salt)
         self.prefix_queries += max(len(hashes), 1)
+        now = time.monotonic()
         shared: list[int] = []
         for h in hashes:
             pid = self.hash_to_page.get(h)
             if pid is None:
                 break
             info = self.pages[pid]
-            if info.ref_count == 0:
-                self.evictable.pop(pid, None)
+            if info.ref_count == 0 and pid in self.evictable:
+                self._remove_evictable(pid)
             info.ref_count += 1
+            info.hits += 1
+            info.last_used = now
             shared.append(pid)
         if self.offload is not None:
             shared = self._extend_from_offload(hashes, shared)
@@ -189,6 +348,7 @@ class KVPageManager:
         # in HBM, restore tier-resident ones; stop at the first miss
         plan: list[tuple[bytes, Optional[int]]] = []  # (hash, pid | None)
         n_restores = 0
+        now = time.monotonic()
         for h in hashes[len(shared):]:
             pid = self.hash_to_page.get(h)
             if pid is not None:
@@ -196,9 +356,11 @@ class KVPageManager:
                 # registered by a later request) — share it, don't restore.
                 # Ref it NOW so planning's own allocations can't evict it.
                 info = self.pages[pid]
-                if info.ref_count == 0:
-                    self.evictable.pop(pid, None)
+                if info.ref_count == 0 and pid in self.evictable:
+                    self._remove_evictable(pid)
                 info.ref_count += 1
+                info.hits += 1
+                info.last_used = now
                 plan.append((h, pid))
             elif self.offload.has(h):
                 if self.max_io_pages and n_restores >= self.max_io_pages:
@@ -262,6 +424,10 @@ class KVPageManager:
                 ri += 1
                 info = self.pages[rp]
                 info.hash = h
+                info.depth = len(shared)  # position in the restored chain
+                info.hits = 1
+                info.last_used = now
+                info.offloaded = True  # blob still lives in the tier
                 self.hash_to_page[h] = rp
                 shared.append(rp)
                 self.offload_hits += 1
@@ -277,11 +443,16 @@ class KVPageManager:
         """Record hashes for fully-written pages of a sequence so later
         requests can share them. Called after prefill completes."""
         hashes = prefix_hashes(tokens, self.page_size, salt)
+        now = time.monotonic()
         new: list[bytes] = []
-        for h, pid in zip(hashes, page_ids):
+        for depth, (h, pid) in enumerate(zip(hashes, page_ids)):
             info = self.pages[pid]
             if info.hash is None and h not in self.hash_to_page:
                 info.hash = h
+                info.depth = depth
+                info.hits = 0
+                info.last_used = now
+                info.offloaded = False
                 self.hash_to_page[h] = pid
                 new.append(h)
         if self.offload is not None and new:

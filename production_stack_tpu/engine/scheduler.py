@@ -79,6 +79,14 @@ class Sequence:
     first_dispatch_time: Optional[float] = None  # admission-wait instrumentation
     lora_slot: int = 0             # adapter slot (0 = base model)
     cache_salt: bytes = b""        # prefix-cache salt (adapter identity)
+    # exempt from load shedding (queue bound + queue deadline): set by the
+    # API layer for parallel-sampling SIBLINGS (choice > 0), which only
+    # launch after choice 0's first output — their request is mid-flight,
+    # a 429 is no longer possible, and shedding one choice would leak a
+    # zero-token 'shed' finish into a committed stream. Choice 0 itself
+    # stays sheddable: its pre-output shed converts the whole request to a
+    # clean 429 and the siblings are aborted with it.
+    shed_exempt: bool = False
     # distributed-tracing context (tracing.SpanContext of the engine.request
     # span) — phase spans for this sequence parent under it; None = untraced
     trace: Optional[object] = None
@@ -158,6 +166,8 @@ class Scheduler:
         decode_pipeline: int = 1,
         spec_k: int = 0,
         spec_ngram: int = 3,
+        max_waiting_seqs: int = 0,
+        queue_deadline_s: float = 0.0,
     ):
         self.kv = kv
         self.max_num_seqs = max_num_seqs
@@ -180,6 +190,16 @@ class Scheduler:
         self.decode_pipeline = max(1, decode_pipeline)
         self.spec_k = max(0, spec_k)
         self.spec_ngram = max(1, spec_ngram)
+        # admission control (overload survival, docs/failure-handling.md):
+        # a bounded waiting queue — the API layer sheds (429 + Retry-After)
+        # once num_waiting() reaches max_waiting_seqs (0 = unbounded) — and
+        # a per-request queue deadline: a request still undispatched after
+        # queue_deadline_s seconds is shed by the engine loop (0 = never).
+        # Unbounded queues turn overload into unbounded TTFT for EVERYONE;
+        # shedding keeps the served subset's latency sane and tells clients
+        # exactly when to retry.
+        self.max_waiting_seqs = max(0, max_waiting_seqs)
+        self.queue_deadline_s = max(0.0, queue_deadline_s)
         self.waiting: list[Sequence] = []
         self.running: list[Sequence] = []
         self.preemptions_total = 0
@@ -240,6 +260,37 @@ class Scheduler:
 
     def num_waiting(self) -> int:
         return len(self.waiting)
+
+    def saturated(self) -> bool:
+        """Waiting queue at (or past) its bound — new work should shed.
+
+        Free seats project forward: sequences about to be admitted straight
+        into running must not count against the waiting bound, or a batch
+        finishing (seats free, queue momentarily still full) would shed
+        arrivals a nearly-idle engine could serve — and export a spurious
+        engine_saturated gauge the router honors for a whole scrape
+        interval. This projection is the single saturation definition: the
+        API fast path, the engine-side authoritative bound, and the
+        /metrics gauge all read it."""
+        if self.max_waiting_seqs <= 0:
+            return False
+        free_seats = max(0, self.max_num_seqs - len(self.running))
+        return len(self.waiting) >= self.max_waiting_seqs + free_seats
+
+    def expired_waiting(self, now: Optional[float] = None) -> list[Sequence]:
+        """Waiting sequences past the queue deadline that can still shed
+        CLEANLY: never dispatched (no tokens streamed) and not preempted —
+        a preempted sequence already delivered output, so a 429 is no longer
+        an honest answer and it keeps its place instead."""
+        if self.queue_deadline_s <= 0:
+            return []
+        now = time.monotonic() if now is None else now
+        return [
+            s for s in self.waiting
+            if s.first_dispatch_time is None
+            and not getattr(s, "preempted", False)
+            and now - s.arrival_time > self.queue_deadline_s
+        ]
 
     def num_running(self) -> int:
         return len(self.running)
@@ -365,6 +416,11 @@ class Scheduler:
     # -- step planning ------------------------------------------------------
 
     def schedule(self) -> Optional[ScheduledBatch]:
+        # high-watermark proactive spill: while the pool is nearly full, copy
+        # the coldest evictable pages to the offload tier BEFORE an admission
+        # or decode-growth allocation forces an eviction — the eviction then
+        # frees slots with zero device I/O (cheap no-op below the watermark)
+        self.kv.proactive_spill()
         self._try_admit()
         prefilling = [s for s in self.running if s.in_prefill]
         decoding = [s for s in self.running if not s.in_prefill]

@@ -200,6 +200,11 @@ class TieredKVStore:
         self._lock = threading.RLock()
         self.hits = {"cpu": 0, "disk": 0, "remote": 0}
         self.misses = 0
+        # blobs evicted out the BOTTOM of the local hierarchy (disk-tier
+        # eviction, or CPU-tier eviction with no disk tier). Without a remote
+        # tier this is permanent KV loss — it used to happen silently;
+        # exported as kv_offload_dropped_evictions_total on /metrics
+        self.dropped_evictions = 0
 
     def enabled(self) -> bool:
         # NB: explicit None checks — the tiers define __len__, so an *empty*
@@ -217,6 +222,7 @@ class TieredKVStore:
                 self._dropped_locally(k)
 
     def _dropped_locally(self, key: str) -> None:
+        self.dropped_evictions += 1
         if self.on_local_drop is not None and not self.contains_local(key):
             self.on_local_drop(key)
 
@@ -281,5 +287,6 @@ class TieredKVStore:
                 "disk_bytes": self.disk.used_bytes if self.disk else 0,
                 "hits": dict(self.hits),
                 "misses": self.misses,
+                "dropped_evictions": self.dropped_evictions,
                 "remote_errors": self.remote.errors if self.remote else 0,
             }

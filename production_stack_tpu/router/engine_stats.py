@@ -29,6 +29,9 @@ class EngineStats:
     gpu_prefix_cache_hits_total: float = 0.0
     gpu_prefix_cache_queries_total: float = 0.0
     gpu_cache_usage_perc: float = 0.0
+    # engine-side admission control state (api_server overload surface):
+    # routing deprioritizes saturated backends between Retry-After windows
+    engine_saturated: int = 0
 
     _FIELDS = {
         "vllm:num_requests_running": "num_running_requests",
@@ -37,6 +40,7 @@ class EngineStats:
         "vllm:gpu_prefix_cache_hits_total": "gpu_prefix_cache_hits_total",
         "vllm:gpu_prefix_cache_queries_total": "gpu_prefix_cache_queries_total",
         "vllm:gpu_cache_usage_perc": "gpu_cache_usage_perc",
+        "vllm:engine_saturated": "engine_saturated",
     }
 
     @staticmethod

@@ -26,11 +26,14 @@ from production_stack_tpu.router.resilience import (
     count_deadline_abort,
     count_failover,
     count_retry,
+    count_shed,
     get_breaker_registry,
     get_retry_policy,
+    get_saturation_registry,
 )
 from production_stack_tpu.router.routing_logic import (
     DisaggregatedPrefillRouter,
+    SessionRouter,
     get_routing_logic,
 )
 from production_stack_tpu.router.engine_stats import get_engine_stats_scraper
@@ -146,12 +149,55 @@ def _filter_headers(headers) -> dict:
 class _RetryableProxyError(Exception):
     """Connect-stage or pre-first-byte failure: no response bytes have
     reached the client, so the request can safely fail over to another
-    backend. Mid-stream failures are NOT retryable — tokens already left."""
+    backend. Mid-stream failures are NOT retryable — tokens already left.
 
-    def __init__(self, reason: str, status: int = 502):
+    ``status == 429`` marks a load SHED (engine admission control): the
+    backend is healthy but out of capacity, so failover is immediate (no
+    backoff), the circuit breaker is never fed, and ``retry_after`` carries
+    the backend's Retry-After hint for the terminal client response."""
+
+    def __init__(self, reason: str, status: int = 502,
+                 retry_after: Optional[float] = None):
         super().__init__(reason)
         self.reason = reason
         self.status = status
+        self.retry_after = retry_after
+
+    @property
+    def is_shed(self) -> bool:
+        return self.status == 429
+
+
+# longest a single 429 may exclude a backend from routing: a malformed or
+# hostile Retry-After ('inf', '1e18') must never quarantine a healthy
+# backend until router restart
+MAX_RETRY_AFTER_S = 60.0
+
+
+def _parse_retry_after(raw: Optional[str]) -> float:
+    """Retry-After header seconds (delta form only; HTTP-date is overkill
+    for an intra-cluster contract). Malformed/absent -> 1 s; clamped to
+    [0, MAX_RETRY_AFTER_S]."""
+    try:
+        v = float(raw)
+    except (TypeError, ValueError):
+        return 1.0
+    if v != v:  # NaN
+        return 1.0
+    return min(MAX_RETRY_AFTER_S, max(0.0, v))
+
+
+def _overloaded_response(message: str, retry_after: Optional[float]) -> web.Response:
+    """Terminal 429 + Retry-After for a fleet-wide shed (mirrors the
+    engine's shed contract, api_server._shed_response): the honest answer
+    under saturation, and the signal well-behaved clients back off on."""
+    retry = max(1, int(-(-(retry_after or 1.0) // 1)))  # ceil, floor 1 s
+    return web.json_response(
+        {"error": {"message": message, "type": "overloaded_error",
+                   "code": 429}},
+        status=429,
+        headers={"Retry-After": str(retry)},
+    )
 
 
 async def abort_on_engine(backend_url: str, request_id: str) -> None:
@@ -261,11 +307,23 @@ async def process_request(
                 )
             except _RetryableProxyError as e:
                 last_err = e
-                breakers.record_failure(backend_url)
-                logger.error(
-                    "backend %s failed for request %s (attempt %d/%d): %s",
-                    backend_url, request_id, attempt, policy.max_attempts, e.reason,
-                )
+                if e.is_shed:
+                    # engine load shed (429 + Retry-After): the backend is
+                    # healthy, just out of capacity — NEVER feeds the
+                    # breaker (acceptance: shed failover must not trip it)
+                    logger.warning(
+                        "backend %s shed request %s (attempt %d/%d, "
+                        "retry-after %.1fs); failing over",
+                        backend_url, request_id, attempt, policy.max_attempts,
+                        e.retry_after or 1.0,
+                    )
+                else:
+                    breakers.record_failure(backend_url)
+                    logger.error(
+                        "backend %s failed for request %s (attempt %d/%d): %s",
+                        backend_url, request_id, attempt, policy.max_attempts,
+                        e.reason,
+                    )
             remaining = policy.remaining(t_attempts0)
             if remaining is not None and remaining <= 0:
                 count_deadline_abort("request")
@@ -283,12 +341,20 @@ async def process_request(
                 except Exception:
                     logger.exception("failover routing failed")
             if nxt is None:
+                if last_err.is_shed:
+                    # every alternative is saturated too: surface the 429 +
+                    # Retry-After now — re-queueing on a known-saturated
+                    # backend only adds latency to an honest answer
+                    break
                 # no alternative endpoint: re-try the same backend only if
                 # its breaker still admits traffic, else give up now
                 if not breakers.allows(backend_url):
                     break
                 nxt = backend_url
-            delay = policy.backoff(attempt)
+            # shed failover is IMMEDIATE: the engine told us exactly why it
+            # refused, and other engines have capacity — backoff only delays
+            # the client while the shedding engine's queue drains
+            delay = 0.0 if last_err.is_shed else policy.backoff(attempt)
             if remaining is not None:
                 delay = min(delay, max(0.0, remaining))
             count_retry()
@@ -300,6 +366,13 @@ async def process_request(
                 )
             await asyncio.sleep(delay)
             backend_url = nxt
+        if last_err.is_shed:
+            # all candidates saturated: forward the shed verbatim
+            return _overloaded_response(
+                f"all backends saturated after {attempt} attempt(s): "
+                f"{last_err.reason}",
+                last_err.retry_after,
+            )
         return web.json_response(
             {"error": f"backend error after {attempt} attempt(s): {last_err.reason}"},
             status=last_err.status if last_err.status >= 500 else 502,
@@ -414,6 +487,24 @@ async def _proxy_attempt(
                 f"backend returned {backend_resp.status}: "
                 f"{detail.decode(errors='replace')}",
                 backend_resp.status,
+            )
+        if backend_resp.status == 429:
+            # engine load shed (admission control): remember the Retry-After
+            # window so routing stops offering this backend new traffic, and
+            # convert to an immediate breaker-neutral failover
+            retry_after = _parse_retry_after(
+                backend_resp.headers.get("Retry-After")
+            )
+            try:
+                detail = (await asyncio.wait_for(backend_resp.read(), 2.0))[:200]
+            except Exception:  # noqa: BLE001 - body is best-effort detail
+                detail = b""
+            get_saturation_registry().mark(backend_url, retry_after)
+            count_shed()
+            raise _RetryableProxyError(
+                f"backend shed the request (429, retry-after {retry_after:g}s): "
+                f"{detail.decode(errors='replace')}",
+                429, retry_after=retry_after,
             )
         chunks = backend_resp.content.iter_any()
         first_chunk: Optional[bytes] = None
@@ -535,8 +626,8 @@ async def _proxy_attempt(
         if capture_body is not None:
             await capture_body(backend_resp.status, b"".join(captured))
         return resp
-    except _RetryableProxyError:
-        outcome = "retryable_error"
+    except _RetryableProxyError as e:
+        outcome = "shed" if e.is_shed else "retryable_error"
         if backend_resp is not None:
             backend_resp.close()
         raise
@@ -638,6 +729,25 @@ async def route_general_request(
     endpoints = get_breaker_registry().filter_endpoints(endpoints)
 
     engine_stats = get_engine_stats_scraper().get_engine_stats()
+    # shed-aware placement: saturated backends (inside a 429 Retry-After
+    # window, or reporting vllm:engine_saturated on scrape) receive no new
+    # NON-STICKY traffic. Sticky means THIS request actually resolves a
+    # session key — that request keeps its backend (losing affinity costs a
+    # full-prefix recompute; the engine's own 429 plus failover covers the
+    # truly-saturated case). Keyless requests under a SessionRouter fall
+    # back to QPS routing and are as re-homeable as any other traffic, so
+    # they route around saturation too. Fail-static when the whole fleet is
+    # saturated.
+    sticky = False
+    if isinstance(router, SessionRouter):
+        headers = getattr(request, "headers", None)
+        sticky = bool(
+            (headers.get(router.session_key) if headers is not None else None)
+            or (request_json or {}).get(router.session_key)
+        )
+    if not sticky:
+        endpoints = router.saturation_filtered(endpoints, engine_stats)
+
     request_stats = get_request_stats_monitor().get_request_stats()
     t_route0 = time.perf_counter()
     try:
@@ -650,10 +760,15 @@ async def route_general_request(
 
     async def pick_next(excluded: set) -> Optional[str]:
         """Failover target: re-run the routing logic over the surviving
-        candidates (already-failed URLs excluded, open breakers excluded
-        WITHOUT the fail-static fallback — if every alternative is tripped,
-        surfacing the original error beats queueing on a known-bad pod)."""
-        pool = [ep for ep in candidates if ep.url not in excluded]
+        candidates (already-failed URLs excluded, open breakers and
+        saturated backends excluded WITHOUT the fail-static fallback — if
+        every alternative is tripped or shedding, surfacing the original
+        error/429 beats queueing on a known-bad or known-full pod)."""
+        sat = get_saturation_registry()
+        pool = [
+            ep for ep in candidates
+            if ep.url not in excluded and not sat.is_saturated(ep.url)
+        ]
         pool = get_breaker_registry().filter_endpoints(pool, fail_static=False)
         if not pool:
             return None
@@ -711,6 +826,16 @@ async def send_request_to_prefiller(
                 f"prefiller returned {resp.status}: "
                 f"{detail.decode(errors='replace')}",
                 resp.status,
+            )
+        if resp.status == 429:
+            # prefiller shed (admission control): breaker-neutral immediate
+            # failover to another prefiller, same as the general proxy path
+            retry_after = _parse_retry_after(resp.headers.get("Retry-After"))
+            get_saturation_registry().mark(url, retry_after)
+            count_shed()
+            raise _RetryableProxyError(
+                f"prefiller shed the request (429, retry-after "
+                f"{retry_after:g}s)", 429, retry_after=retry_after,
             )
         try:
             body = await resp.json()
@@ -799,7 +924,9 @@ async def route_disaggregated_prefill_request(
         except (_RetryableProxyError, aiohttp.ClientError, asyncio.TimeoutError,
                 ConnectionResetError) as e:
             monitor.on_request_complete(prefill_url, request_id)
-            breakers.record_failure(prefill_url)
+            shed = isinstance(e, _RetryableProxyError) and e.is_shed
+            if not shed:  # sheds are capacity, not failure: breaker-neutral
+                breakers.record_failure(prefill_url)
             if isinstance(e, asyncio.TimeoutError):
                 count_deadline_abort("ttft")
                 spawn_abort(prefill_url, request_id)
@@ -825,18 +952,25 @@ async def route_disaggregated_prefill_request(
             # _pick's label fallback would otherwise silently run prefill on
             # a decode pod, breaking the disaggregation invariant. With no
             # labeled pods anywhere (label-less test rigs) any pod is fair.
-            pool = [ep for ep in endpoints if ep.url not in tried]
+            sat = get_saturation_registry()
+            pool = [ep for ep in endpoints
+                    if ep.url not in tried and not sat.is_saturated(ep.url)]
             if any(ep.model_label in router.prefill_labels for ep in endpoints):
                 pool = [ep for ep in pool
                         if ep.model_label in router.prefill_labels]
             if attempt >= policy.max_attempts or not pool:
+                if shed:
+                    return _overloaded_response(
+                        f"all prefillers saturated after {attempt} attempt(s)",
+                        e.retry_after,
+                    )
                 return web.json_response(
                     {"error": f"prefill failed after {attempt} attempt(s): {e}"},
                     status=502,
                 )
             count_retry()
             count_failover()
-            delay = policy.backoff(attempt)
+            delay = 0.0 if shed else policy.backoff(attempt)
             if remaining is not None:
                 delay = min(delay, max(0.0, remaining))
             await asyncio.sleep(delay)

@@ -20,6 +20,12 @@ Three cooperating mechanisms (docs/failure-handling.md):
   fail-static: when EVERY candidate's breaker is open the original list is
   returned unchanged — a fully-tripped fleet must degrade to "try anyway",
   never to a synthesized 503.
+- **SaturationRegistry** — backend 429 + Retry-After responses (engine load
+  shedding, docs/failure-handling.md overload section) mark the backend
+  saturated for the advertised window. Shed-aware failover moves the request
+  to the next engine immediately WITHOUT feeding the breaker (an overloaded
+  engine is healthy), and routing stops offering saturated backends new
+  non-sticky traffic until the window elapses.
 
 All state is mutated from the router's single event loop; plain ints are
 safe counters here. Rendered into /metrics by ``render_resilience_metrics``
@@ -207,10 +213,65 @@ class BreakerRegistry:
         return dict(self._breakers)
 
 
+class SaturationRegistry:
+    """Per-backend load-shed state (overload survival).
+
+    A backend answering 429 + Retry-After is SHEDDING, not failing: it is
+    healthy, it just has no capacity right now. The registry remembers the
+    advertised Retry-After window so routing stops offering the backend new
+    non-sticky traffic until the window elapses — a scrape-interval-fast
+    signal (the engine-stats gauge lags by up to a scrape period). Distinct
+    from the circuit breaker by design: sheds never feed the breaker, so an
+    overloaded-but-alive fleet can't trip itself into fail-static mode.
+    """
+
+    def __init__(self):
+        self._until: dict[str, float] = {}  # url -> monotonic expiry
+        # every backend that EVER shed, for 1->0 gauge transitions: a series
+        # that vanishes instead of flipping to 0 leaves Prometheus showing a
+        # stale 1 until the staleness interval, and `== 0` alerts never fire
+        self._seen: set[str] = set()
+
+    # shed-window clamp (defense in depth with request_service's Retry-After
+    # parser): one 429 must never exclude a backend for longer than this
+    MAX_WINDOW_S = 60.0
+
+    def mark(self, url: str, retry_after_s: float) -> None:
+        window = min(self.MAX_WINDOW_S, max(0.5, retry_after_s))
+        self._until[url] = time.monotonic() + window
+        self._seen.add(url)
+
+    def is_saturated(self, url: str, now: Optional[float] = None) -> bool:
+        until = self._until.get(url)
+        if until is None:
+            return False
+        if (now or time.monotonic()) >= until:
+            del self._until[url]  # window elapsed: eligible again
+            return False
+        return True
+
+    def saturated_urls(self) -> list[str]:
+        now = time.monotonic()
+        return sorted(u for u in list(self._until) if self.is_saturated(u, now))
+
+    def seen_urls(self) -> list[str]:
+        return sorted(self._seen)
+
+    def forget(self, url: str) -> None:
+        """Backend gone (pod deleted): drop its window AND its gauge row."""
+        self._until.pop(url, None)
+        self._seen.discard(url)
+
+    def clear(self) -> None:
+        self._until.clear()
+        self._seen.clear()
+
+
 # -- counters (event-loop-only mutation; rendered by app.py /metrics) --------
 
 retries_total = 0
 failovers_total = 0
+sheds_total = 0  # backend 429s observed (shed-aware failover, not failures)
 deadline_aborts_total: dict[str, int] = {"ttft": 0, "inter_chunk": 0, "request": 0}
 
 
@@ -224,6 +285,11 @@ def count_failover() -> None:
     failovers_total += 1
 
 
+def count_shed() -> None:
+    global sheds_total
+    sheds_total += 1
+
+
 def count_deadline_abort(kind: str) -> None:
     deadline_aborts_total[kind] = deadline_aborts_total.get(kind, 0) + 1
 
@@ -231,9 +297,10 @@ def count_deadline_abort(kind: str) -> None:
 def reset_counters() -> None:
     """Test/bench support (mirrors reset_hop_samples): live Prometheus
     counters never reset outside a process restart."""
-    global retries_total, failovers_total
+    global retries_total, failovers_total, sheds_total
     retries_total = 0
     failovers_total = 0
+    sheds_total = 0
     for k in list(deadline_aborts_total):
         deadline_aborts_total[k] = 0
 
@@ -245,6 +312,8 @@ def render_resilience_metrics() -> list[str]:
         f"vllm_router:retries_total {retries_total}",
         "# TYPE vllm_router:failovers_total counter",
         f"vllm_router:failovers_total {failovers_total}",
+        "# TYPE vllm_router:sheds_total counter",
+        f"vllm_router:sheds_total {sheds_total}",
         "# TYPE vllm_router:deadline_aborts_total counter",
     ]
     for kind, n in sorted(deadline_aborts_total.items()):
@@ -264,6 +333,16 @@ def render_resilience_metrics() -> list[str]:
             lines.append(
                 f'vllm_router:circuit_open_events_total{{backend="{url}"}} {b.open_events}'
             )
+    sat_reg = get_saturation_registry()
+    seen = sat_reg.seen_urls()
+    if seen:
+        active = set(sat_reg.saturated_urls())
+        lines.append("# TYPE vllm_router:backend_saturated gauge")
+        for url in seen:  # 0 rows included: the gauge flips, never vanishes
+            lines.append(
+                f'vllm_router:backend_saturated{{backend="{url}"}} '
+                f"{int(url in active)}"
+            )
     return lines
 
 
@@ -271,6 +350,14 @@ def render_resilience_metrics() -> list[str]:
 
 _policy: Optional[RetryPolicy] = None
 _registry: Optional[BreakerRegistry] = None
+_saturation: Optional[SaturationRegistry] = None
+
+
+def get_saturation_registry() -> SaturationRegistry:
+    global _saturation
+    if _saturation is None:
+        _saturation = SaturationRegistry()
+    return _saturation
 
 
 def initialize_resilience(
@@ -285,6 +372,7 @@ def initialize_resilience(
     breaker_cooldown: float = 30.0,
 ) -> None:
     global _policy, _registry
+    get_saturation_registry().clear()  # reconfigure: no stale shed windows
     _policy = RetryPolicy(
         max_attempts=retry_max_attempts,
         backoff_base=retry_backoff_base,

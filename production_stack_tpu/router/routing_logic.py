@@ -48,6 +48,30 @@ class RoutingInterface(metaclass=SingletonMeta):
 
         return get_breaker_registry().filter_endpoints(endpoints)
 
+    @staticmethod
+    def saturation_filtered(
+        endpoints: list[EndpointInfo], engine_stats: Optional[dict] = None
+    ) -> list[EndpointInfo]:
+        """Deprioritize saturated backends: drop endpoints currently inside
+        a shed window (a recent 429 + Retry-After) or whose scraped stats
+        report ``vllm:engine_saturated`` — they have no capacity for new
+        non-sticky traffic. Fail-static: when EVERY candidate is saturated
+        the original set passes through unchanged, so the requests reach an
+        engine whose own 429 (with Retry-After) is the correct client
+        answer — never a synthesized router error."""
+        from production_stack_tpu.router.resilience import get_saturation_registry
+
+        reg = get_saturation_registry()
+        out = []
+        for ep in endpoints:
+            if reg.is_saturated(ep.url):
+                continue
+            es = (engine_stats or {}).get(ep.url)
+            if es is not None and getattr(es, "engine_saturated", 0):
+                continue
+            out.append(ep)
+        return out if out else list(endpoints)
+
 
 def _qps_routing(endpoints: list[EndpointInfo], request_stats: dict[str, Any]) -> str:
     """Lowest-QPS endpoint (parity :59-81)."""

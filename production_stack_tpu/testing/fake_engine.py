@@ -15,6 +15,13 @@ scripts/chaos_check.py):
 - ``--hang``             accepts the request, never sends headers (hung
                          engine; only an abort or a router deadline frees it)
 - ``--hang-after-chunks N``  streams N chunks then stalls forever
+- ``--saturate-after-n N``  engine admission control: a generation request
+                         arriving while N are already in flight is SHED
+                         with 429 + Retry-After (bounded queue depth — the
+                         in-flight count provably never exceeds N)
+- ``--shed-rate P``      each generation request 429s (with Retry-After)
+                         with probability P
+- ``--retry-after S``    Retry-After seconds advertised on shed responses
 - ``POST /abort``        cancels an in-flight request by X-Request-Id, like
                          the real engine's abort endpoint
 
@@ -45,10 +52,12 @@ from production_stack_tpu.tracing import (
 
 STATE = {
     "running": 0,
+    "running_peak": 0,      # high-watermark of concurrent in-flight requests
     "total": 0,
     "sleeping": False,
     "draining": False,
     "served": 0,            # generation requests seen (drives --fail-first-n)
+    "shed": 0,              # 429s emitted (saturate-after-n / shed-rate)
     "inflight": {},         # req_id -> handler asyncio.Task (for /abort)
 }
 
@@ -61,6 +70,18 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     fail_after_chunks = faults.get("fail_after_chunks")
     hang = bool(faults.get("hang", False))
     hang_after_chunks = faults.get("hang_after_chunks")
+    saturate_after_n = faults.get("saturate_after_n")
+    shed_rate = float(faults.get("shed_rate", 0.0))
+    retry_after = f"{float(faults.get('retry_after') or 1):g}"
+
+    def shed_response(reason: str):
+        STATE["shed"] += 1
+        return web.json_response(
+            {"error": {"message": f"saturated (injected: {reason})",
+                       "type": "overloaded_error", "code": 429}},
+            status=429,
+            headers={"Retry-After": retry_after},
+        )
 
     async def health(request):
         if STATE["draining"]:
@@ -83,12 +104,20 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         )
 
     async def metrics(request):
+        saturated = int(
+            saturate_after_n is not None
+            and STATE["running"] >= int(saturate_after_n)
+        )
         text = (
             f'vllm:num_requests_running{{model_name="{model}"}} {STATE["running"]}\n'
             f'vllm:num_requests_waiting{{model_name="{model}"}} 0\n'
             f'vllm:gpu_cache_usage_perc{{model_name="{model}"}} 0.42\n'
             f'vllm:gpu_prefix_cache_hits_total{{model_name="{model}"}} 10\n'
             f'vllm:gpu_prefix_cache_queries_total{{model_name="{model}"}} 20\n'
+            f'vllm:engine_saturated{{model_name="{model}"}} {saturated}\n'
+            f'vllm:num_requests_shed_total{{model_name="{model}"}} {STATE["shed"]}\n'
+            # fake-only observability: bounded-queue proof for overload tests
+            f'fake:running_peak{{model_name="{model}"}} {STATE["running_peak"]}\n'
         )
         # per-phase histograms, same names as the real engine's /metrics so
         # smoke tests and dashboard queries exercise the fake identically
@@ -132,6 +161,13 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             return web.json_response(
                 {"error": {"message": "injected failure (fail-rate)"}}, status=500
             )
+        # admission control simulation: shed BEFORE taking a slot, so the
+        # in-flight count is provably bounded by saturate_after_n (the
+        # overload chaos scenario asserts on running_peak)
+        if saturate_after_n is not None and STATE["running"] >= int(saturate_after_n):
+            return shed_response("saturate-after-n")
+        if shed_rate and random.random() < shed_rate:
+            return shed_response("shed-rate")
         # distributed tracing, same span model as the real engine
         # (engine.request > queue/prefill/decode) so router e2e tests can
         # assert full-stack trace propagation without a TPU
@@ -139,6 +175,7 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         trace_ctx = collector.root_from_headers(request.headers).child()
         t_accept = time.time()
         STATE["running"] += 1
+        STATE["running_peak"] = max(STATE["running_peak"], STATE["running"])
         STATE["total"] += 1
         # registered while holding a slot so POST /abort can cancel this
         # handler and free the slot, like the real engine's abort endpoint
@@ -316,6 +353,14 @@ def main():
                    help="accept generation requests but never respond")
     p.add_argument("--hang-after-chunks", type=int, default=None,
                    help="stall the stream after N chunks (connection stays up)")
+    p.add_argument("--saturate-after-n", type=int, default=None,
+                   help="shed (429 + Retry-After) generation requests "
+                        "arriving while N are already in flight")
+    p.add_argument("--shed-rate", type=float, default=0.0,
+                   help="probability a generation request is shed with "
+                        "429 + Retry-After")
+    p.add_argument("--retry-after", type=float, default=1.0,
+                   help="Retry-After seconds advertised on shed responses")
     args = p.parse_args()
     app = make_app(
         args.model, args.speed, args.ttft, args.model_label,
@@ -325,6 +370,9 @@ def main():
             "fail_after_chunks": args.fail_after_chunks,
             "hang": args.hang,
             "hang_after_chunks": args.hang_after_chunks,
+            "saturate_after_n": args.saturate_after_n,
+            "shed_rate": args.shed_rate,
+            "retry_after": args.retry_after,
         },
     )
     asyncio.run(_serve_until_sigterm(app, args.port))

@@ -12,10 +12,19 @@ enabled, then drives a request run through the router and asserts:
 - both broken backends' circuit breakers are open by the end (checked
   against vllm_router:circuit_state on /metrics).
 
-Importable as ``run_chaos()`` (tests/test_chaos.py wires it into tier-1) or
-runnable standalone:
+A second scenario, ``run_overload()`` (``--scenario overload``), drives an
+arrival rate above fleet capacity: two fake engines with bounded admission
+(``--saturate-after-n``) behind a shed-aware router. Overflow requests must
+shed CLEANLY — every client response is a 200 or a 429 with Retry-After
+(zero other errors, zero hangs), per-engine in-flight depth stays bounded,
+and the shedding engines' circuit breakers stay closed (a shed is capacity,
+not failure).
+
+Importable as ``run_chaos()`` / ``run_overload()`` (tests/test_chaos.py
+wires both into tier-1) or runnable standalone:
 
     python scripts/chaos_check.py --num-requests 200
+    python scripts/chaos_check.py --scenario overload
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ import collections
 import json
 import re
 import sys
+import threading
 
 import requests
 
@@ -131,15 +141,155 @@ def run_chaos(
             stop_proc(p)
 
 
+def run_overload(
+    num_requests: int = 48,
+    concurrency: int = 12,
+    seats: int = 3,
+    retry_budget: int = 3,
+    max_tokens: int = 8,
+) -> dict:
+    """Overload scenario: arrival rate > fleet capacity.
+
+    Two fake engines, each with bounded admission (``--saturate-after-n
+    seats``), behind a shed-aware router. ``concurrency`` client threads
+    drive ``num_requests`` — well past the fleet's 2 x seats in-flight
+    capacity — so a slice of requests finds BOTH engines saturated and must
+    come back as a clean 429 + Retry-After (never a 5xx, never a hang).
+    Returns a summary dict; callers assert on it."""
+    import concurrent.futures as cf
+
+    fakes, urls = [], []
+    try:
+        for _ in range(2):
+            port = free_port()
+            fakes.append(start_proc(
+                ["-m", "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", "fake/model",
+                 # slow enough that requests overlap and saturation is real
+                 "--speed", "60",
+                 "--saturate-after-n", str(seats),
+                 "--retry-after", "1"]
+            ))
+            urls.append(f"http://127.0.0.1:{port}")
+        router_port = free_port()
+        router = start_proc([
+            "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["fake/model"] * len(urls)),
+            "--engine-stats-interval", "1",
+            "--retry-max-attempts", str(retry_budget),
+            "--retry-backoff-base", "0.01",
+            "--breaker-failure-threshold", "3",
+            "--breaker-cooldown", "300",
+        ])
+        fakes.append(router)
+        base = f"http://127.0.0.1:{router_port}"
+        for proc, url in zip(fakes[:-1], urls):
+            wait_healthy(f"{url}/health", proc, timeout=30)
+        wait_healthy(f"{base}/health", router, timeout=30)
+
+        statuses: collections.Counter = collections.Counter()
+        missing_retry_after = 0
+        hangs = 0
+        lock = threading.Lock()
+
+        def one(_i: int) -> None:
+            nonlocal missing_retry_after, hangs
+            try:
+                r = requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "x",
+                          "max_tokens": max_tokens},
+                    timeout=30,
+                )
+                with lock:
+                    statuses[r.status_code] += 1
+                    if r.status_code == 429 and "Retry-After" not in r.headers:
+                        missing_retry_after += 1
+            except requests.RequestException:
+                with lock:
+                    hangs += 1
+
+        with cf.ThreadPoolExecutor(max_workers=concurrency) as pool:
+            list(pool.map(one, range(num_requests)))
+
+        metrics = requests.get(f"{base}/metrics", timeout=10).text
+        circuit = {m.group(1): int(m.group(2))
+                   for m in CIRCUIT_RE.finditer(metrics)}
+
+        def _counter(name: str) -> float:
+            m = re.search(rf"^{re.escape(name)} ([0-9.]+)$", metrics, re.M)
+            return float(m.group(1)) if m else 0.0
+
+        peaks = {}
+        for url in urls:
+            text = requests.get(f"{url}/metrics", timeout=10).text
+            m = re.search(r"fake:running_peak\{[^}]*\} (\d+)", text)
+            # None (metric missing) must FAIL the bounded-depth check, not
+            # sail past it — a dropped metric is a broken invariant probe
+            peaks[url] = int(m.group(1)) if m else None
+        return {
+            "statuses": dict(statuses),
+            "non_429_errors": sum(
+                n for s, n in statuses.items() if s not in (200, 429)
+            ) + hangs,
+            "hangs": hangs,
+            "missing_retry_after": missing_retry_after,
+            "circuit_state": circuit,
+            "urls": urls,
+            "seats": seats,
+            "running_peak": peaks,
+            "sheds_total": _counter("vllm_router:sheds_total"),
+            "failovers_total": _counter("vllm_router:failovers_total"),
+        }
+    finally:
+        for p in fakes:
+            stop_proc(p)
+
+
 def main() -> int:
     p = argparse.ArgumentParser("chaos-check")
-    p.add_argument("--num-requests", type=int, default=200)
+    p.add_argument("--scenario", choices=["chaos", "overload"], default="chaos")
+    p.add_argument("--num-requests", type=int, default=None)
     p.add_argument("--retry-budget", type=int, default=3)
     p.add_argument("--ttft-deadline", type=float, default=1.0)
     p.add_argument("--breaker-threshold", type=int, default=3)
     args = p.parse_args()
+    from production_stack_tpu.router.resilience import OPEN
+
+    if args.scenario == "overload":
+        s = run_overload(
+            num_requests=args.num_requests or 48,
+            retry_budget=args.retry_budget,
+        )
+        print(json.dumps(s, indent=2))
+        failures = []
+        if s["non_429_errors"]:
+            failures.append(
+                f"{s['non_429_errors']} non-429 client errors/hangs"
+            )
+        if s["missing_retry_after"]:
+            failures.append(
+                f"{s['missing_retry_after']} 429s without Retry-After"
+            )
+        for url, peak in s["running_peak"].items():
+            if peak is None or peak > s["seats"]:
+                failures.append(
+                    f"queue depth unbounded on {url}: peak {peak} > "
+                    f"{s['seats']} seats"
+                )
+        for url in s["urls"]:
+            if s["circuit_state"].get(url) == OPEN:
+                failures.append(f"sheds tripped the breaker for {url}")
+        if failures:
+            print("OVERLOAD CHECK FAILED: " + "; ".join(failures))
+            return 1
+        print("OVERLOAD CHECK PASSED")
+        return 0
+
     s = run_chaos(
-        num_requests=args.num_requests,
+        num_requests=args.num_requests or 200,
         retry_budget=args.retry_budget,
         ttft_deadline=args.ttft_deadline,
         breaker_threshold=args.breaker_threshold,
@@ -153,8 +303,6 @@ def main() -> int:
             f"a request used {s['max_attempts_observed']} proxy attempts "
             f"(budget {s['retry_budget']})"
         )
-    from production_stack_tpu.router.resilience import OPEN
-
     for label in ("fail_url", "hang_url"):
         if s["circuit_state"].get(s[label]) != OPEN:
             failures.append(f"breaker for {label}={s[label]} is not open")

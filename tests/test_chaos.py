@@ -77,6 +77,32 @@ def test_chaos_run_zero_client_5xx():
     assert s["failovers_total"] > 0
 
 
+def test_overload_sheds_cleanly_with_bounded_queue_depth():
+    """Acceptance (overload survival): arrival rate > fleet capacity must
+    degrade to clean sheds, not errors — every client response is a 200 or
+    a 429 carrying Retry-After (zero 5xx, zero hangs), per-engine in-flight
+    depth never exceeds the admission bound, and the shedding engines'
+    breakers stay closed (sheds are capacity, not failure, so failover on
+    429 must not trip them)."""
+    s = chaos_check.run_overload(
+        num_requests=48, concurrency=12, seats=3, retry_budget=3,
+    )
+    assert s["non_429_errors"] == 0, s["statuses"]
+    assert s["hangs"] == 0, s
+    assert s["statuses"].get(200, 0) > 0, s["statuses"]
+    # the run actually overloaded the fleet: some requests were shed
+    assert s["sheds_total"] > 0, s
+    # every shed the client saw carried the retry contract
+    assert s["missing_retry_after"] == 0, s
+    # bounded queue depth: admission control held the in-flight line (a
+    # missing peak metric is a failure, not a pass)
+    for url, peak in s["running_peak"].items():
+        assert peak is not None and 0 <= peak <= s["seats"], (url, peak, s)
+    # sheds never feed the breaker
+    for url in s["urls"]:
+        assert s["circuit_state"].get(url, 0) != OPEN, s["circuit_state"]
+
+
 def test_inter_chunk_stall_aborts_engine_and_sends_sse_error():
     """Acceptance: a stream stalled past the inter-chunk timeout is aborted
     on the engine (scheduler slot freed, verified via /metrics running-count)

@@ -244,6 +244,103 @@ class TestFailover:
         assert routed[-1] == urls[1]
 
 
+class TestShedAwareRouting:
+    """Overload semantics (docs/failure-handling.md): a backend's 429 +
+    Retry-After is a SHED, not a failure — immediate failover, breaker
+    untouched, and the saturated backend receives no new non-sticky traffic
+    for the advertised window."""
+
+    def test_shedding_backend_fails_over_without_breaker_trip(self):
+        procs, urls = [], []
+        # backend 0 sheds EVERYTHING via --shed-rate 1.0 (429 on the data
+        # plane WITHOUT advertising vllm:engine_saturated — the
+        # between-scrapes case, so the shed-failover path itself is what
+        # routes around it) with a 2 s Retry-After; backend 1 is healthy
+        for extra in (["--shed-rate", "1.0", "--retry-after", "2"], []):
+            port = free_port()
+            procs.append(start_proc(
+                ["-m", "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", "fake/model",
+                 "--speed", "500"] + extra
+            ))
+            urls.append(f"http://127.0.0.1:{port}")
+        for proc, url in zip(procs, urls):
+            wait_healthy(f"{url}/health", proc, timeout=30)
+        router, base = _start_router(
+            urls, extra=["--retry-max-attempts", "3",
+                         "--retry-backoff-base", "0.01",
+                         "--breaker-failure-threshold", "2"]
+        )
+        try:
+            for _ in range(8):
+                r = requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "x",
+                          "max_tokens": 2},
+                    timeout=15,
+                )
+                assert r.status_code == 200, r.text
+            metrics = requests.get(f"{base}/metrics", timeout=5).text
+            # sheds were observed and counted...
+            m = re.search(r"^vllm_router:sheds_total ([0-9.]+)$", metrics,
+                          re.M)
+            assert m and float(m.group(1)) >= 1, metrics
+            # ...but the shedding backend's breaker is NOT open (sheds are
+            # capacity, not failure)
+            m = re.search(
+                rf'vllm_router:circuit_state\{{backend="{re.escape(urls[0])}"\}} (\d+)',
+                metrics,
+            )
+            if m:  # breaker row only renders once the backend saw traffic
+                assert int(m.group(1)) != 2, metrics
+            # the saturated backend shows in the router's shed window gauge
+            assert f'vllm_router:backend_saturated{{backend="{urls[0]}"}} 1' \
+                in metrics
+        finally:
+            log = stop_proc(router)
+            for p in procs:
+                stop_proc(p)
+        routed = _routed_endpoints(log)
+        assert len(routed) == 8
+        # roundrobin would have alternated 4/4; after the first shed marks
+        # the backend saturated for 2 s, all later requests route straight
+        # to the healthy one — at most the very first pick (plus one
+        # post-window probe) may land on the shedder
+        assert routed.count(urls[0]) <= 2, routed
+        assert "shed request" in log  # shed-failover log line
+
+    def test_all_backends_saturated_forwards_429_with_retry_after(self):
+        procs, urls = [], []
+        for _ in range(2):
+            port = free_port()
+            procs.append(start_proc(
+                ["-m", "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", "fake/model",
+                 "--speed", "500",
+                 "--shed-rate", "1.0", "--retry-after", "1"]
+            ))
+            urls.append(f"http://127.0.0.1:{port}")
+        for proc, url in zip(procs, urls):
+            wait_healthy(f"{url}/health", proc, timeout=30)
+        router, base = _start_router(
+            urls, extra=["--retry-max-attempts", "3",
+                         "--retry-backoff-base", "0.01"]
+        )
+        try:
+            r = requests.post(
+                f"{base}/v1/completions",
+                json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+                timeout=15,
+            )
+            assert r.status_code == 429, r.text
+            assert float(r.headers.get("Retry-After", "0")) >= 1
+            assert r.json()["error"]["type"] == "overloaded_error"
+        finally:
+            stop_proc(router)
+            for p in procs:
+                stop_proc(p)
+
+
 class TestExperimentalFeatures:
     def test_pii_block_and_semantic_cache(self):
         fakes, urls = _start_fakes(1)
