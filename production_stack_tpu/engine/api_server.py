@@ -293,6 +293,17 @@ class EngineServer:
         if self.engine.scheduler.has_work():
             logger.warning("drain timeout: %d request(s) still running",
                            self.engine.scheduler.num_running())
+        # warm-start manifest: spill the hot working set AFTER in-flight work
+        # finished (their pages are registered by now), so the next
+        # incarnation restores it instead of recomputing (warm restarts)
+        spill = getattr(self.engine, "warm_spill", None)
+        if spill is not None:
+            try:
+                n = await asyncio.get_running_loop().run_in_executor(None, spill)
+                if n:
+                    logger.info("drain: warm-start manifest spilled (%d pages)", n)
+            except Exception:  # noqa: BLE001 - shutdown keeps going
+                logger.exception("drain: warm-start spill failed")
 
     async def version(self, request: web.Request) -> web.Response:
         return web.json_response({"version": __version__})
@@ -369,8 +380,8 @@ class EngineServer:
              s["decode_chained_dispatches_total"])
         emit("runahead_prefill_dispatches_total", "counter",
              s.get("runahead_prefill_dispatches_total", 0))
-        for k in sorted(s):  # kv offload / transfer / spec / loop metrics
-            if k.startswith(("kv_", "spec_decode_", "engine_loop_")):
+        for k in sorted(s):  # kv offload / transfer / spec / warm-start / loop
+            if k.startswith(("kv_", "spec_decode_", "engine_loop_", "warm_start_")):
                 kind = "counter" if k.endswith("_total") else "gauge"
                 emit(k, kind, s[k])
         # TTFT hop breakdown for streaming requests (accept->submit->first

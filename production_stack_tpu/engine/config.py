@@ -160,6 +160,24 @@ class EngineConfig:
     kv_spill_watermark: float = 0.9
     kv_offload_dir: Optional[str] = None
     kv_offload_disk_gb: float = 16.0
+    # warm-start manifests (kvoffload/warmstart.py, docs/failure-handling.md
+    # "Restarts & rolling upgrades"): on SIGTERM drain and every
+    # warm_start_interval_s the engine spills its hottest chain-head pages +
+    # the prefix-index metadata to the offload tier under a generation-fenced
+    # per-engine namespace; on startup it restores them BEFORE reporting
+    # ready, so restarts serve warm prefixes instead of recomputing them.
+    # Requires at least one offload tier (cpu/disk/remote) to persist into —
+    # a DISK or REMOTE tier for state to survive process death.
+    warm_start: bool = False
+    # seconds between periodic manifest spills (a hard crash loses at most
+    # this much warm-state delta); <= 0 spills only on drain
+    warm_start_interval_s: float = 60.0
+    # manifest namespace in the offload tier; engines sharing a namespace
+    # fence each other by generation (rolling upgrades reuse the old pod's
+    # namespace). Default: kv_instance_id, else "<model>-<port>".
+    warm_start_namespace: Optional[str] = None
+    # manifest size cap in pages (highest-reuse-score chain heads first)
+    warm_start_max_pages: int = 256
     kv_remote_url: Optional[str] = None
     kv_serde: str = "naive"            # naive | int8 (kvoffload/serde.py)
     kv_controller_url: Optional[str] = None
@@ -190,19 +208,49 @@ class EngineConfig:
         return self.served_model_name or self.model
 
 
+# --help text for flags whose one-line meaning is not obvious from the name;
+# the dataclass comments stay the authoritative long-form docs
+_FLAG_HELP = {
+    "warm_start": (
+        "spill a warm-start manifest (hot chain-head KV pages + prefix-index "
+        "metadata) to the offload tier on drain and every "
+        "--warm-start-interval-s, and restore it on startup before reporting "
+        "ready — engine restarts keep their hot prefixes. Needs an offload "
+        "tier (--kv-offload-dir / --kv-remote-url for restart durability)"
+    ),
+    "warm_start_interval_s": (
+        "seconds between periodic warm-start manifest spills (bounds how "
+        "much warm state a hard crash loses); <= 0 spills only on SIGTERM "
+        "drain"
+    ),
+    "warm_start_namespace": (
+        "offload-tier namespace for this engine's warm-start manifests; "
+        "restarts/replacements reusing a namespace fence the previous "
+        "incarnation by generation (default: --kv-instance-id, else "
+        "<model>-<port>)"
+    ),
+    "warm_start_max_pages": (
+        "cap on pages a warm-start manifest covers (highest-reuse-score "
+        "chain heads kept first)"
+    ),
+}
+
+
 def add_engine_args(p: argparse.ArgumentParser) -> None:
     for f in dataclasses.fields(EngineConfig):
         flag = "--" + f.name.replace("_", "-")
         ftype = str(f.type)
+        help_ = _FLAG_HELP.get(f.name)
         if ftype == "bool" or isinstance(f.default, bool):
-            p.add_argument(flag, action=argparse.BooleanOptionalAction, default=f.default)
+            p.add_argument(flag, action=argparse.BooleanOptionalAction,
+                           default=f.default, help=help_)
         else:
             typ = str
             if "int" in ftype or isinstance(f.default, int):
                 typ = int
             elif "float" in ftype or isinstance(f.default, float):
                 typ = float
-            p.add_argument(flag, type=typ, default=f.default)
+            p.add_argument(flag, type=typ, default=f.default, help=help_)
 
 
 def config_from_args(args: argparse.Namespace) -> EngineConfig:

@@ -169,6 +169,41 @@ class LLMEngine:
             max_io_pages=self._max_io_pages,
             spill_watermark=cfg.kv_spill_watermark,
         )
+        # warm-start manifests (kvoffload/warmstart.py): restore the previous
+        # incarnation's hot working set into the pool BEFORE the API server
+        # exists, so the first post-restart requests hit warm prefixes. The
+        # restore runs here on the construction thread — the engine loop has
+        # not started, so the batched set_pages uploads race nothing.
+        self.warm = None
+        if cfg.warm_start:
+            if self._offload is None:
+                logger.warning(
+                    "--warm-start needs an offload tier that survives "
+                    "restarts (--kv-offload-dir or --kv-remote-url); disabled"
+                )
+            elif cfg.distributed_num_processes > 1:
+                # the restore dispatches device programs during __init__,
+                # before serve() wraps the runner in the multi-host
+                # broadcaster — followers would never see them and desync
+                logger.warning(
+                    "--warm-start is single-host only for now; disabled"
+                )
+            else:
+                from production_stack_tpu.kvoffload.warmstart import (
+                    WarmStartManager,
+                )
+
+                self.warm = WarmStartManager(
+                    self.kv, self._offload,
+                    namespace=(
+                        cfg.warm_start_namespace or cfg.kv_instance_id
+                        or f"{cfg.name}-{cfg.port}"
+                    ),
+                    interval_s=cfg.warm_start_interval_s,
+                    max_pages=cfg.warm_start_max_pages,
+                    model=cfg.name,
+                )
+                self.warm.restore()
         # disaggregated prefill (SURVEY.md §2.3): producer pushes finished
         # prefill KV to the decode peer; consumer receives into its store
         self._kv_sender = None
@@ -640,6 +675,10 @@ class LLMEngine:
             t_sec = time.perf_counter()
             self._drain_inbox(block=not self.scheduler.has_work())
             self._shed_expired()  # queue-deadline load shedding
+            if self.warm is not None:
+                # periodic warm-start manifest (crash protection): prefers
+                # idle loop iterations, forced past 2x the interval
+                self.warm.maybe_spill(busy=self.scheduler.has_work())
             # adaptive chain depth inputs: the scheduler caps chained bursts
             # so the expected number of arrivals stuck waiting behind a chain
             # stays below ~half a request (scheduler.schedule)
@@ -1346,6 +1385,23 @@ class LLMEngine:
                 )
         return out
 
+    def warm_spill(self) -> int:
+        """Final warm-start manifest spill (SIGTERM drain path — the API
+        server calls this after in-flight requests finish, before teardown).
+        Runs on the device thread so the page fetches serialize with any
+        still-running steps. No-op without --warm-start."""
+        if self.warm is None:
+            return 0
+        try:
+            return int(
+                self._run_on_device_thread(
+                    lambda: self.warm.spill("drain"), what="warm-start spill"
+                ) or 0
+            )
+        except Exception:  # noqa: BLE001 - shutdown must not hang on a spill
+            logger.exception("warm-start drain spill failed")
+            return 0
+
     def sleep(self, level: int = 1) -> None:
         """Free HBM without killing the process. Level 1 drops the KV pools;
         level 2 additionally moves weights to host DRAM (SURVEY.md §7 hard
@@ -1478,6 +1534,13 @@ class LLMEngine:
             out["kv_offload_loaded_pages_total"] = o["loaded_pages"]
             out["kv_offload_cpu_bytes"] = o["cpu_bytes"]
             out["kv_offload_disk_bytes"] = o["disk_bytes"]
+            # offload-tier integrity: blobs that failed their checksum on
+            # read and were quarantined (never served) — local tiers plus,
+            # on a disagg consumer, pushes rejected at the receiver
+            corrupt = o.get("corrupt_pages", 0)
+            if self._kv_receiver is not None:
+                corrupt += getattr(self._kv_receiver, "corrupt_chunks", 0)
+            out["kv_corrupt_pages_total"] = corrupt
             # permanent KV loss at the bottom local tier (satellite: was a
             # silent drop) — nonzero means blobs left the hierarchy entirely
             out["kv_offload_dropped_evictions_total"] = o.get(
@@ -1490,4 +1553,6 @@ class LLMEngine:
                 out["kv_offload_link_bandwidth_bytes_per_sec"] = round(
                     self.kv_link_bandwidth_bytes_per_s
                 )
+        if self.warm is not None:
+            out.update(self.warm.stats())
         return out

@@ -296,11 +296,24 @@ class KVPageManager:
         self._spill_dirty = len(unspilled) > len(batch)
         if not batch:
             return 0
-        self.offload.save_pages(batch)
-        for pid, _ in batch:
-            self.pages[pid].offloaded = True
-        self.proactive_spilled_pages_total += len(batch)
-        return len(batch)
+        # flip to the zero-I/O eviction path only for CONFIRMED saves — a
+        # mid-batch tier failure marking unsaved pages would silently lose
+        # their KV at eviction time (the blob the skip relies on never made
+        # it into the tier)
+        saved = self.offload.save_pages(batch)
+        n = 0
+        for pid, h in batch:
+            if saved is None or h in saved:  # None: legacy offload stubs
+                self.pages[pid].offloaded = True
+                n += 1
+        if n < len(batch):
+            # unconfirmed saves stay on the dirty list: the flag was computed
+            # from the PLANNED batch, and leaving it False would park those
+            # pages until some unrelated free() — re-arming retries them next
+            # call (the tier may have recovered)
+            self._spill_dirty = True
+        self.proactive_spilled_pages_total += n
+        return n
 
     # -- prefix cache -------------------------------------------------------
 
@@ -436,6 +449,82 @@ class KVPageManager:
         if ri < n_restore:
             self.free(restore_pids[ri:])  # unhashed -> back to the free list
         return shared
+
+    # -- warm start (kvoffload/warmstart.py) --------------------------------
+
+    def warm_candidates(
+        self, max_pages: int
+    ) -> "list[tuple[int, bytes, int, float]]":
+        """The pages a warm-start manifest should cover: every hashed page
+        (cached-evictable AND still-referenced — a full page's contents are
+        immutable once hashed), ordered by reuse score DESC then chain depth
+        ASC and capped at ``max_pages``. The depth tiebreak mirrors the
+        capped-spill rule: a chain can only restore from its head, so under
+        a cap the heads are what must survive. Returns
+        ``(pid, hash, depth, hits)`` tuples — ``hits`` is the recency-DECAYED
+        hit count WITHOUT the head bonus, because warm_restore feeds it back
+        into ``PageInfo.hits`` and ``_evict_score`` re-adds the depth bonus;
+        storing the full score would double-count it and skew post-restart
+        eviction toward fresher, genuinely-hot pages."""
+        now = time.monotonic()
+
+        def decayed_hits(info: PageInfo) -> float:
+            age = max(0.0, now - info.last_used)
+            return info.hits * 0.5 ** (age / self.HIT_DECAY_S)
+
+        # top-k selection, not a full sort: this runs on the engine device
+        # thread every warm_start_interval_s (same reasoning as
+        # proactive_spill's nsmallest) — O(H log cap) over hashed pages
+        cands = heapq.nsmallest(
+            max(0, max_pages),
+            (
+                (-self._evict_score(self.pages[pid]), self.pages[pid].depth, pid, h)
+                for h, pid in self.hash_to_page.items()
+            ),
+        )
+        return [
+            (pid, h, d, decayed_hits(self.pages[pid])) for _, d, pid, h in cands
+        ]
+
+    def warm_restore(self, entries, loader) -> int:
+        """Rebuild prefix-cache state from a warm-start manifest: allocate
+        slots, pull the blobs through ``loader`` (connector.load_pages_sparse
+        — per-entry best-effort, batched device upload), and register each
+        restored page under its chunk hash with its manifest depth and reuse
+        score. Restored pages enter the pool EVICTABLE (nothing references
+        them yet), so a cold boot under immediate load degrades exactly like
+        a warm cache would. Returns the number of pages restored."""
+        todo = [
+            (h, d, s) for h, d, s in entries if h not in self.hash_to_page
+        ]
+        # at boot the pool is empty; cap defensively anyway so a manifest
+        # larger than the pool cannot force evictions of fresher state
+        todo = todo[: self.num_free()]
+        if not todo:
+            return 0
+        pids = self.allocate(len(todo))
+        if pids is None:  # cannot happen given the cap; stay safe
+            return 0
+        ok = loader([(pid, h) for pid, (h, _, _) in zip(pids, todo)])
+        now = time.monotonic()
+        restored = 0
+        for pid, (h, depth, score), good in zip(pids, todo, ok):
+            if not good:
+                continue  # free() below returns the unhashed slot to the pool
+            info = self.pages[pid]
+            info.hash = h
+            info.depth = depth
+            # the manifest's decayed hit count seeds hits so restored
+            # prefixes keep their relative eviction protection (the depth
+            # bonus is re-added by _evict_score, not stored)
+            info.hits = score
+            info.last_used = now
+            info.offloaded = True  # the blob is (still) in the tier
+            self.hash_to_page[h] = pid
+            restored += 1
+        # hashed pages land in the evictable pool; failed ones free outright
+        self.free(pids)
+        return restored
 
     def register_filled(
         self, tokens: Sequence[int], page_ids: Sequence[int], salt: bytes = b""

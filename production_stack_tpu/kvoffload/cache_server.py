@@ -18,6 +18,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from production_stack_tpu.kvoffload.protocol import read_frame, write_frame
+from production_stack_tpu.kvoffload.serde import KVIntegrityError, verify_blob
 from production_stack_tpu.utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -31,6 +32,9 @@ class CacheServer:
         self.gets = 0
         self.hits = 0
         self.puts = 0
+        # entries that failed their integrity check on read and were dropped
+        # (a shared server must never fan corruption out to the whole fleet)
+        self.corrupt = 0
 
     # -- storage --------------------------------------------------------------
 
@@ -48,9 +52,21 @@ class CacheServer:
     def get(self, key: str) -> Optional[bytes]:
         self.gets += 1
         blob = self._data.get(key)
-        if blob is not None:
-            self.hits += 1
-            self._data.move_to_end(key)
+        if blob is None:
+            return None
+        try:
+            verify_blob(blob)
+        except KVIntegrityError as e:
+            # quarantine: a corrupt entry on a SHARED server would otherwise
+            # be re-fetched by every engine in the fleet; drop it and report
+            # a miss so the caller falls back to another tier or recompute
+            self.corrupt += 1
+            self._data.pop(key, None)
+            self.used_bytes -= len(blob)
+            logger.warning("cache server: quarantined corrupt blob %s: %s", key, e)
+            return None
+        self.hits += 1
+        self._data.move_to_end(key)
         return blob
 
     def stats(self) -> dict:
@@ -61,6 +77,7 @@ class CacheServer:
             "gets": self.gets,
             "hits": self.hits,
             "puts": self.puts,
+            "corrupt": self.corrupt,
         }
 
     # -- protocol -------------------------------------------------------------

@@ -164,25 +164,32 @@ class KVOffloadConnector:
             logger.exception("kv offload save_page failed; dropping page %s", h.hex())
             self.report_evict([h])
 
-    def save_pages(self, pairs: "list[tuple[int, bytes]]") -> None:
+    def save_pages(self, pairs: "list[tuple[int, bytes]]") -> "set[bytes]":
         """Offload a batch of HBM pages before their slots are reused —
         ONE device fetch per <=64 pages instead of one per page (each fetch
         is a full host<->device round trip on network-attached chips; an
         eviction storm spilling a long history page-by-page would stall the
         engine loop for seconds). Never raises (same engine-loop safety as
-        save_page)."""
+        save_page). Returns the hashes whose blobs are KNOWN to be in the
+        store afterwards (already local + stored this call) — a caller that
+        flips pages to the zero-I/O eviction path (``offloaded``) must only
+        do so for these, or a mid-batch tier failure turns into silent KV
+        loss."""
+        ok: "set[bytes]" = set()
         todo = pairs
         stored = 0  # prefix of `todo` safely in the store
         try:
             if not self.store.enabled():
                 self.report_evict([h for _, h in pairs])
-                return
+                return ok
             # pages already offloaded (contains_local) stay OUT of the evict
             # set on failure — their blobs still exist
-            todo = [
-                (pid, h) for pid, h in pairs
-                if not self.store.contains_local(h.hex())
-            ]
+            todo = []
+            for pid, h in pairs:
+                if self.store.contains_local(h.hex()):
+                    ok.add(h)
+                else:
+                    todo.append((pid, h))
             for i in range(0, len(todo), 64):
                 chunk = todo[i : i + 64]
                 ks, vs = self.runner.get_pages([pid for pid, _ in chunk])
@@ -191,12 +198,14 @@ class KVOffloadConnector:
                     self.store.put(h.hex(), blob)
                     self.saved_pages += 1
                     stored += 1
+                    ok.add(h)
         except Exception:
             # evict ONLY what was neither already local nor stored before
             # the failure; reporting stored pages evicted would poison the
             # global KV index for chunks this instance actually holds
             logger.exception("kv offload save_pages failed; dropping rest")
             self.report_evict([h for _, h in todo[stored:]])
+        return ok
 
     def load_pages(self, pairs: "list[tuple[int, bytes]]") -> int:
         """Restore a batch of pages into HBM — one upload + one scatter
@@ -242,7 +251,7 @@ class KVOffloadConnector:
                 blob = self.store.get(h.hex())
                 if blob is None:
                     break
-                k, v = serde_mod.deserialize(blob)
+                k, v = serde_mod.deserialize(blob, verify=False)
                 batch_ids.append(pid)
                 batch_k.append(k)
                 batch_v.append(v)
@@ -253,6 +262,52 @@ class KVOffloadConnector:
                 break
         flush()
         return done
+
+    def load_pages_sparse(self, pairs: "list[tuple[int, bytes]]") -> "list[bool]":
+        """Best-effort batched restore: like :meth:`load_pages` but a
+        missing/corrupt blob skips THAT page instead of truncating the rest.
+        Used by warm-start restore, where entries are independent hash->page
+        mappings rather than one prefix chain (a chain's later pages are
+        useless without its head; a warm-start manifest's are not). Returns
+        per-page success flags aligned with ``pairs``. Never raises."""
+        ok = [False] * len(pairs)
+        batch_idx: list[int] = []
+        batch_ids: list[int] = []
+        batch_k: list = []
+        batch_v: list = []
+
+        def flush() -> None:
+            if not batch_ids:
+                return
+            try:
+                self.runner.set_pages(batch_ids, batch_k, batch_v)
+            except Exception:
+                logger.exception("kv warm restore batch failed")
+            else:
+                for i in batch_idx:
+                    ok[i] = True
+                self.loaded_pages += len(batch_ids)
+            batch_idx.clear()
+            batch_ids.clear()
+            batch_k.clear()
+            batch_v.clear()
+
+        for i, (pid, h) in enumerate(pairs):
+            try:
+                blob = self.store.get(h.hex())  # verifies + quarantines
+                if blob is None:
+                    continue
+                k, v = serde_mod.deserialize(blob)
+                batch_idx.append(i)
+                batch_ids.append(pid)
+                batch_k.append(k)
+                batch_v.append(v)
+                if len(batch_ids) >= 64:
+                    flush()
+            except Exception:
+                logger.exception("kv warm restore failed for %s", h.hex())
+        flush()
+        return ok
 
     def has(self, h: bytes) -> bool:
         try:
@@ -286,7 +341,7 @@ class KVOffloadConnector:
             blob = self.store.get(h.hex())
             if blob is None:
                 return False
-            k, v = serde_mod.deserialize(blob)
+            k, v = serde_mod.deserialize(blob, verify=False)
             self.runner.set_page(pid, k, v)
             self.loaded_pages += 1
             return True

@@ -9,13 +9,22 @@ helm/templates/deployment-vllm-multi.yaml:309-314):
 - ``int8``: per-(layer, head) symmetric int8 quantization (CacheGen-style
   compression, lossy but ~2x smaller than bf16) for DCN/disk tiers.
 
-Blob layout: ``u32 header_len | header JSON | k bytes | v bytes``.
+Blob layout: ``u32 header_len | header JSON | body``.
+
+Integrity (format v2): the header additionally records ``v`` (format
+version), ``blen`` (body length) and ``crc`` (CRC32 of the body). Readers
+call :func:`verify_blob` before trusting a blob pulled from any tier — a
+bit-flipped or truncated page must convert to a cache MISS (recompute), never
+to silently-wrong KV. v1 blobs (no ``crc``) still parse, so a disk tier
+surviving an upgrade keeps serving; a blob from a FUTURE format version is
+rejected as unreadable rather than misparsed.
 """
 
 from __future__ import annotations
 
 import json
 import struct
+import zlib
 
 import numpy as np
 
@@ -27,6 +36,66 @@ except ImportError:  # pragma: no cover
     BF16 = np.dtype(np.float32)
 
 _HDR = struct.Struct("!I")
+
+# blob format version written by this build; readers accept <= this
+SERDE_FORMAT_VERSION = 2
+
+
+class KVIntegrityError(ValueError):
+    """A blob failed its checksum / length / version check. The caller must
+    treat the entry as a miss (quarantine + recompute), never deserialize."""
+
+
+def _seal(hdr: dict, body: bytes) -> bytes:
+    """Finish a blob: stamp version + body length + CRC32 into the header."""
+    hdr["v"] = SERDE_FORMAT_VERSION
+    hdr["blen"] = len(body)
+    hdr["crc"] = zlib.crc32(body) & 0xFFFFFFFF
+    enc = json.dumps(hdr).encode()
+    return _HDR.pack(len(enc)) + enc + body
+
+
+def verify_blob(blob: bytes) -> dict:
+    """Integrity-check a blob without deserializing its payload; returns the
+    parsed header. Raises :class:`KVIntegrityError` on a malformed frame, a
+    future format version, a truncated body, or a CRC mismatch. v1 blobs
+    (no ``crc`` field) pass — they predate checksums."""
+    try:
+        (n,) = _HDR.unpack_from(blob)
+        hdr = json.loads(bytes(blob[_HDR.size : _HDR.size + n]))
+        if not isinstance(hdr, dict):
+            raise ValueError("header is not an object")
+    except (struct.error, ValueError, UnicodeDecodeError) as e:
+        raise KVIntegrityError(f"unreadable blob header: {e}") from None
+    version = int(hdr.get("v", 1))
+    if version > SERDE_FORMAT_VERSION:
+        raise KVIntegrityError(
+            f"blob format v{version} is newer than supported "
+            f"v{SERDE_FORMAT_VERSION}"
+        )
+    body = memoryview(blob)[_HDR.size + n :]
+    if "blen" in hdr and len(body) != int(hdr["blen"]):
+        raise KVIntegrityError(
+            f"truncated blob: body {len(body)} bytes, header says {hdr['blen']}"
+        )
+    if "crc" in hdr and (zlib.crc32(body) & 0xFFFFFFFF) != int(hdr["crc"]):
+        raise KVIntegrityError("blob CRC mismatch (corrupt payload)")
+    return hdr
+
+
+def seal_bytes(payload: bytes, kind: str = "raw", **attrs) -> bytes:
+    """Wrap arbitrary bytes in the same verifiable envelope KV pages use —
+    non-page tier entries (warm-start manifests, head pointers) get the same
+    corruption detection as page blobs."""
+    return _seal({"kind": kind, **attrs}, payload)
+
+
+def unseal_bytes(blob: bytes) -> tuple[dict, bytes]:
+    """Verify and open a :func:`seal_bytes` envelope; returns (header, body).
+    Raises :class:`KVIntegrityError` on corruption."""
+    hdr = verify_blob(blob)
+    (n,) = _HDR.unpack_from(blob)
+    return hdr, bytes(memoryview(blob)[_HDR.size + n :])
 
 
 def _dtype_name(dt: np.dtype) -> str:
@@ -43,14 +112,12 @@ class NaiveSerde:
     name = "naive"
 
     def serialize(self, k: np.ndarray, v: np.ndarray) -> bytes:
-        hdr = json.dumps(
-            {
-                "serde": self.name,
-                "shape": list(k.shape),
-                "dtype": _dtype_name(k.dtype),
-            }
-        ).encode()
-        return _HDR.pack(len(hdr)) + hdr + k.tobytes() + v.tobytes()
+        hdr = {
+            "serde": self.name,
+            "shape": list(k.shape),
+            "dtype": _dtype_name(k.dtype),
+        }
+        return _seal(hdr, k.tobytes() + v.tobytes())
 
     @staticmethod
     def _split(blob: bytes) -> tuple[dict, memoryview]:
@@ -86,20 +153,13 @@ class Int8Serde(NaiveSerde):
     def serialize(self, k: np.ndarray, v: np.ndarray) -> bytes:
         qk, sk = self._quant(k)
         qv, sv = self._quant(v)
-        hdr = json.dumps(
-            {
-                "serde": self.name,
-                "shape": list(k.shape),
-                "dtype": _dtype_name(k.dtype),
-            }
-        ).encode()
-        return (
-            _HDR.pack(len(hdr))
-            + hdr
-            + sk.tobytes()
-            + qk.tobytes()
-            + sv.tobytes()
-            + qv.tobytes()
+        hdr = {
+            "serde": self.name,
+            "shape": list(k.shape),
+            "dtype": _dtype_name(k.dtype),
+        }
+        return _seal(
+            hdr, sk.tobytes() + qk.tobytes() + sv.tobytes() + qv.tobytes()
         )
 
     def deserialize(self, blob: bytes) -> tuple[np.ndarray, np.ndarray]:
@@ -130,9 +190,16 @@ def get_serde(name: str):
         raise ValueError(f"unknown serde {name!r}; options: {sorted(SERDES)}")
 
 
-def deserialize(blob: bytes) -> tuple[np.ndarray, np.ndarray]:
+def deserialize(blob: bytes, verify: bool = True) -> tuple[np.ndarray, np.ndarray]:
     """Deserialize by the serde name recorded in the blob header — blobs from
     engines with a different configured serde (shared cache server, or a disk
-    tier surviving a serde change) parse correctly."""
-    hdr, _ = NaiveSerde._split(blob)
+    tier surviving a serde change) parse correctly. Verifies the checksum
+    first — a corrupt blob raises :class:`KVIntegrityError` instead of
+    producing silently-wrong KV; pass ``verify=False`` only when the blob
+    just came from a read path that already verified it (TieredKVStore.get),
+    to avoid paying the CRC twice on the hot restore path."""
+    if verify:
+        hdr = verify_blob(blob)
+    else:
+        hdr, _ = NaiveSerde._split(blob)
     return get_serde(hdr.get("serde", "naive")).deserialize(blob)

@@ -12,6 +12,13 @@ instances can share); DRAM eviction spills to disk; disk eviction drops the
 blob locally. ``get`` walks DRAM -> disk -> remote and promotes hits to DRAM.
 Evictions that remove the *last local* copy surface through ``on_local_drop``
 so the engine can tell the KV-index controller.
+
+Integrity: every ``get`` verifies the blob's checksum/version header
+(kvoffload/serde.py v2 format) before returning it. A corrupt or
+future-version blob is QUARANTINED — deleted from the tier that served it,
+counted in ``corrupt_pages`` (exported as vllm:kv_corrupt_pages_total) — and
+the walk continues to the next tier, so a bit-flip on disk falls back to the
+remote copy and, failing that, to recompute. A bad page is never served.
 """
 
 from __future__ import annotations
@@ -22,6 +29,7 @@ from collections import OrderedDict
 from typing import Callable, Optional
 
 from production_stack_tpu.kvoffload.protocol import BlockingClient, parse_hostport
+from production_stack_tpu.kvoffload.serde import KVIntegrityError, verify_blob
 from production_stack_tpu.utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -99,6 +107,18 @@ class DiskTier:
         self._index.move_to_end(key)
         return blob
 
+    def get_fresh(self, key: str) -> Optional[bytes]:
+        """Read the file directly, bypassing this process's in-memory index:
+        a concurrent incarnation sharing the directory (rolling upgrade on
+        one host) may have written the key after our index was built. Does
+        not touch index/LRU state — mutable-key reads must stay side-effect
+        free."""
+        try:
+            with open(self._file(key), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
     def put(self, key: str, blob: bytes) -> list[str]:
         """Write; returns keys evicted (dropped entirely)."""
         if len(blob) > self.max_bytes:
@@ -174,6 +194,15 @@ class RemoteTier:
             self.errors += 1
             return False
 
+    def delete(self, key: str) -> None:
+        """Quarantine support: drop a corrupt entry server-side so other
+        engines sharing the cache server stop fetching it too."""
+        try:
+            self._request({"op": "delete", "key": key})
+        except Exception as e:
+            self.errors += 1
+            logger.warning("remote kv delete failed: %s", e)
+
     def close(self) -> None:
         self._client.close()
 
@@ -205,6 +234,10 @@ class TieredKVStore:
         # tier this is permanent KV loss — it used to happen silently;
         # exported as kv_offload_dropped_evictions_total on /metrics
         self.dropped_evictions = 0
+        # blobs that failed their checksum/version check on read and were
+        # quarantined (vllm:kv_corrupt_pages_total); nonzero means a tier is
+        # flipping bits or a rolling upgrade crossed an incompatible format
+        self.corrupt_pages = 0
 
     def enabled(self) -> bool:
         # NB: explicit None checks — the tiers define __len__, so an *empty*
@@ -212,6 +245,11 @@ class TieredKVStore:
         return (
             self.cpu is not None or self.disk is not None or self.remote is not None
         )
+
+    def durable(self) -> bool:
+        """True if some tier survives process death (disk or remote) — the
+        prerequisite for warm-start state to mean anything across restarts."""
+        return self.disk is not None or self.remote is not None
 
     def _spill(self, evicted: list[tuple[str, bytes]]) -> None:
         for k, b in evicted:
@@ -241,23 +279,67 @@ class TieredKVStore:
         if self.remote is not None:
             self.remote.put(key, blob)
 
+    def _verified(self, key: str, blob: bytes, tier_name: str, tier) -> bool:
+        """True if ``blob`` passes its integrity check; on failure the entry
+        is quarantined (deleted from the tier that served it) and counted so
+        the get-walk falls through to the next tier / recompute."""
+        try:
+            verify_blob(blob)
+            return True
+        except KVIntegrityError as e:
+            self.corrupt_pages += 1
+            logger.warning(
+                "quarantining corrupt kv blob %s from %s tier: %s",
+                key, tier_name, e,
+            )
+            try:
+                tier.delete(key)
+            except Exception:  # noqa: BLE001 - quarantine is best-effort
+                pass
+            return False
+
+    def get_authoritative(self, key: str) -> Optional[bytes]:
+        """Read a MUTABLE key (warm-start head pointer), preferring SHARED
+        sources over this process's private caches: remote first, then the
+        disk FILE (bypassing this process's in-memory index — another
+        incarnation sharing the directory may have written it after our
+        index was built), DRAM last. The ordinary ``get`` walk is designed
+        for immutable content-addressed blobs, where a local copy is as good
+        as any; for a mutable key it would return our own stale copy and,
+        e.g., blind an old engine incarnation to the newer generation that
+        fenced it."""
+        if self.remote is not None:
+            blob = self.remote.get(key)
+            if blob is not None and self._verified(key, blob, "remote", self.remote):
+                return blob
+        with self._lock:
+            if self.disk is not None:
+                blob = self.disk.get_fresh(key)
+                if blob is not None and self._verified(key, blob, "disk", self.disk):
+                    return blob
+            if self.cpu is not None:
+                blob = self.cpu.get(key)
+                if blob is not None and self._verified(key, blob, "cpu", self.cpu):
+                    return blob
+        return None
+
     def get(self, key: str) -> Optional[bytes]:
         with self._lock:
             if self.cpu is not None:
                 blob = self.cpu.get(key)
-                if blob is not None:
+                if blob is not None and self._verified(key, blob, "cpu", self.cpu):
                     self.hits["cpu"] += 1
                     return blob
             if self.disk is not None:
                 blob = self.disk.get(key)
-                if blob is not None:
+                if blob is not None and self._verified(key, blob, "disk", self.disk):
                     self.hits["disk"] += 1
                     if self.cpu is not None:  # promote
                         self._spill(self.cpu.put(key, blob))
                     return blob
         if self.remote is not None:
             blob = self.remote.get(key)
-            if blob is not None:
+            if blob is not None and self._verified(key, blob, "remote", self.remote):
                 self.hits["remote"] += 1
                 with self._lock:
                     if self.cpu is not None:
@@ -265,6 +347,28 @@ class TieredKVStore:
                 return blob
         self.misses += 1
         return None
+
+    def persist(self, key: str, force: bool = False) -> bool:
+        """Ensure a DRAM-tier blob also has a process-death-durable local
+        copy: copy it to the disk tier if one exists (remote copies are
+        already written through by ``put``). Warm-start state must outlive
+        the process — a cpu+disk hierarchy otherwise holds the newest (last
+        to evict) blobs only in DRAM. ``force`` re-copies even when the key
+        is already on disk: content-addressed page blobs are immutable (skip
+        is safe and cheap), but MUTABLE keys (the warm-start head pointer)
+        would otherwise keep a stale durable copy forever. Returns True if a
+        durable local copy exists afterwards."""
+        with self._lock:
+            if self.disk is None:
+                return False
+            if not force and key in self.disk:
+                return True
+            blob = self.cpu.get(key) if self.cpu is not None else None
+            if blob is None:
+                return key in self.disk
+            for dropped in self.disk.put(key, blob):
+                self._dropped_locally(dropped)
+            return key in self.disk
 
     def contains_local(self, key: str) -> bool:
         with self._lock:
@@ -288,5 +392,6 @@ class TieredKVStore:
                 "hits": dict(self.hits),
                 "misses": self.misses,
                 "dropped_evictions": self.dropped_evictions,
+                "corrupt_pages": self.corrupt_pages,
                 "remote_errors": self.remote.errors if self.remote else 0,
             }

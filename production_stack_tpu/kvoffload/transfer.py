@@ -29,6 +29,7 @@ from production_stack_tpu.kvoffload.protocol import (
     read_frame,
     write_frame,
 )
+from production_stack_tpu.kvoffload.serde import KVIntegrityError, verify_blob
 from production_stack_tpu.utils.logging import init_logger
 
 logger = init_logger(__name__)
@@ -63,6 +64,9 @@ class KVTransferReceiver:
         self.received_chunks = 0
         self.received_bytes = 0
         self.device_pages = 0
+        # pushes rejected by the integrity check (bit-flipped in flight or a
+        # producer on an incompatible serde format) — never enter the store
+        self.corrupt_chunks = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -78,6 +82,20 @@ class KVTransferReceiver:
                     return
                 op = hdr.get("op")
                 if op == "push":
+                    try:
+                        verify_blob(payload)
+                    except KVIntegrityError as e:
+                        # refuse the page: a corrupt blob admitted here would
+                        # later scatter wrong KV into the decode pools. The
+                        # producer keeps its copy; admission falls back to
+                        # the TCP-retry / recompute path.
+                        self.corrupt_chunks += 1
+                        logger.warning(
+                            "rejecting corrupt kv push %s from %s: %s",
+                            hdr.get("key"), peer, e,
+                        )
+                        await write_frame(writer, {"ok": False, "error": "integrity"})
+                        continue
                     self.store.put_local(hdr["key"], payload)
                     self.received_chunks += 1
                     self.received_bytes += len(payload)

@@ -79,6 +79,13 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         self.scrape_interval = scrape_interval
         self.engine_stats: dict[str, EngineStats] = {}
         self.last_success: dict[str, float] = {}  # url -> monotonic ts
+        # restart epochs: bumped when a backend's counters regress (the
+        # process restarted) or when a dropped-for-staleness backend scrapes
+        # again — a reborn pod's first successful scrape starts a NEW epoch,
+        # so routing never blends pre-restart state into it (no lingering
+        # saturation window, no stale-snapshot quarantine on the newborn)
+        self.epochs: dict[str, int] = {}
+        self._dropped_stale: set[str] = set()
         self._task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
@@ -118,6 +125,33 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         scrape intervals without a success the entry is DROPPED, so
         load-aware routing stops trusting a dead pod's old queue depth."""
         fresh = {url: st for url, st in zip(urls, results) if st is not None}
+        for url, st in fresh.items():
+            prev = self.engine_stats.get(url)
+            # restart detection: Prometheus counters only move forward within
+            # one process lifetime, so a regression means the engine was
+            # reborn. Also: a backend that was dropped for staleness and now
+            # scrapes again came back from the dead (restart or partition).
+            reborn = (
+                prev is not None
+                and st.gpu_prefix_cache_queries_total
+                < prev.gpu_prefix_cache_queries_total
+            ) or (url in self._dropped_stale)
+            if reborn:
+                self.epochs[url] = self.epochs.get(url, 0) + 1
+                self._dropped_stale.discard(url)
+                logger.info(
+                    "engine %s restarted (stats epoch %d): clearing its "
+                    "pre-restart saturation window", url, self.epochs[url],
+                )
+                # a Retry-After window from the previous incarnation must
+                # not keep routing away from an engine with an empty queue;
+                # the breaker is deliberately NOT reset — the reborn backend
+                # re-enters traffic through the normal half-open probe
+                from production_stack_tpu.router.resilience import (
+                    get_saturation_registry,
+                )
+
+                get_saturation_registry().forget(url)
         self.engine_stats.update(fresh)
         for url in fresh:
             self.last_success[url] = now
@@ -125,6 +159,17 @@ class EngineStatsScraper(metaclass=SingletonMeta):
             if url not in urls:
                 del self.engine_stats[url]
                 self.last_success.pop(url, None)
+        # sweep per-backend bookkeeping for urls gone from the CONFIG —
+        # including ones already stale-dropped from engine_stats (an
+        # autoscaled fleet churning per-pod urls would otherwise leak these
+        # forever, and a reused address would inherit a bogus 'reborn' epoch)
+        current = set(urls)
+        for url in list(self._dropped_stale):
+            if url not in current:
+                self._dropped_stale.discard(url)
+        for url in list(self.epochs):
+            if url not in current:
+                del self.epochs[url]
         cutoff = now - self.STALE_INTERVALS * self.scrape_interval
         for url in list(self.engine_stats):
             if self.last_success.get(url, now) < cutoff:
@@ -133,6 +178,7 @@ class EngineStatsScraper(metaclass=SingletonMeta):
                     "scrape in %.0fs)", url, now - self.last_success[url],
                 )
                 del self.engine_stats[url]
+                self._dropped_stale.add(url)
 
     async def _scrape_one(self, url: str) -> Optional[EngineStats]:
         from production_stack_tpu.router.request_service import get_client_session

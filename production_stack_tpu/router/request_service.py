@@ -324,6 +324,16 @@ async def process_request(
                         backend_url, request_id, attempt, policy.max_attempts,
                         e.reason,
                     )
+                    # replay dedupe: the failed attempt may still be EXECUTING
+                    # on its engine (a snapped TCP connection with no bytes in
+                    # flight goes unnoticed by a non-streaming generation, and
+                    # the engine would run it to completion while the replay
+                    # runs elsewhere — double execution fleet-wide). Abort it
+                    # by the attempt's echoed X-Request-Id (wire_id) before
+                    # failing over; unknown/finished ids are engine-side
+                    # no-ops, and the deadline paths' own aborts make this
+                    # idempotent. Sheds skip it: a shed was never admitted.
+                    spawn_abort(backend_url, wire_id)
             remaining = policy.remaining(t_attempts0)
             if remaining is not None and remaining <= 0:
                 count_deadline_abort("request")

@@ -22,8 +22,25 @@ scripts/chaos_check.py):
 - ``--shed-rate P``      each generation request 429s (with Retry-After)
                          with probability P
 - ``--retry-after S``    Retry-After seconds advertised on shed responses
+- ``--crash-after-n N``  HARD crash: once N generation requests have been
+                         accepted, the process ``os._exit``s abruptly —
+                         mid-stream when streaming, before responding
+                         otherwise. No drain, no manifest spill: models the
+                         kill -9 / OOM half of restart chaos (SIGTERM models
+                         the graceful half)
+- ``--restart-restore-pages M``  models a WARM restart: /metrics advertises
+                         ``vllm:warm_start_restored_pages M`` (+ manifest
+                         age), so rolling-restart chaos runs can assert the
+                         warm-start surface without a real engine
 - ``POST /abort``        cancels an in-flight request by X-Request-Id, like
                          the real engine's abort endpoint
+
+Observability used by chaos assertions: ``fake:running_peak`` (bounded-queue
+proof), ``fake:served_total`` (generation requests accepted by THIS process —
+resets on restart, which is how a chaos run detects traffic returning to a
+reborn backend), ``fake:completed_total`` (generations that ran to the end —
+fleet-wide sum proves an idempotent replay executed exactly once), and
+``fake:abort_requests_total`` (router-initiated reclaims received).
 
 SIGTERM drains like the real engine (api_server graceful drain): /health
 flips to 503, new generation requests are refused, in-flight streams finish.
@@ -57,6 +74,8 @@ STATE = {
     "sleeping": False,
     "draining": False,
     "served": 0,            # generation requests seen (drives --fail-first-n)
+    "completed": 0,         # generations that ran to the end (replay dedupe)
+    "aborts": 0,            # POST /abort calls received (router reclaims)
     "shed": 0,              # 429s emitted (saturate-after-n / shed-rate)
     "inflight": {},         # req_id -> handler asyncio.Task (for /abort)
 }
@@ -73,6 +92,19 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     saturate_after_n = faults.get("saturate_after_n")
     shed_rate = float(faults.get("shed_rate", 0.0))
     retry_after = f"{float(faults.get('retry_after') or 1):g}"
+    crash_after_n = faults.get("crash_after_n")
+    restore_pages = int(faults.get("restart_restore_pages") or 0)
+    start_time = time.time()
+
+    def _hard_crash():
+        """kill -9 semantics: no drain, no flushed buffers, no cleanup —
+        exactly what a warm-start manifest's periodic spill must survive."""
+        import os
+        import sys
+
+        print("fake-engine: injected hard crash (--crash-after-n)", flush=True)
+        sys.stdout.flush()
+        os._exit(9)
 
     def shed_response(reason: str):
         STATE["shed"] += 1
@@ -116,9 +148,25 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             f'vllm:gpu_prefix_cache_queries_total{{model_name="{model}"}} 20\n'
             f'vllm:engine_saturated{{model_name="{model}"}} {saturated}\n'
             f'vllm:num_requests_shed_total{{model_name="{model}"}} {STATE["shed"]}\n'
-            # fake-only observability: bounded-queue proof for overload tests
+            # fake-only observability: bounded-queue proof for overload tests,
+            # per-process served/completed/abort counters for restart + replay
+            # chaos assertions (served resets with the process, so a reborn
+            # backend's counter climbing from 0 proves traffic returned)
             f'fake:running_peak{{model_name="{model}"}} {STATE["running_peak"]}\n'
+            f'fake:served_total{{model_name="{model}"}} {STATE["served"]}\n'
+            f'fake:completed_total{{model_name="{model}"}} {STATE["completed"]}\n'
+            f'fake:abort_requests_total{{model_name="{model}"}} {STATE["aborts"]}\n'
         )
+        if restore_pages:
+            # warm-restart modelling (--restart-restore-pages): the same
+            # surface a real --warm-start engine exports after restore
+            text += (
+                f'vllm:warm_start_restored_pages{{model_name="{model}"}} '
+                f"{restore_pages}\n"
+                f'vllm:warm_start_manifest_age_seconds{{model_name="{model}"}} '
+                f"{time.time() - start_time:.3f}\n"
+                f'vllm:kv_corrupt_pages_total{{model_name="{model}"}} 0\n'
+            )
         # per-phase histograms, same names as the real engine's /metrics so
         # smoke tests and dashboard queries exercise the fake identically
         text += "\n".join(render_phase_histograms(f'model_name="{model}"')) + "\n"
@@ -153,6 +201,13 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         # fault injection: 500s fire BEFORE a slot is held (connect-stage
         # failure from the router's point of view)
         STATE["served"] += 1
+        # hard crash: request N+1 and later never answer — the process dies
+        # abruptly (mid-stream when streaming, pre-response otherwise)
+        crashing = (
+            crash_after_n is not None and STATE["served"] > int(crash_after_n)
+        )
+        if crashing and not stream:
+            _hard_crash()
         if fail_first_n and STATE["served"] <= fail_first_n:
             return web.json_response(
                 {"error": {"message": "injected failure (fail-first-n)"}}, status=500
@@ -214,6 +269,7 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             if not stream:
                 await asyncio.sleep(max_tokens / speed)
                 _decode_done(t_first)
+                STATE["completed"] += 1
                 text = "Hello " * max_tokens
                 choice = (
                     {"index": 0, "message": {"role": "assistant", "content": text},
@@ -237,6 +293,12 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             )
             await resp.prepare(request)
             for i in range(max_tokens):
+                # mid-stream hard crash: one chunk leaves first when the
+                # stream has more than one, then the whole process vanishes
+                # without a FIN or a drain; a single-token stream crashes on
+                # its only chunk (the flag must fire for every request shape)
+                if crashing and i >= min(1, max_tokens - 1):
+                    _hard_crash()
                 if fail_after_chunks is not None and i >= int(fail_after_chunks):
                     # mid-stream truncation: drop the TCP connection without
                     # a chunked terminator, so the proxy sees a payload error
@@ -258,6 +320,7 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                 )
                 await asyncio.sleep(1.0 / speed)
             _decode_done(t_first)
+            STATE["completed"] += 1
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
@@ -277,6 +340,7 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         except Exception:  # noqa: BLE001
             body = {}
         rid = body.get("request_id") or request.query.get("request_id")
+        STATE["aborts"] += 1
         task = STATE["inflight"].pop(rid, None)
         if task is not None:
             task.cancel()
@@ -361,6 +425,13 @@ def main():
                         "429 + Retry-After")
     p.add_argument("--retry-after", type=float, default=1.0,
                    help="Retry-After seconds advertised on shed responses")
+    p.add_argument("--crash-after-n", type=int, default=None,
+                   help="hard-crash the process (os._exit, no drain) once N "
+                        "generation requests have been accepted — mid-stream "
+                        "when streaming")
+    p.add_argument("--restart-restore-pages", type=int, default=None,
+                   help="model a warm restart: advertise "
+                        "vllm:warm_start_restored_pages N on /metrics")
     args = p.parse_args()
     app = make_app(
         args.model, args.speed, args.ttft, args.model_label,
@@ -373,6 +444,8 @@ def main():
             "saturate_after_n": args.saturate_after_n,
             "shed_rate": args.shed_rate,
             "retry_after": args.retry_after,
+            "crash_after_n": args.crash_after_n,
+            "restart_restore_pages": args.restart_restore_pages,
         },
     )
     asyncio.run(_serve_until_sigterm(app, args.port))

@@ -103,6 +103,30 @@ def test_overload_sheds_cleanly_with_bounded_queue_depth():
         assert s["circuit_state"].get(url, 0) != OPEN, s["circuit_state"]
 
 
+def test_rolling_restart_under_load_zero_errors_and_traffic_returns():
+    """Acceptance (zero-loss restarts, ISSUE 5): three engines restarted one
+    at a time under sustained load — SIGTERM drain, exit, rebirth on the same
+    address advertising a warm restore. Zero client non-429 errors across the
+    whole rotation, every engine drains to a clean exit, routed traffic
+    returns to each reborn backend within the breaker half-open window, and
+    the reborn backends export the warm-start metric surface."""
+    s = chaos_check.run_rolling_restart(
+        engines=3, workers=6, breaker_cooldown=1.5, return_window=8.0,
+        restore_pages=32,
+    )
+    assert s["non_429_errors"] == 0, s["errors"]
+    assert s["statuses"].get(200, 0) > 0, s["statuses"]
+    assert len(s["restarts"]) == 3
+    for r in s["restarts"]:
+        # SIGTERM drained to a clean exit (no in-flight stream was cut)
+        assert r["exit_rc"] == 0, r
+        # the reborn backend re-entered rotation inside the half-open window
+        assert r["traffic_returned_s"] is not None, r
+        assert r["traffic_returned_s"] <= s["return_window"], r
+        # warm-start surface present on the reborn process
+        assert r["warm_restored_pages"] == 32, r
+
+
 def test_inter_chunk_stall_aborts_engine_and_sends_sse_error():
     """Acceptance: a stream stalled past the inter-chunk timeout is aborted
     on the engine (scheduler slot freed, verified via /metrics running-count)

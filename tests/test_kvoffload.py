@@ -1,5 +1,6 @@
 """KV offload tier tests: serde, tiers, cache server, KV-index controller,
-and end-to-end engine offload (evict -> restore with correct KV)."""
+end-to-end engine offload (evict -> restore with correct KV), and the
+integrity layer (checksums, quarantine, recompute fallback)."""
 
 import asyncio
 import threading
@@ -7,7 +8,12 @@ import threading
 import numpy as np
 import pytest
 
-from production_stack_tpu.kvoffload.serde import get_serde
+from production_stack_tpu.kvoffload.serde import (
+    KVIntegrityError,
+    get_serde,
+    seal_bytes,
+    verify_blob,
+)
 from production_stack_tpu.kvoffload.tiers import CPUTier, DiskTier, TieredKVStore
 
 
@@ -78,19 +84,24 @@ class TestTiers:
 
     def test_spill_cpu_to_disk_and_drop(self, tmp_path):
         dropped = []
+        # sealed payloads: tier reads verify checksums, so stored blobs must
+        # carry the integrity envelope (raw bytes would read as corrupt)
+        blobs = {k: seal_bytes(c.encode() * 80) for k, c in
+                 (("a", "1"), ("b", "2"), ("c", "3"))}
+        sz = len(blobs["a"])
         st = TieredKVStore(
-            cpu_bytes=100,
+            cpu_bytes=sz + sz // 4,
             disk_path=str(tmp_path),
-            disk_bytes=120,
+            disk_bytes=2 * sz - sz // 4,
             on_local_drop=dropped.append,
         )
-        st.put("a", b"1" * 80)
-        st.put("b", b"2" * 80)  # a spills to disk
-        assert st.get("a") == b"1" * 80  # disk hit, promoted
+        st.put("a", blobs["a"])
+        st.put("b", blobs["b"])  # a spills to disk
+        assert st.get("a") == blobs["a"]  # disk hit, promoted
         assert st.hits["disk"] == 1
-        st.put("c", b"3" * 80)  # b spills; disk holds a+b=160 > 120 -> a drops
+        st.put("c", blobs["c"])  # b spills; disk holds a+b > cap -> a drops
         assert dropped  # something was fully dropped locally
-        assert st.stats()["disk_bytes"] <= 120
+        assert st.stats()["disk_bytes"] <= 2 * sz - sz // 4
 
 
 def _run_server(coro_factory):
@@ -127,6 +138,178 @@ def _run_server(coro_factory):
     return server_box["port"], stop
 
 
+class TestIntegrity:
+    """Offload-tier integrity (ISSUE 5): per-page checksums + versioned
+    headers; corrupt or version-mismatched entries are never served — they
+    are rejected, quarantined, counted, and the caller recomputes."""
+
+    def _blob(self):
+        k, v = _kv()
+        return get_serde("naive").serialize(k, v)
+
+    def test_bitflip_rejected(self):
+        blob = bytearray(self._blob())
+        blob[-3] ^= 0x40  # flip one bit deep in the V payload
+        with pytest.raises(KVIntegrityError):
+            verify_blob(bytes(blob))
+        from production_stack_tpu.kvoffload import serde as serde_mod
+
+        with pytest.raises(KVIntegrityError):
+            serde_mod.deserialize(bytes(blob))
+
+    def test_truncation_rejected(self):
+        blob = self._blob()
+        with pytest.raises(KVIntegrityError):
+            verify_blob(blob[: len(blob) - 7])
+
+    def test_future_version_rejected(self):
+        import json
+        import struct
+
+        hdr = json.dumps({"v": 99, "serde": "naive"}).encode()
+        blob = struct.pack("!I", len(hdr)) + hdr + b"body"
+        with pytest.raises(KVIntegrityError):
+            verify_blob(blob)
+
+    def test_garbage_header_rejected(self):
+        with pytest.raises(KVIntegrityError):
+            verify_blob(b"not a frame at all")
+
+    def test_v1_blob_without_crc_still_parses(self):
+        """Pre-upgrade blobs (no crc field) must keep deserializing — a disk
+        tier surviving a rolling upgrade is the whole point of warm starts."""
+        import json
+        import struct
+
+        k, v = _kv()
+        hdr = json.dumps(
+            {"serde": "naive", "shape": list(k.shape), "dtype": "bfloat16"}
+        ).encode()
+        legacy = struct.pack("!I", len(hdr)) + hdr + k.tobytes() + v.tobytes()
+        from production_stack_tpu.kvoffload import serde as serde_mod
+
+        k2, v2 = serde_mod.deserialize(legacy)
+        np.testing.assert_array_equal(np.asarray(k2), k)
+
+    def test_cpu_tier_quarantines_and_counts(self):
+        st = TieredKVStore(cpu_bytes=1 << 20)
+        blob = self._blob()
+        st.put("k", blob)
+        bad = bytearray(blob)
+        bad[-1] ^= 0xFF
+        st.cpu._data["k"] = bytes(bad)  # bit rot in DRAM
+        assert st.get("k") is None  # never served
+        assert st.corrupt_pages == 1
+        assert st.stats()["corrupt_pages"] == 1
+        assert "k" not in st.cpu  # quarantined, not left to re-fail forever
+
+    def test_disk_corruption_falls_back_to_remote_copy(self, tmp_path):
+        """A bit-flip on disk must fall THROUGH to the next tier, not poison
+        the get: the remote copy still serves, and the disk entry is gone."""
+        from production_stack_tpu.kvoffload import cache_server
+
+        port, stop = _run_server(
+            lambda h, p: cache_server.serve(h, p, max_bytes=1 << 20)
+        )
+        try:
+            st = TieredKVStore(
+                disk_path=str(tmp_path), disk_bytes=1 << 20,
+                remote_url=f"127.0.0.1:{port}",
+            )
+            blob = self._blob()
+            st.put("k", blob)  # disk + write-through to remote
+            # corrupt the on-disk file in place
+            f = tmp_path / "k.kv"
+            raw = bytearray(f.read_bytes())
+            raw[len(raw) // 2] ^= 0x01
+            f.write_bytes(bytes(raw))
+            assert st.get("k") == blob  # served from the REMOTE copy
+            assert st.corrupt_pages == 1
+            assert st.hits["remote"] == 1
+        finally:
+            stop()
+
+    def test_truncated_disk_file_rejected(self, tmp_path):
+        st = TieredKVStore(disk_path=str(tmp_path), disk_bytes=1 << 20)
+        blob = self._blob()
+        st.put("k", blob)
+        f = tmp_path / "k.kv"
+        f.write_bytes(f.read_bytes()[: len(blob) // 2])  # torn write
+        assert st.get("k") is None
+        assert st.corrupt_pages == 1
+
+    def test_cache_server_quarantines_corrupt_entry(self):
+        from production_stack_tpu.kvoffload.cache_server import CacheServer
+
+        cs = CacheServer(max_bytes=1 << 20)
+        blob = self._blob()
+        bad = bytearray(blob)
+        bad[-2] ^= 0x10
+        cs.put("k", bytes(bad))
+        assert cs.get("k") is None  # shared server never fans corruption out
+        assert cs.corrupt == 1
+        assert cs.get("k") is None and cs.corrupt == 1  # gone, not re-failed
+        assert cs.stats()["corrupt"] == 1
+
+
+class TestCorruptionRecomputeFallback:
+    """End-to-end: a corrupted offload tier must yield token-identical output
+    via recompute — checksum rejection converts a restore into a miss, never
+    into wrong KV (acceptance: corrupt pages are never served)."""
+
+    @pytest.fixture(scope="class")
+    def engine(self):
+        from production_stack_tpu.engine.config import EngineConfig
+        from production_stack_tpu.engine.engine import LLMEngine
+
+        cfg = EngineConfig(
+            model="llama-debug", max_model_len=256, max_num_seqs=4,
+            num_pages=28, page_size=8, prefill_chunk=32,
+            kv_offload_cpu_gb=0.001,
+        )
+        eng = LLMEngine(cfg)
+        eng.start()
+        yield eng
+        eng.stop()
+
+    def _greedy(self, engine, prompt, n=4):
+        from production_stack_tpu.engine.scheduler import SamplingParams
+
+        async def run():
+            toks = []
+            async for out in engine.generate(
+                f"cor-{np.random.randint(1 << 30)}", prompt=prompt,
+                params=SamplingParams(max_tokens=n, temperature=0.0,
+                                      ignore_eos=True),
+            ):
+                toks.extend(out.token_ids)
+            return toks
+
+        return asyncio.run(run())
+
+    def test_bitflipped_spill_recomputes_token_identical(self, engine):
+        prompt = "integrity check: the five boxing wizards jump quickly " * 3
+        first = self._greedy(engine, prompt)
+        # churn the pool so the prompt's pages spill to the CPU tier
+        for i in range(6):
+            self._greedy(engine, f"corruption filler number {i} padding " * 3)
+        store = engine._offload.store
+        assert store.cpu is not None and len(store.cpu) > 0
+        # flip a bit in EVERY spilled blob: any restore attempt must reject
+        for key in list(store.cpu._data):
+            raw = bytearray(store.cpu._data[key])
+            raw[-1] ^= 0x01
+            store.cpu._data[key] = bytes(raw)
+        c0 = engine.stats()["kv_corrupt_pages_total"]
+        again = self._greedy(engine, prompt)
+        assert again == first, "recompute fallback must be token-identical"
+        stats = engine.stats()
+        # the corruption was detected + quarantined (counter incremented),
+        # and the corrupt pages were never scattered into the pool
+        assert stats["kv_corrupt_pages_total"] > c0
+        assert stats["kv_corrupt_pages_total"] == store.corrupt_pages
+
+
 class TestCacheServer:
     def test_put_get_over_tcp(self):
         from production_stack_tpu.kvoffload import cache_server
@@ -138,8 +321,9 @@ class TestCacheServer:
         try:
             remote = RemoteTier(f"127.0.0.1:{port}")
             assert remote.get("nope") is None
-            remote.put("key1", b"payload-bytes")
-            assert remote.get("key1") == b"payload-bytes"
+            blob = seal_bytes(b"payload-bytes")
+            remote.put("key1", blob)
+            assert remote.get("key1") == blob
             assert "key1" in remote
             remote.close()
         finally:
@@ -155,8 +339,9 @@ class TestCacheServer:
             # two stores sharing one server: what one puts, the other gets
             a = TieredKVStore(cpu_bytes=1000, remote_url=f"127.0.0.1:{port}")
             b = TieredKVStore(cpu_bytes=1000, remote_url=f"127.0.0.1:{port}")
-            a.put("shared", b"kv-blob")
-            assert b.get("shared") == b"kv-blob"
+            blob = seal_bytes(b"kv-blob")
+            a.put("shared", blob)
+            assert b.get("shared") == blob
             assert b.hits["remote"] == 1
         finally:
             stop()

@@ -244,6 +244,81 @@ class TestFailover:
         assert routed[-1] == urls[1]
 
 
+class TestReplayDedupe:
+    """Regression (ISSUE 5): a router replay of an idempotent request after
+    mid-flight engine death must not execute twice on the fleet. The retry
+    path now aborts the failed attempt on its engine by the attempt's echoed
+    X-Request-Id before replaying elsewhere — a snapped TCP connection with
+    no bytes in flight is invisible to a non-streaming generation, which
+    would otherwise run to completion in parallel with the replay."""
+
+    def _fake_counter(self, url: str, name: str) -> int:
+        text = requests.get(f"{url}/metrics", timeout=5).text
+        m = re.search(rf"fake:{name}\{{[^}}]*\}} (\d+)", text)
+        return int(m.group(1)) if m else -1
+
+    def test_failover_aborts_failed_attempt_and_executes_once(self):
+        # backend 0 dies pre-first-byte on every stream; backend 1 is healthy
+        procs, urls = [], []
+        for extra in (["--fail-after-chunks", "0"], []):
+            port = free_port()
+            procs.append(start_proc(
+                ["-m", "production_stack_tpu.testing.fake_engine",
+                 "--port", str(port), "--model", "fake/model",
+                 "--speed", "500"] + extra
+            ))
+            urls.append(f"http://127.0.0.1:{port}")
+        router = None
+        try:
+            for proc, url in zip(procs, urls):
+                wait_healthy(f"{url}/health", proc, timeout=30)
+            router, base = _start_router(
+                urls,
+                extra=["--retry-max-attempts", "3",
+                       "--retry-backoff-base", "0.01",
+                       "--breaker-failure-threshold", "10"],
+            )
+            n = 4
+            for i in range(n):
+                r = requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "x",
+                          "max_tokens": 4, "stream": True},
+                    headers={"X-Request-Id": f"dedupe-{i}"},
+                    timeout=30,
+                )
+                assert r.status_code == 200, r.text
+                # the client-visible id stays the ORIGINAL across the replay
+                assert r.headers.get("X-Request-Id") == f"dedupe-{i}"
+            # exactly one execution per request fleet-wide: the healthy
+            # backend completed them all, the dying one completed none
+            deadline = time.time() + 10
+            while (time.time() < deadline
+                   and self._fake_counter(urls[1], "completed_total") < n):
+                time.sleep(0.2)
+            assert self._fake_counter(urls[1], "completed_total") == n
+            assert self._fake_counter(urls[0], "completed_total") == 0
+            # the retry path RECLAIMED every failed attempt on its engine
+            # (abort by the attempt's wire id) before replaying it: one abort
+            # per generation attempt the dying backend accepted (round-robin
+            # sends only a subset of requests there first)
+            served0 = self._fake_counter(urls[0], "served_total")
+            assert served0 >= 1, "no request ever attempted the dying backend"
+            deadline = time.time() + 10
+            while (time.time() < deadline
+                   and self._fake_counter(urls[0], "abort_requests_total")
+                   < served0):
+                time.sleep(0.2)
+            assert (
+                self._fake_counter(urls[0], "abort_requests_total") == served0
+            )
+        finally:
+            if router is not None:
+                stop_proc(router)
+            for p in procs:
+                stop_proc(p)
+
+
 class TestShedAwareRouting:
     """Overload semantics (docs/failure-handling.md): a backend's 429 +
     Retry-After is a SHED, not a failure — immediate failover, breaker

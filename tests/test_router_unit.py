@@ -384,3 +384,36 @@ def test_engine_stats_staleness_drops_dead_pod():
     assert set(s.get_engine_stats()) == {"http://b"}
     assert "http://a" not in s.last_success
     SingletonMeta._instances.pop(EngineStatsScraper, None)
+
+
+def test_engine_stats_restart_starts_new_epoch_and_clears_saturation():
+    """A reborn backend (counters regressed, or back from a staleness drop)
+    starts a NEW stats epoch: its pre-restart saturation window is cleared
+    so routing offers it traffic again immediately (the breaker path alone
+    governs re-entry), with no stale-snapshot quarantine on the newborn."""
+    from production_stack_tpu.router.resilience import get_saturation_registry
+
+    SingletonMeta._instances.pop(EngineStatsScraper, None)
+    s = EngineStatsScraper(scrape_interval=10.0)
+    sat = get_saturation_registry()
+    url = "http://a"
+    old = EngineStats(num_running_requests=5, gpu_prefix_cache_queries_total=100)
+    s.apply_scrape_results([url], [old], now=0.0)
+    assert s.epochs.get(url) is None
+    # engine restarts: the pre-restart incarnation had shed (Retry-After
+    # window active) and its counters reset to a small value
+    sat.mark(url, 30.0)
+    assert sat.is_saturated(url)
+    reborn = EngineStats(num_running_requests=0, gpu_prefix_cache_queries_total=2)
+    s.apply_scrape_results([url], [reborn], now=10.0)
+    assert s.epochs[url] == 1
+    assert not sat.is_saturated(url)  # stale shed window cleared
+    # a backend returning after a staleness DROP is also a new epoch
+    s.apply_scrape_results([url], [None], now=20.0)
+    s.apply_scrape_results([url], [None], now=55.0)  # > 3 intervals: dropped
+    assert url not in s.get_engine_stats()
+    s.apply_scrape_results([url], [reborn], now=60.0)
+    assert s.epochs[url] == 2
+    assert url in s.get_engine_stats()  # newborn snapshot trusted at once
+    sat.forget(url)
+    SingletonMeta._instances.pop(EngineStatsScraper, None)
