@@ -96,6 +96,21 @@ class EngineConfig:
     # (batch, context, page_size) bucket.
     decode_pages_per_block: int = 0
     decode_prefetch_pages: int = 0
+    # prefill-kernel memory pipeline tuning (threaded into the model config;
+    # ops/pallas/prefill_attention.py). prefill_pages_per_block: KV pages
+    # landed CONTIGUOUSLY per packed grid cell and folded as one wide
+    # matmul (0 = auto: ~512 slots). prefill_prefetch_pages: page DMAs kept
+    # in flight ahead of the cell being consumed (0 = auto: ~2 cells'
+    # worth). Retune with scripts/profile_prefill.py, which reports
+    # achieved HBM GB/s + tok/s per (chunk, context) bucket.
+    prefill_pages_per_block: int = 0
+    prefill_prefetch_pages: int = 0
+    # fused paged-KV write: the prefill kernel commits the chunk's K/V to
+    # its pool pages in-kernel (pools aliased input->output), replacing the
+    # post-scan scatter pass — the chunk's KV crosses HBM once instead of
+    # three times. Disable to fall back to the stacked-output + scatter
+    # path (same numerics; tests assert bit-identical pools).
+    prefill_fused_kv_write: bool = True
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     # sequence/context parallelism: long prefill chunks run ring attention
@@ -211,6 +226,20 @@ class EngineConfig:
 # --help text for flags whose one-line meaning is not obvious from the name;
 # the dataclass comments stay the authoritative long-form docs
 _FLAG_HELP = {
+    "prefill_pages_per_block": (
+        "prefill kernel: KV pages landed contiguously per packed grid cell "
+        "and folded as one wide matmul (0 = auto ~512 KV slots; retune with "
+        "scripts/profile_prefill.py)"
+    ),
+    "prefill_prefetch_pages": (
+        "prefill kernel: page DMAs kept in flight ahead of the cell being "
+        "consumed (0 = auto ~2 cells' worth)"
+    ),
+    "prefill_fused_kv_write": (
+        "commit each prefill chunk's K/V to its pool pages from inside the "
+        "attention kernel instead of a separate post-scan scatter pass "
+        "(same numerics; --no-prefill-fused-kv-write falls back)"
+    ),
     "warm_start": (
         "spill a warm-start manifest (hot chain-head KV pages + prefix-index "
         "metadata) to the offload tier on drain and every "

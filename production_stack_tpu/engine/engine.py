@@ -19,6 +19,7 @@ from typing import AsyncIterator, Optional
 
 import numpy as np
 
+from production_stack_tpu import tracing
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.kv_manager import KVPageManager
 from production_stack_tpu.engine.model_loader import load_model
@@ -78,14 +79,27 @@ class LLMEngine:
                     "kv_write_mode=%s unsupported for this model family; "
                     "keeping 'pre'", cfg.kv_write_mode,
                 )
-        # decode-kernel pipeline tuning rides the model config the same way
-        # attn_impl does (the kernel call sites live in the model forwards)
-        for knob in ("decode_pages_per_block", "decode_prefetch_pages"):
+        # decode/prefill-kernel pipeline tuning rides the model config the
+        # same way attn_impl does (the kernel call sites live in the model
+        # forwards)
+        for knob in (
+            "decode_pages_per_block", "decode_prefetch_pages",
+            "prefill_pages_per_block", "prefill_prefetch_pages",
+        ):
             val = getattr(cfg, knob, 0)
             if val and any(
                 f.name == knob for f in dataclasses.fields(model_cfg)
             ):
                 model_cfg = dataclasses.replace(model_cfg, **{knob: val})
+        # fused paged-KV write is a bool (default on): copy it whenever the
+        # model family has the field and the value differs
+        if any(
+            f.name == "prefill_fused_kv_write"
+            for f in dataclasses.fields(model_cfg)
+        ) and model_cfg.prefill_fused_kv_write != cfg.prefill_fused_kv_write:
+            model_cfg = dataclasses.replace(
+                model_cfg, prefill_fused_kv_write=cfg.prefill_fused_kv_write
+            )
         self.model_cfg = model_cfg
         self.tokenizer = load_tokenizer(
             cfg.tokenizer or (cfg.model if "/" in cfg.model or cfg.model.startswith(".") else None)
@@ -929,11 +943,27 @@ class LLMEngine:
                         self.scheduler._finish(s, "error")
                         self._emit(s, "", error=True)
                 continue
-            self.loop_seconds["step"] += (
-                time.perf_counter() - t_step - inline_ae
-            )
+            step_wall = time.perf_counter() - t_step - inline_ae
+            self.loop_seconds["step"] += step_wall
             if fetched:
                 self._unfetched.clear()  # a real fetch retires prior dispatches
+                # dispatch-granular prefill-phase observability (the
+                # Grafana prefill panel): chunk latency for FETCHED prefill
+                # dispatches (a skip-fetch dispatch's wall is just enqueue
+                # time — the final fetched chunk absorbs the queued
+                # compute), and decode per-token time while a prefill is
+                # resident (the interleave the demand gate schedules)
+                if batch.kind == "prefill":
+                    tracing.prefill_chunk_hist.observe(step_wall)
+                elif batch.kind == "decode" and any(
+                    s.in_prefill for s in self.scheduler.running
+                ):
+                    toks_n = max(
+                        1, self.scheduler.decode_steps * batch.bursts
+                    )
+                    tracing.interleaved_decode_hist.observe(
+                        step_wall / toks_n
+                    )
             if tokens is not None:
                 self._apply_and_emit(batch, tokens, lp_data)
         logger.info("engine loop exited")
@@ -1153,8 +1183,6 @@ class LLMEngine:
         once per request at finish — zero cost on the step path. Histograms
         are always-on (they back the dashboard's phase panels); spans only
         when the request carries a sampled trace context."""
-        from production_stack_tpu import tracing
-
         seq.trace_done = True
         now_m = time.monotonic()
         anchor = time.time() - now_m  # monotonic -> wall clock

@@ -125,8 +125,13 @@ class ModelRunner:
                 and getattr(cfg, "num_kv_heads", 1) % tp == 0
             )
             use_pallas = jax.default_backend() == "tpu" and mesh_ok and heads_ok
+            # "pallas_prefill": decode kernel everywhere it applies PLUS the
+            # v2 chunked-prefill kernel (ragged packed grid + contiguous-KV
+            # DMA ring + fused paged-KV write) on single-device prefill
+            # chunks; multi-device prefill keeps the XLA/ring path inside
+            # the model forward (GSPMD cannot partition a pallas_call)
             cfg = dataclasses.replace(
-                cfg, attn_impl="pallas" if use_pallas else "xla"
+                cfg, attn_impl="pallas_prefill" if use_pallas else "xla"
             )
             self.cfg = cfg
         # the forward needs the mesh for sp/pp and for the sharded pallas

@@ -424,20 +424,30 @@ class Scheduler:
         self._try_admit()
         prefilling = [s for s in self.running if s.in_prefill]
         decoding = [s for s in self.running if not s.in_prefill]
-        # Alternate prefill chunks with decode bursts when a REAL prefill
-        # backlog coexists with decoding rows: strict prefill priority
+        # Alternate prefill chunks with decode bursts when prefill work
+        # coexists with RESIDENT DECODE DEMAND: strict prefill priority
         # starves decodes under a steady long-prompt arrival stream
         # (measured 64-token answers taking ~40 s under the multi-round-qa
         # workload) — the whole point of chunked prefill is that decode
-        # latency survives long prompts. The backlog threshold keeps SHORT
-        # prefill flurries on the fast strict-priority path: they clear in
-        # a dispatch or two, and alternating through them would pay a fetch
-        # round trip per interleaved (unchained) decode burst.
+        # latency survives long prompts. The gate is demand-driven, not
+        # backlog-only: a long-prompt backlog (>= 2 chunks, e.g. one 32k
+        # prompt) alternates so the in-flight decodes' inter-token latency
+        # stays bounded while it streams through, AND a big resident decode
+        # batch (>= prefill_batch rows) alternates even when the backlog is
+        # short — each skipped interleave there stalls that many live
+        # streams for a whole chunk, which is worse than the one fetch
+        # round trip the interleaved burst costs. Small decode batches with
+        # a short backlog keep the fast strict-priority path: the flurry
+        # clears in a dispatch or two.
         backlog = sum(len(s.prompt_ids) - s.num_computed for s in prefilling)
+        demand = len(decoding)
         alternate = (
-            decoding
-            and backlog >= 2 * self.prefill_chunk
+            demand > 0
             and self._last_kind == "prefill"
+            and (
+                backlog >= 2 * self.prefill_chunk
+                or demand >= max(2, self.prefill_batch)
+            )
         )
         if prefilling and not alternate:
             return self._take_prefill(prefilling)

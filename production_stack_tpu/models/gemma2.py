@@ -60,6 +60,9 @@ class Gemma2Config:
     kv_write_mode: str = "post"       # same contract as LlamaConfig.kv_write_mode
     decode_pages_per_block: int = 0   # same contract as LlamaConfig
     decode_prefetch_pages: int = 0
+    prefill_pages_per_block: int = 0  # same contract as LlamaConfig
+    prefill_prefetch_pages: int = 0
+    prefill_fused_kv_write: bool = True
 
     @property
     def tie_word_embeddings(self) -> bool:
@@ -224,12 +227,30 @@ def forward(
         kv_pos = stale_kv_positions(page_table, positions, k_pages.shape[2])
 
     # pallas decode streams straight from the stacked pools via a layer
-    # index (see models/llama.py stream_pools)
+    # index (see models/llama.py stream_pools); prefill kernel v2 does the
+    # same for chunks — the per-layer window rides the scan as a traced
+    # scalar-prefetch operand, so Gemma's interleaved local/global layers
+    # each stream only their live page range
+    single_dev = mesh is None or mesh.devices.size == 1
+    prefill_kernel_ok = (
+        T >= 16 and single_dev and kv_burst is None and post_write
+        and cfg.attn_impl in ("pallas_prefill", "pallas_interpret")
+    )
     stream_pools = (
-        cfg.attn_impl.startswith("pallas") and T == 1 and post_write
+        cfg.attn_impl.startswith("pallas") and post_write
+        and (T == 1 or prefill_kernel_ok)
+    )
+    fused_prefill = (
+        prefill_kernel_ok and stream_pools and T > 1
+        and cfg.prefill_fused_kv_write
     )
 
-    def layer(x, layer_in):
+    def layer(x_carry, layer_in):
+        if fused_prefill:
+            x, kp_c, vp_c = x_carry  # pools ride the scan as aliased carry
+        else:
+            x = x_carry
+            kp_c = vp_c = None
         if stream_pools:
             if burst:
                 lp, li, window, ka, va = layer_in
@@ -295,6 +316,37 @@ def forward(
                 attn = ragged_paged_attention_decode(
                     q[:, 0], *pool_args, page_table, kv_lens, **common
                 )[:, None]
+        elif prefill_kernel_ok:
+            # chunked prefill: ragged packed grid + contiguous-KV DMA ring
+            # (+ fused paged-KV write when the pools ride the carry)
+            from production_stack_tpu.ops.pallas.prefill_attention import (
+                ragged_paged_attention_prefill,
+            )
+
+            kernel_kw = dict(
+                window=window, sm_scale=sm_scale,
+                logit_softcap=cfg.attn_logit_softcap,
+                interpret=cfg.attn_impl == "pallas_interpret",
+                pages_per_block=cfg.prefill_pages_per_block or None,
+                prefetch_pages=cfg.prefill_prefetch_pages or None,
+                layer=li,
+            )
+            kernel_args = (
+                q,
+                kp_c if fused_prefill else k_pages,
+                vp_c if fused_prefill else v_pages,
+                page_table, positions, kv_lens,
+                k.astype(pool_dt), v.astype(pool_dt),
+                jnp.sum(positions >= 0, axis=1).astype(jnp.int32),
+            )
+            if fused_prefill:
+                attn, kp_c, vp_c = ragged_paged_attention_prefill(
+                    *kernel_args, fused_write=True, **kernel_kw
+                )
+            else:
+                attn = ragged_paged_attention_prefill(
+                    *kernel_args, **kernel_kw
+                )
         elif post_write:
             kc, vc = gather_kv_pages(kp, vp, page_table)
             if burst:
@@ -321,6 +373,9 @@ def forward(
         h = _rms_norm_1p(x, lp["mlp_norm"], eps)
         mlp = (jax.nn.gelu(h @ lp["w_gate"], approximate=True) * (h @ lp["w_up"])) @ lp["w_down"]
         x = x + _rms_norm_1p(mlp, lp["post_mlp_norm"], eps)
+        if fused_prefill:
+            # the kernel already committed this layer's K/V to the pool
+            return (x, kp_c, vp_c), None
         if burst:
             out_kv = (kwin, vwin)
         elif post_write:
@@ -340,6 +395,11 @@ def forward(
     if burst:
         x, (k_acc, v_acc) = lax.scan(layer, x, xs + (k_acc0, v_acc0))
         # no pool write: the caller commits the burst once (deferred mode)
+    elif fused_prefill:
+        # no post-scan scatter: every layer's kernel wrote its pool slice
+        (x, k_pages, v_pages), _ = lax.scan(
+            layer, (x, k_pages, v_pages), xs
+        )
     elif post_write:
         x, (k_new, v_new) = lax.scan(layer, x, xs)
         k_pages, v_pages = write_kv_pages_all_layers(

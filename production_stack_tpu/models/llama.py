@@ -81,6 +81,17 @@ class LlamaConfig:
     # grid cell, and DMA-ring depth (page copies kept in flight)
     decode_pages_per_block: int = 0
     decode_prefetch_pages: int = 0
+    # prefill-kernel memory pipeline tuning (0 = kernel auto; see
+    # ops/pallas/prefill_attention.py): KV pages landed contiguously per
+    # packed grid cell (one wide matmul each), and how many page DMAs stay
+    # in flight ahead of the cell being consumed
+    prefill_pages_per_block: int = 0
+    prefill_prefetch_pages: int = 0
+    # fused paged-KV write: the prefill kernel scatters the chunk's K/V
+    # into its pool pages in-kernel (pools aliased input->output), so the
+    # layer scan stops stacking per-layer K/V and the post-scan
+    # write_kv_pages_all_layers pass disappears from the prefill path
+    prefill_fused_kv_write: bool = True
 
     @staticmethod
     def from_hf_config(cfg: dict) -> "LlamaConfig":
@@ -489,13 +500,11 @@ def forward(
     # keeps the XLA/ring path (GSPMD cannot partition a pallas_call and the
     # sp axis owns long chunks).
     single_dev = mesh is None or mesh.devices.size == 1
-    # prefill kernel is OPT-IN (attn_impl="pallas_prefill") / interpret-test
-    # only: measured on v5e it only reaches parity with the XLA gather path
-    # (~67 ms vs ~68 ms attention at 16k ctx) — page-granular (64-slot)
-    # matmuls fragment the MXU, and prefill is compute-bound so the gather
-    # traffic the kernel saves is cheap there. A contiguous-KV variant
-    # (in-kernel DMA gather of N pages -> one wide matmul) is the path to a
-    # win; until then serving keeps XLA for chunks.
+    # prefill kernel v2 (attn_impl="pallas_prefill", the TPU auto default /
+    # "pallas_interpret" in tests): packed ragged grid + contiguous-KV DMA
+    # ring — v1's page-granular (64-slot) matmuls fragmented the MXU and
+    # only reached XLA parity; v2 lands N pages contiguously in VMEM and
+    # folds them as ONE wide matmul (ops/pallas/prefill_attention.py).
     prefill_kernel_ok = (
         T >= 16 and single_dev and sp == 1 and kv_burst is None
         and cfg.attn_impl in ("pallas_prefill", "pallas_interpret")
@@ -506,9 +515,24 @@ def forward(
         and post_write
         and (T == 1 or prefill_kernel_ok)
     )
+    # fused paged-KV write: the kernel commits the chunk's K/V to the pool
+    # in-kernel, the pools ride the layer scan as an aliased CARRY, and the
+    # post-scan write_kv_pages_all_layers pass disappears — the chunk's KV
+    # crosses HBM once instead of three times (stack write + read + scatter)
+    fused_prefill = (
+        prefill_kernel_ok and stream_pools and T > 1
+        and getattr(cfg, "prefill_fused_kv_write", False)
+    )
 
     def layer(x_aux, layer_in):
-        x, aux = x_aux
+        if fused_prefill:
+            # the pools ride the scan as CARRY: each layer's kernel writes
+            # its own slice in place (aliased input->output), so the carry
+            # chain is copy-free and the scan emits no stacked K/V
+            x, aux, kp_c, vp_c = x_aux
+        else:
+            x, aux = x_aux
+            kp_c = vp_c = None
         if stream_pools:
             if burst:
                 lp, li, ll, ka, va = layer_in
@@ -609,15 +633,31 @@ def forward(
             )
 
             pool_dt = k_pages.dtype
-            attn = ragged_paged_attention_prefill(
-                q, k_pages, v_pages, aux["page_table"], aux["positions"],
-                aux["kv_lens"],
-                k.astype(pool_dt), v.astype(pool_dt),
-                jnp.sum(aux["positions"] >= 0, axis=1).astype(jnp.int32),
+            kernel_kw = dict(
                 window=cfg.sliding_window,
                 interpret=cfg.attn_impl == "pallas_interpret",
+                pages_per_block=getattr(cfg, "prefill_pages_per_block", 0)
+                or None,
+                prefetch_pages=getattr(cfg, "prefill_prefetch_pages", 0)
+                or None,
                 layer=li,
             )
+            kernel_args = (
+                q,
+                kp_c if fused_prefill else k_pages,
+                vp_c if fused_prefill else v_pages,
+                aux["page_table"], aux["positions"], aux["kv_lens"],
+                k.astype(pool_dt), v.astype(pool_dt),
+                jnp.sum(aux["positions"] >= 0, axis=1).astype(jnp.int32),
+            )
+            if fused_prefill:
+                attn, kp_c, vp_c = ragged_paged_attention_prefill(
+                    *kernel_args, fused_write=True, **kernel_kw
+                )
+            else:
+                attn = ragged_paged_attention_prefill(
+                    *kernel_args, **kernel_kw
+                )
         else:
             kc, vc = gather_kv_pages(kp, vp, aux["page_table"])
             if burst:
@@ -651,6 +691,11 @@ def forward(
                     window=cfg.sliding_window,
                     kv_positions=aux["kv_pos"] if post_write else None,
                 )
+        x = x + proj(attn.reshape(Bm, Tm, -1), "wo")
+        x = _mlp_residual(x, lp, cfg, proj)
+        if fused_prefill:
+            # the kernel already committed this layer's K/V to the pool
+            return (x, aux, kp_c, vp_c), None
         if burst:
             out_kv = (kwin, vwin)  # stacked by the scan -> [L, B, C, KH, D]
         elif post_write:
@@ -659,8 +704,7 @@ def forward(
             )
         else:
             out_kv = (kp, vp)
-        x = x + proj(attn.reshape(Bm, Tm, -1), "wo")
-        return (_mlp_residual(x, lp, cfg, proj), aux), out_kv
+        return (x, aux), out_kv
 
     lora_layers = None if lora is None else lora["layers"]
     if stream_pools:
@@ -687,6 +731,11 @@ def forward(
         x, (k_new, v_new) = serving_layer_pipeline(mesh, layer, x, aux, scan_xs)
         k_pages, v_pages = write_kv_pages_all_layers(
             k_pages, v_pages, k_new, v_new, page_table, positions
+        )
+    elif fused_prefill:
+        # no post-scan scatter: every layer's kernel wrote its pool slice
+        (x, _, k_pages, v_pages), _ = lax.scan(
+            layer, (x, aux, k_pages, v_pages), scan_xs
         )
     elif post_write:
         (x, _), (k_new, v_new) = lax.scan(layer, (x, aux), scan_xs)

@@ -47,6 +47,12 @@ class OPTConfig:
     kv_write_mode: str = "post"  # same contract as LlamaConfig.kv_write_mode
     decode_pages_per_block: int = 0  # same contract as LlamaConfig
     decode_prefetch_pages: int = 0
+    prefill_pages_per_block: int = 0  # same contract as LlamaConfig
+    prefill_prefetch_pages: int = 0
+    # accepted for config-threading uniformity; OPT's layer scan carries
+    # pools as per-layer xs slices (no stacked-pool streaming), so its
+    # prefill kernel path keeps the post-scan scatter regardless
+    prefill_fused_kv_write: bool = True
 
     # uniform accessors used by the runner/engine (OPT has no GQA)
     @property
@@ -188,6 +194,25 @@ def forward(
                 pages_per_block=cfg.decode_pages_per_block or None,
                 prefetch_pages=cfg.decode_prefetch_pages or None,
             )[:, None]
+        elif (
+            T >= 16 and post_write
+            and cfg.attn_impl in ("pallas_prefill", "pallas_interpret")
+        ):
+            # chunked prefill via kernel v2 (see models/llama.py); OPT's
+            # scan carries per-layer pool slices, so the post-scan scatter
+            # stays and fused_write is not used here
+            from production_stack_tpu.ops.pallas.prefill_attention import (
+                ragged_paged_attention_prefill,
+            )
+
+            attn = ragged_paged_attention_prefill(
+                q, kp, vp, page_table, positions, kv_lens,
+                k.astype(kp.dtype), v.astype(vp.dtype),
+                jnp.sum(positions >= 0, axis=1).astype(jnp.int32),
+                interpret=cfg.attn_impl == "pallas_interpret",
+                pages_per_block=cfg.prefill_pages_per_block or None,
+                prefetch_pages=cfg.prefill_prefetch_pages or None,
+            )
         elif post_write:
             kc, vc = gather_kv_pages(kp, vp, page_table)
             kc = jnp.concatenate([kc, k.astype(kc.dtype)], axis=1)

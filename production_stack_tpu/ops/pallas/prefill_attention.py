@@ -1,30 +1,58 @@
-"""Pallas TPU kernel: flash prefill attention over paged KV.
+"""Pallas TPU kernel: ragged flash prefill over paged KV (v2) + fused
+paged-KV write.
 
-Why (round-5 measurement): the XLA chunked-prefill path
-(ops/attention.py flash_attention after gather_kv_pages) materializes a
-[B, S, KH, D] gather of the page pool per layer AND runs its online-softmax
-as a 32-step lax.scan at 16k context — measured ~93 ms per 1k-token chunk at
-16k context on v5e (vs ~25 ms at 1k context), i.e. the attention term runs
-at well under 20% MFU right when it dominates (2.2 TFLOP per chunk at 16k).
-This kernel streams pages HBM->VMEM exactly once via scalar-prefetch page
-indirection (same trick as paged_attention.py's decode kernel), keeps the
-(m, l, acc) flash state in VMEM scratch across a query block's KV sweep, and
-folds the chunk's own in-register K/V (write-after-attend mode: the pool is
-stale for the current chunk) as a final block — no pool gather, no scan.
+Why v2 (BENCH_r05): chunked prefill throughput went BACKWARDS with context
+— 9,788 tok/s at 16k fell to 7,158 at 32k — because the v1 kernel kept the
+decode-v1 memory structure the decode kernel already abandoned (PR 3):
 
-Masking model mirrors ops/attention.stale_kv_positions: paged slot s holds
-absolute position s and is valid while s < paged_end_b = kv_lens[b] -
-cur_lens[b] (later slots are stale; the chunk's K/V ride in-register), so
-every valid paged slot is causally visible to every chunk query (chunk
-positions all >= chunk start) and only the validity bound is needed; chunk
-entry j at positions[b, j] is visible to query t iff positions[b, j] >= 0
-and positions[b, j] <= positions[b, t]. Padded rows (positions -1) see
-nothing and emit zeros.
+1. **Dense grid.** v1 ran grid = (B, n_qb, n_page_blocks) over the page
+   BUCKET: a 1k-token history in a 32k bucket still executed ~500 dead
+   (query-block x kv-block) cells whose BlockSpec fetches refetched the
+   last page. v2 derives each (sequence, query-block)'s LIVE kv-block count
+   from ``kv_lens``/``cur_lens`` (and the sliding window, per query block)
+   on the host side, packs live cells into a 1D grid, and pads with no-op
+   cells that alias the last live cell — prefill cost scales with each
+   sequence's REAL history, so mixed 1k/16k batches cost the sum of their
+   real work, and a 32k prompt's later chunks pay for 32k once, not
+   bucket x chunks.
+
+2. **Page-granular matmuls.** v1 fetched N pages per cell as N separate
+   BlockSpec inputs and folded each page separately: a 64-slot score matmul
+   fragments the MXU (measured XLA-parity on v5e — the kernel's whole
+   advantage vanished into per-page overhead). v2 leaves the pools in HBM
+   (``memory_space=ANY``) and drives a manually multi-buffered VMEM ring of
+   page copies (``pltpu.make_async_copy``, ``prefill_prefetch_pages``
+   deep): N pages land CONTIGUOUSLY in a ring slot and fold as ONE wide
+   [KH, TQ, N*page] matmul — the "contiguous-KV variant" the v1 notes
+   called the path to a win. Copies stay in flight across cell boundaries,
+   so the HBM pipeline never drains between cells.
+
+3. **Fused paged-KV write.** The chunk's own K/V used to ride the layer
+   scan as stacked outputs and get committed by a separate post-scan
+   scatter (``write_kv_pages_all_layers``): write the stack, read it back,
+   scatter into the pool — 3 HBM traversals of the chunk's KV per step.
+   With ``fused_write=True`` the kernel writes the chunk's K/V into its
+   pool pages directly from VMEM (the pools are aliased input->output), so
+   the chunk's KV crosses HBM once. Interior pages are single page-sized
+   DMAs; a partial head/tail page (unaligned chunk start, or a chunk end
+   mid-page) is read-modify-written so untouched slots keep their exact
+   old bytes — tests assert the pool is bit-identical to the scatter path.
+
+Masking model is unchanged from v1 (ops/attention.stale_kv_positions):
+paged slot s holds absolute position s and is valid while s < paged_end =
+kv_lens[b] - cur_lens[b]; the chunk's K/V ride in-register and fold at each
+query block's last cell. Fused-write contract (and the causal block-skip):
+valid chunk entries are CONTIGUOUS — entry j sits at position paged_end + j
+— which is how the engine's scheduler builds every prefill chunk
+(scheduler._plan_prefill). Upper-triangle chunk sub-blocks (entries no
+query in the block can see) are skipped by a dynamic loop bound, so they
+cost nothing.
 
 Equivalent role in the reference: vLLM's CUDA prefill (flash-attn) kernels
-inside the engine image (/root/reference helm/templates/
-deployment-vllm-multi.yaml:128-141); tests assert equivalence against the
-XLA oracle.
+inside the engine image; PAPERS "Ragged Paged Attention" is the direct
+blueprint. Tests assert equivalence against the XLA oracle
+(tests/test_pallas_prefill.py); scripts/profile_prefill.py measures the
+achieved page-streaming HBM GB/s and the ragged-scaling property on chip.
 """
 
 from __future__ import annotations
@@ -38,71 +66,144 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_FOLD_BLOCK = 128  # chunk-fold sub-block (CB): one score tensor's S extent
 
 
 def _prefill_kernel(
     # scalar prefetch
-    pt_ref,      # [B, max_pages] int32 page table (drives kv block fetch)
+    pt_ref,      # [B, max_pages] int32 page table
     lens_ref,    # [B] int32 kv lengths (chunk end)
-    cl_ref,      # [B] int32 chunk sizes (in-register entries)
+    cl_ref,      # [B] int32 chunk sizes (valid in-register entries)
     win_ref,     # [1] int32 window (huge = full causal)
     layer_ref,   # [1] int32 layer into stacked pools
-    # blocks
-    q_ref,       # [1, TQ, NH, D]
+    seq_ref,     # [NC] packed cell -> batch row
+    qb_ref,      # [NC] packed cell -> query block
+    blk_ref,     # [NC] packed cell -> kv block within the (b, qb) live range
+    cnt_ref,     # [B*n_qb] live cell count per (b, qb) (>= 1)
+    lopg_ref,    # [B*n_qb] first live page (window start) per (b, qb)
+    livepg_ref,  # [B*n_qb] live page count per (b, qb) — the packing's
+                 # source of truth; the kernel must never re-derive it
+    total_ref,   # [1] total live cells
+    # inputs
+    q_ref,       # [1, TQ, NH, D] (current (b, qb) block)
     pos_ref,     # [1, TQ] int32 query positions (-1 pad)
-    *refs,       # N x (k_ref, v_ref) [1, 1, page, KH, D], k_cur, v_cur
-                 # ([1, C, KH, D]), cpos_ref [1, C], o_ref, qg/m/l/acc scratch
+    kp_hbm,      # [L, P, page, KH, D], memory_space=ANY (stays in HBM)
+    vp_hbm,
+    kc_ref,      # [1, Cw, KH, D] chunk K/V, front-padded by fp_pad slots
+    vc_ref,
+    cpos_ref,    # [1, Cw] chunk entry positions (-1 pad)
+    *refs,       # o_ref [, kp_out, vp_out], then scratch (see wrapper)
     sm_scale: float,
     kv_heads: int,
     logit_softcap: float | None,
     pages_per_block: int,
+    ring_blocks: int,
+    n_qb: int,
+    fused_write: bool,
+    fp_pad: int,
+    max_write_pages: int,
 ):
     N = pages_per_block
-    kv_refs = refs[: 2 * N]
-    (k_cur_ref, v_cur_ref, cpos_ref, o_ref,
-     qg_ref, m_ref, l_ref, acc_ref) = refs[2 * N:]
-    b = pl.program_id(0)
-    p = pl.program_id(2)
-    page_size = kv_refs[0].shape[2]
+    RB = ring_blocks
+    if fused_write:
+        (o_ref, kp_out, vp_out, k_buf, v_buf, ksem, vsem,
+         wk_sem, wv_sem, rk_sem, rv_sem, wbuf_k, wbuf_v,
+         qg_ref, m_ref, l_ref, acc_ref) = refs
+        kp_src, vp_src = kp_out, vp_out  # aliased with kp_hbm/vp_hbm
+    else:
+        (o_ref, k_buf, v_buf, ksem, vsem,
+         qg_ref, m_ref, l_ref, acc_ref) = refs
+        kp_src, vp_src = kp_hbm, vp_hbm
+    KB = k_buf.shape[1]
+    page_size = KB // N
+    max_pages = pt_ref.shape[1]
+    n_cells = seq_ref.shape[0]
     TQ, NH, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     KH = kv_heads
     G = NH // KH
+    lyr = layer_ref[0]
 
-    @pl.when(p == 0)
+    c = pl.program_id(0)
+    total = total_ref[0]
+    live = c < total
+    b = seq_ref[c]
+    qb = qb_ref[c]
+    p = blk_ref[c]
+    r = b * n_qb + qb
+
+    def _copies(g):
+        """DMA descriptors + go/no-go predicate for global page stream index
+        g = cell*N + i. A page is fetched iff its cell is live and the page
+        lies inside the cell's (b, qb) live range — the SAME predicate gates
+        start and wait, so semaphore counts always pair. Page i of cell cc
+        lands at offset i*page within ring slot cc % RB: the cell's N pages
+        are CONTIGUOUS in VMEM and fold as one wide matmul."""
+        cc = jnp.minimum(g // N, n_cells - 1)
+        bb = seq_ref[cc]
+        rr = bb * n_qb + qb_ref[cc]
+        pi = blk_ref[cc] * N + g % N
+        ok = (g < total * N) & (pi < livepg_ref[rr])
+        pid = pt_ref[bb, jnp.minimum(lopg_ref[rr] + pi, max_pages - 1)]
+        slot = cc % RB
+        off = (g % N) * page_size
+        s = g % (RB * N)
+        kcp = pltpu.make_async_copy(
+            kp_src.at[lyr, pid], k_buf.at[slot, pl.ds(off, page_size)],
+            ksem.at[s],
+        )
+        vcp = pltpu.make_async_copy(
+            vp_src.at[lyr, pid], v_buf.at[slot, pl.ds(off, page_size)],
+            vsem.at[s],
+        )
+        return ok, kcp, vcp
+
+    def _start(g):
+        ok, kcp, vcp = _copies(g)
+
+        @pl.when(ok)
+        def _():
+            kcp.start()
+            vcp.start()
+
+    @pl.when(live & (p == 0))
     def _():
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
-        # queries split per GQA group into scratch: group g's heads are
-        # h = kh*G + g, so q4[:, :, g] is the [TQ, KH, D] slice batched over
-        # KH. Row packing (one [KH, G*TQ, D] matmul) hits Mosaic reshape
-        # limits (minor-dim collapses are unsupported shape casts); scratch
-        # lets the fold below index groups DYNAMICALLY from a fori_loop.
+        # per-GQA-group query scratch (see v1 notes: group-major scratch lets
+        # the fold index groups dynamically from a fori_loop, and Mosaic
+        # rejects the minor-dim collapse a row-packed layout would need)
         q4 = (
             q_ref[0] * jnp.asarray(sm_scale, q_ref.dtype)
         ).reshape(TQ, KH, G, D)
         for g in range(G):
             qg_ref[g] = q4[:, :, g].transpose(1, 0, 2)  # [KH, TQ, D]
 
+    @pl.when(c == 0)
+    def _():
+        # warm-up: fill the ring's first RB-1 block slots; steady state below
+        # tops off block c+RB-1 while consuming block c, so up to (RB-1)*N
+        # page DMAs stay in flight across cell boundaries
+        for g in range((RB - 1) * N):
+            _start(jnp.int32(g))
+
     paged_end = lens_ref[b] - cl_ref[b]
     pos_q = pos_ref[0]  # [TQ]
+    win = win_ref[0]
 
     def fold(k, v, kv_pos, valid):
         """One online-softmax update; k/v [KH, S, D], kv_pos/valid [S].
 
-        The GQA groups run under a fori_loop, NOT a Python loop: every
-        unrolled fold gets its own scoped-vmem stack for the [KH, TQ, S]
-        f32 score temporaries (Mosaic does not reuse stacks across unrolled
-        statements — measured 4 pages x 4 groups unrolled at 26 MB vs the
-        16 MB budget), while a loop body compiles once and reuses one stack.
-        Inputs stay in their own dtype (bf16 in production: MXU-native, and
-        f32 copies of q/k/v doubled the stack).
-        """
+        Groups run under a fori_loop, NOT a Python loop: every unrolled fold
+        would get its own scoped-vmem stack for the [KH, TQ, S] f32 score
+        temporaries, while a loop body compiles once and reuses one stack
+        (v1's measured 26 MB-vs-16 MB lesson). Inputs stay in their own
+        dtype (bf16 in production: MXU-native)."""
         vis = (
             valid[None, None, :]
             & (kv_pos[None, None, :] <= pos_q[None, :, None])
             & (pos_q[None, :, None] >= 0)
-            & (kv_pos[None, None, :] > pos_q[None, :, None] - win_ref[0])
+            & (kv_pos[None, None, :] > pos_q[None, :, None] - win)
         )  # [1, TQ, S]
 
         def gbody(g, carry):
@@ -123,44 +224,153 @@ def _prefill_kernel(
             pv = lax.dot_general(
                 pij.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32,
-            )  # [KH, TQ, D]; bf16 pij on the MXU, f32 accumulate
+            )  # [KH, TQ, D]
             acc_ref[g] = acc_ref[g] * alpha[..., None] + pv
             return carry
 
         lax.fori_loop(0, G, gbody, 0)
 
-    for i in range(N):
-        start = (p * N + i) * page_size
-
-        @pl.when(start < paged_end)
-        def _(k_ref=kv_refs[2 * i], v_ref=kv_refs[2 * i + 1], start=start):
-            k = k_ref[0, 0].transpose(1, 0, 2)  # [KH, page, D], pool dtype
-            v = v_ref[0, 0].transpose(1, 0, 2)
-            idx = start + lax.iota(jnp.int32, page_size)
-            # paged slot position == slot index; causal vs chunk queries is
-            # automatic (slot < paged_end <= every valid query position)
-            fold(k, v, idx, idx < paged_end)
-
-    @pl.when(p == pl.num_programs(2) - 1)
+    # ---- paged KV: top off the ring, then ONE wide fold over the cell ----
+    @pl.when(live)
     def _():
-        # fold the chunk's own K/V (stale in the pool) in sub-blocks under a
-        # fori_loop (same stack-reuse point as the groups; one [KH, TQ, C]
-        # f32 score tensor for a 1k chunk also blew the budget on size)
-        C = k_cur_ref.shape[1]
-        CB = min(128, C)
+        for i in range(N):
+            _start(c * N + i + (RB - 1) * N)
+        for i in range(N):
+            ok_i, kcp, vcp = _copies(c * N + i)
 
-        def cbody(ci, carry):
-            c0 = ci * CB
-            kc = k_cur_ref[0, pl.dslice(c0, CB)].transpose(1, 0, 2)
-            vc = v_cur_ref[0, pl.dslice(c0, CB)].transpose(1, 0, 2)
-            cpos = cpos_ref[0, pl.dslice(c0, CB)]  # entry positions (-1 pad)
-            fold(kc, vc, cpos, cpos >= 0)
-            return carry
+            @pl.when(ok_i)
+            def _():
+                kcp.wait()
+                vcp.wait()
 
-        lax.fori_loop(0, C // CB, cbody, 0)
+        @pl.when(p * N < livepg_ref[r])
+        def _():
+            slot = c % RB
+            k = k_buf[slot].transpose(1, 0, 2)  # [KH, KB, D]
+            v = v_buf[slot].transpose(1, 0, 2)
+            start = (lopg_ref[r] + p * N) * page_size
+            idx = start + lax.iota(jnp.int32, KB)
+            # slots of pages beyond the live range hold stale ring bytes;
+            # the validity bound (idx >= paged_end there) masks their
+            # scores, but v must ALSO be sanitized: 0 * garbage in the
+            # pij @ v matmul is NaN when the never-fetched slot is NaN
+            valid = idx < paged_end
+            v = jnp.where(valid[None, :, None], v, 0.0)
+            fold(k, v, idx, valid)
+
+    # ---- fused paged-KV write: once per row, at its first cell ----------
+    if fused_write:
+        ps = page_size
+
+        @pl.when(live & (qb == 0) & (p == 0) & (cl_ref[b] > 0))
+        def _():
+            s0 = paged_end              # chunk start (contiguous contract)
+            e0 = s0 + cl_ref[b]
+            lp0 = s0 // ps
+
+            def page_preds(j):
+                page_start = (lp0 + j) * ps
+                pid = pt_ref[b, jnp.minimum(lp0 + j, max_pages - 1)]
+                any_w = (page_start < e0) & (page_start + ps > s0)
+                full = (page_start >= s0) & (page_start + ps <= e0)
+                src = page_start - s0 + fp_pad  # offset into padded chunk
+                return page_start, pid, any_w, full, src
+
+            # interior pages: one page-sized DMA straight from the chunk's
+            # VMEM block; starts all go out first, waits batch below
+            for j in range(max_write_pages):
+                _, pid, any_w, full, src = page_preds(j)
+
+                @pl.when(any_w & full)
+                def _(j=j, pid=pid, src=src):
+                    pltpu.make_async_copy(
+                        kc_ref.at[0, pl.ds(src, ps)], kp_out.at[lyr, pid],
+                        wk_sem.at[j],
+                    ).start()
+                    pltpu.make_async_copy(
+                        vc_ref.at[0, pl.ds(src, ps)], vp_out.at[lyr, pid],
+                        wv_sem.at[j],
+                    ).start()
+
+            # partial head/tail pages (at most one of each): read-modify-
+            # write so slots outside [s0, e0) keep their exact old bytes —
+            # bit-identical to the scatter path's dropped writes
+            for j in range(max_write_pages):
+                page_start, pid, any_w, full, src = page_preds(j)
+
+                @pl.when(any_w & ~full)
+                def _(j=j, page_start=page_start, pid=pid, src=src):
+                    rk = pltpu.make_async_copy(
+                        kp_out.at[lyr, pid], wbuf_k, rk_sem
+                    )
+                    rv = pltpu.make_async_copy(
+                        vp_out.at[lyr, pid], wbuf_v, rv_sem
+                    )
+                    rk.start()
+                    rv.start()
+                    rk.wait()
+                    rv.wait()
+                    gidx = page_start + lax.broadcasted_iota(
+                        jnp.int32, (ps, 1, 1), 0
+                    )
+                    keep = (gidx >= s0) & (gidx < e0)
+                    wbuf_k[...] = jnp.where(
+                        keep, kc_ref[0, pl.ds(src, ps)], wbuf_k[...]
+                    )
+                    wbuf_v[...] = jnp.where(
+                        keep, vc_ref[0, pl.ds(src, ps)], wbuf_v[...]
+                    )
+                    wk = pltpu.make_async_copy(
+                        wbuf_k, kp_out.at[lyr, pid], wk_sem.at[j]
+                    )
+                    wv = pltpu.make_async_copy(
+                        wbuf_v, vp_out.at[lyr, pid], wv_sem.at[j]
+                    )
+                    wk.start()
+                    wv.start()
+                    wk.wait()
+                    wv.wait()
+
+            for j in range(max_write_pages):
+                _, pid, any_w, full, src = page_preds(j)
+
+                @pl.when(any_w & full)
+                def _(j=j, pid=pid, src=src):
+                    pltpu.make_async_copy(
+                        kc_ref.at[0, pl.ds(src, ps)], kp_out.at[lyr, pid],
+                        wk_sem.at[j],
+                    ).wait()
+                    pltpu.make_async_copy(
+                        vc_ref.at[0, pl.ds(src, ps)], vp_out.at[lyr, pid],
+                        wv_sem.at[j],
+                    ).wait()
+
+    # ---- last cell of (b, qb): fold the chunk, write the output ---------
+    @pl.when(live & (p == cnt_ref[r] - 1))
+    def _():
+        CB = _FOLD_BLOCK
+
+        @pl.when(qb * TQ < cl_ref[b])
+        def _():
+            # causal block-skip: entries past the block's last query are
+            # invisible (positions are contiguous), so the loop bound is
+            # min(cl, (qb+1)*TQ) — fully-masked upper-triangle sub-blocks
+            # never execute
+            bound = jnp.minimum(cl_ref[b], (qb + 1) * TQ)
+            n_sub = pl.cdiv(bound, CB)
+
+            def cbody(ci, carry):
+                c0 = fp_pad + ci * CB
+                kc = kc_ref[0, pl.ds(c0, CB)].transpose(1, 0, 2)
+                vc = vc_ref[0, pl.ds(c0, CB)].transpose(1, 0, 2)
+                cpos = cpos_ref[0, pl.ds(c0, CB)]  # -1 pad = invisible
+                fold(kc, vc, cpos, cpos >= 0)
+                return carry
+
+            lax.fori_loop(0, n_sub, cbody, 0)
+
         out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)[..., None]
-        # [G, KH, TQ, D] -> [TQ, NH, D] with h = kh*G + g: stack heads as
-        # (KH, G) then collapse — all major-dim moves
+        # [G, KH, TQ, D] -> [TQ, NH, D] with h = kh*G + g
         out = out.transpose(2, 1, 0, 3).reshape(TQ, NH, D)
         o_ref[0] = out.astype(o_ref.dtype)
 
@@ -168,7 +378,8 @@ def _prefill_kernel(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "sm_scale", "logit_softcap", "interpret", "pages_per_block", "q_block"
+        "sm_scale", "logit_softcap", "interpret", "pages_per_block",
+        "prefetch_pages", "q_block", "fused_write",
     ),
 )
 def ragged_paged_attention_prefill(
@@ -187,129 +398,233 @@ def ragged_paged_attention_prefill(
     logit_softcap: float | None = None,
     interpret: bool = False,
     pages_per_block: int | None = None,
+    prefetch_pages: int | None = None,
     q_block: int = 128,
     layer: jnp.ndarray | int | None = None,
-) -> jnp.ndarray:
-    """Chunked-prefill attention over paged KV + in-register chunk K/V.
+    fused_write: bool = False,
+):
+    """Chunked-prefill attention over paged KV + in-register chunk K/V (v2).
 
     Write-after-attend contract (ops/attention.stale_kv_positions): pool
     slots at positions >= kv_lens - cur_lens are stale — the chunk's K/V
-    arrive in ``k_cur/v_cur`` and fold in at the end of each query block's
-    KV sweep. Returns [B, T, NH, D] in q.dtype; matches the XLA oracle
-    (flash_attention with kv_positions) — tests assert equivalence.
+    arrive in ``k_cur/v_cur`` and fold in at each query block's last cell.
+    Valid chunk entries must be CONTIGUOUS and position-sorted: entry j
+    holds position ``kv_lens - cur_lens + j`` for j < cur_lens (how the
+    scheduler builds every chunk). Returns [B, T, NH, D] in q.dtype —
+    matches the XLA oracle in interpret mode (tests assert equivalence at
+    2e-5 in f32; the fold order differs, so output agreement is numerical
+    — only the fused-write POOL contents are bit-identical, vs the
+    scatter path).
+
+    ``pages_per_block``: KV pages landed contiguously per packed grid cell
+    (auto: ~512 KV slots), folded as ONE wide matmul — this is what fixes
+    the v1 page-granular MXU fragmentation.
+
+    ``prefetch_pages``: page DMAs kept in flight ahead of the cell being
+    consumed (auto: ~2 cells' worth within a ~4 MB VMEM budget per pool
+    array). Ring depth in cells is ``1 + ceil(prefetch/pages_per_block)``.
+
+    ``fused_write=True``: additionally scatters the chunk's K/V into its
+    pool pages from inside the kernel (pools aliased input->output) and
+    returns ``(out, k_pages, v_pages)`` — replacing the post-scan
+    ``write_kv_pages_all_layers`` pass on the prefill path. Untouched pool
+    slots (before the chunk start, after the chunk end, other rows' pages)
+    keep their exact old bytes.
+
+    The grid is RAGGED: live (sequence, query-block, kv-block) cells pack
+    to the front of a 1D grid sized for the bucket's worst case; trailing
+    dead cells alias the last live cell (no DMA, no compute). Sliding
+    windows shrink each query block's live page RANGE, not just the mask,
+    so a 4k-window chunk at 128k context streams ~window bytes.
     """
     B, T, NH, D = q.shape
-    if k_pages.ndim == 4:
+    squeeze = k_pages.ndim == 4
+    if squeeze:
         k_pages = k_pages[None]
         v_pages = v_pages[None]
         layer = 0
-    _, _, page_size, KH, _ = k_pages.shape
+    L, P, page_size, KH, _ = k_pages.shape
     max_pages = page_table.shape[1]
     G = NH // KH
     scale = sm_scale if sm_scale is not None else D**-0.5
     if pages_per_block is None:
-        # ONE page per grid cell: unlike decode (one token of compute per
-        # cell, grouping essential), a prefill cell does TQ x page x NH work
-        # — plenty to hide the per-cell pipeline overhead — and every
-        # unrolled page adds its own scoped-vmem stack for the f32 score
-        # temporaries (measured: N=4 x G=4 blew the 16 MB budget)
-        pages_per_block = max(1, min(128 // page_size, max_pages))
+        # ~512 contiguous KV slots per cell: wide enough to keep the MXU's
+        # 128-lane S dim busy, small enough that the f32 score temporaries
+        # ([KH, TQ, KB]) stay a few MB
+        pages_per_block = max(1, min(512 // page_size, max_pages))
     N = max(1, min(pages_per_block, max_pages))
-    n_pb = -(-max_pages // N)
+    KB = N * page_size
+    n_blocks = -(-max_pages // N)
+    if prefetch_pages is None:
+        prefetch_pages = 2 * N  # two cells ahead
+    block_bytes = KB * KH * D * jnp.dtype(k_pages.dtype).itemsize
+    RB = max(2, 1 + -(-int(prefetch_pages) // N))
+    RB = min(RB, max(2, (4 << 20) // max(block_bytes, 1)))
     TQ = min(q_block, T)
     n_qb = -(-T // TQ)
     if n_qb * TQ != T:
         pad = n_qb * TQ - T
         q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
         positions = jnp.pad(positions, ((0, 0), (0, pad)), constant_values=-1)
-    # pad the chunk operands to a whole number of CB=128 fold sub-blocks
-    # (padded entries carry cpos=-1 -> invisible); without this the kernel's
-    # fori over C // CB would silently drop the tail of a non-multiple chunk
-    CB = 128
-    if T % CB:
-        cpad = CB - T % CB
-        k_cur = jnp.pad(k_cur, ((0, 0), (0, cpad), (0, 0), (0, 0)))
-        v_cur = jnp.pad(v_cur, ((0, 0), (0, cpad), (0, 0), (0, 0)))
+    # chunk buffer layout: [fp_pad front | T entries | tail pad]. The front
+    # pad (one page) makes every fused-write source slice a non-negative
+    # fixed-size offset even for an unaligned head page; the tail pad covers
+    # the last page's overhang and rounds to whole fold sub-blocks.
+    CB = _FOLD_BLOCK
+    FP = page_size
+    # tail must cover both the fold's whole-CB sub-block slices (from FP)
+    # and the fused write's last-page overhang (T + page_size from FP)
+    Cw = FP + -(-(T + page_size) // CB) * CB
+    kc = jnp.zeros((B, Cw, KH, D), k_pages.dtype)
+    vc = jnp.zeros((B, Cw, KH, D), v_pages.dtype)
+    kc = lax.dynamic_update_slice(
+        kc, k_cur.astype(k_pages.dtype), (0, FP, 0, 0)
+    )
+    vc = lax.dynamic_update_slice(
+        vc, v_cur.astype(v_pages.dtype), (0, FP, 0, 0)
+    )
+    cl = jnp.asarray(cur_lens, jnp.int32)
+    cpos = jnp.full((B, Cw), -1, jnp.int32)
+    Tc = k_cur.shape[1]
+    cpos = lax.dynamic_update_slice(
+        cpos,
+        jnp.where(
+            (lax.broadcasted_iota(jnp.int32, (B, Tc), 1) < cl[:, None])
+            & (positions[:, :Tc] >= 0),
+            positions[:, :Tc],
+            -1,
+        ),
+        (0, FP),
+    )
     win = (
         jnp.full((1,), 2**30, jnp.int32)
         if window is None
         else jnp.asarray(window, jnp.int32).reshape(1)
     )
     lyr = jnp.asarray(layer, jnp.int32).reshape(1)
-    cl = jnp.asarray(cur_lens, jnp.int32)
-    Cp = k_cur.shape[1]  # CB-padded chunk length
-    # chunk entry positions: entry j sits at positions[b, j] (valid j <
-    # cur_lens); padded entries (incl. the CB-alignment tail) carry -1 and
-    # are invisible to the fold
-    cpos = jnp.full((B, Cp), -1, jnp.int32)
-    cpos = cpos.at[:, :T].set(
-        jnp.where(
-            (lax.broadcasted_iota(jnp.int32, (B, T), 1) < cl[:, None])
-            & (positions[:, :T] >= 0),
-            positions[:, :T],
-            -1,
-        )
+    MAXW = -(-T // page_size) + 1  # pool pages one chunk can touch
+
+    # ---- ragged cell maps: pack live (b, qb, kv-block) cells ------------
+    lens32 = kv_lens.astype(jnp.int32)
+    pe = lens32 - cl                                   # [B] paged_end
+    qbi = jnp.arange(n_qb, dtype=jnp.int32)
+    # earliest valid query of block qb sits at position pe + qb*TQ; its
+    # window opens the live page range at (that - win + 1) — later query
+    # blocks of a windowed model skip early pages entirely
+    qstart = pe[:, None] + qbi[None, :] * TQ           # [B, n_qb]
+    lo = jnp.clip(qstart - win[0] + 1, 0, None)
+    lo_pg = lo // page_size                            # [B, n_qb]
+    hi_pg = -(-jnp.maximum(pe, 0) // page_size)        # [B]
+    live_pg = jnp.maximum(hi_pg[:, None] - lo_pg, 0)   # [B, n_qb]
+    qlive = (qbi[None, :] * TQ) < cl[:, None]
+    live_pg = jnp.where(qlive, live_pg, 0)
+    # every (b, qb) keeps >= 1 cell so padded rows / dead query blocks
+    # still initialize and write their (zero) output block
+    cells = jnp.clip(-(-live_pg // N), 1, n_blocks).astype(jnp.int32)
+    rflat = cells.reshape(-1)                          # [B*n_qb]
+    Rn = B * n_qb
+    cs = jnp.cumsum(rflat).astype(jnp.int32)
+    starts = cs - rflat
+    n_cells = Rn * n_blocks
+    cidx = jnp.arange(n_cells, dtype=jnp.int32)
+    total = cs[Rn - 1]
+    rrow = jnp.minimum(
+        jnp.searchsorted(cs, cidx, side="right").astype(jnp.int32), Rn - 1
     )
+    dead = cidx >= total
+    # dead cells alias the LAST live cell: index maps repeat, so the
+    # pipeline neither fetches nor writes for them
+    seq_of = jnp.where(dead, B - 1, rrow // n_qb)
+    qb_of = jnp.where(dead, n_qb - 1, rrow % n_qb)
+    blk_of = jnp.where(dead, rflat[Rn - 1] - 1, cidx - starts[rrow])
+    total_arr = cs[Rn - 1:Rn]
 
-    def kv_index(i):
-        def index(b, qb, p, pt, lens, _cl, w, l):
-            return (
-                l[0],
-                pt[b, jnp.minimum(p * N + i, max_pages - 1)],
-                0, 0, 0,
-            )
+    NS = 12  # scalar-prefetch operand count
 
-        return index
+    def qrow(c, *refs):
+        so, qo = refs[5], refs[6]
+        return (so[c], qo[c], 0, 0)
 
-    qrow = lambda b, qb, p, *refs: (b, qb, 0, 0)
-    prow = lambda b, qb, p, *refs: (b, qb)
-    crow = lambda b, qb, p, *refs: (b, 0, 0, 0)
-    crow2 = lambda b, qb, p, *refs: (b, 0)
+    def prow(c, *refs):
+        so, qo = refs[5], refs[6]
+        return (so[c], qo[c])
+
+    def crow(c, *refs):
+        return (refs[5][c], 0, 0, 0)
+
+    def crow2(c, *refs):
+        return (refs[5][c], 0)
+
     in_specs = [
         pl.BlockSpec((1, TQ, NH, D), qrow),
         pl.BlockSpec((1, TQ), prow),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec((1, Cw, KH, D), crow),
+        pl.BlockSpec((1, Cw, KH, D), crow),
+        pl.BlockSpec((1, Cw), crow2),
     ]
-    operands = [q, positions]
-    for i in range(N):
-        in_specs += [
-            pl.BlockSpec((1, 1, page_size, KH, D), kv_index(i)),
-            pl.BlockSpec((1, 1, page_size, KH, D), kv_index(i)),
+    operands = [q, positions, k_pages, v_pages, kc, vc, cpos]
+    out_shapes = [jax.ShapeDtypeStruct((B, n_qb * TQ, NH, D), q.dtype)]
+    out_specs = [pl.BlockSpec((1, TQ, NH, D), qrow)]
+    scratch = [
+        pltpu.VMEM((RB, KB, KH, D), k_pages.dtype),
+        pltpu.VMEM((RB, KB, KH, D), v_pages.dtype),
+        pltpu.SemaphoreType.DMA((RB * N,)),
+        pltpu.SemaphoreType.DMA((RB * N,)),
+    ]
+    io_aliases = {}
+    if fused_write:
+        out_shapes += [
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
         ]
-        operands += [k_pages, v_pages]
-    in_specs += [
-        pl.BlockSpec((1, Cp, KH, D), crow),
-        pl.BlockSpec((1, Cp, KH, D), crow),
-        pl.BlockSpec((1, Cp), crow2),
+        out_specs += [
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ]
+        # operand index counts scalar prefetch: pools sit at NS+2 / NS+3
+        io_aliases = {NS + 2: 1, NS + 3: 2}
+        scratch += [
+            pltpu.SemaphoreType.DMA((MAXW,)),
+            pltpu.SemaphoreType.DMA((MAXW,)),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((page_size, KH, D), k_pages.dtype),
+            pltpu.VMEM((page_size, KH, D), v_pages.dtype),
+        ]
+    scratch += [
+        pltpu.VMEM((G, KH, TQ, D), q.dtype),     # per-group queries
+        pltpu.VMEM((G, KH, TQ), jnp.float32),
+        pltpu.VMEM((G, KH, TQ), jnp.float32),
+        pltpu.VMEM((G, KH, TQ, D), jnp.float32),
     ]
-    operands += [k_cur, v_cur, cpos]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
-        grid=(B, n_qb, n_pb),
+        num_scalar_prefetch=NS,
+        grid=(n_cells,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, TQ, NH, D), qrow),
-        scratch_shapes=[
-            pltpu.VMEM((G, KH, TQ, D), q.dtype),     # per-group queries
-            pltpu.VMEM((G, KH, TQ), jnp.float32),
-            pltpu.VMEM((G, KH, TQ), jnp.float32),
-            pltpu.VMEM((G, KH, TQ, D), jnp.float32),
-        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
         _prefill_kernel, sm_scale=scale, kv_heads=KH,
-        logit_softcap=logit_softcap, pages_per_block=N,
+        logit_softcap=logit_softcap, pages_per_block=N, ring_blocks=RB,
+        n_qb=n_qb, fused_write=fused_write, fp_pad=FP,
+        max_write_pages=MAXW,
     )
-    out = pl.pallas_call(
+    outs = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, n_qb * TQ, NH, D), q.dtype),
+        out_shape=tuple(out_shapes),
         interpret=interpret,
+        input_output_aliases=io_aliases,
         # the default 16 MB scoped-vmem budget is a fraction of v5e's
-        # physical VMEM; the f32 score temporaries of a TQ=128 cell need
+        # physical VMEM; the f32 score temporaries of a TQ x KB cell need
         # more headroom than decode-sized cells
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024
-        ),
+        compiler_params=getattr(
+            pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+        )(vmem_limit_bytes=100 * 1024 * 1024),
         cost_estimate=pl.CostEstimate(
             flops=4 * B * T * NH * D * (max_pages * page_size + T),
             bytes_accessed=(
@@ -319,7 +634,14 @@ def ragged_paged_attention_prefill(
             transcendentals=B * NH * T * (max_pages * page_size + T),
         ),
     )(
-        page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), cl, win,
-        lyr, *operands,
+        page_table.astype(jnp.int32), lens32, cl, win, lyr,
+        seq_of, qb_of, blk_of, cells.reshape(-1), lo_pg.reshape(-1),
+        live_pg.reshape(-1).astype(jnp.int32), total_arr,
+        *operands,
     )
-    return out[:, :T]
+    if fused_write:
+        out, kp_new, vp_new = outs
+        if squeeze:
+            kp_new, vp_new = kp_new[0], vp_new[0]
+        return out[:, :T], kp_new, vp_new
+    return outs[0][:, :T]

@@ -28,7 +28,9 @@ from production_stack_tpu.tracing.context import (
 )
 from production_stack_tpu.tracing.metrics import (
     decode_step_time_hist,
+    interleaved_decode_hist,
     offload_restore_hist,
+    prefill_chunk_hist,
     prefill_time_hist,
     queue_time_hist,
     render_phase_histograms,
@@ -47,7 +49,9 @@ __all__ = [
     "gen_span_id",
     "gen_trace_id",
     "get_collector",
+    "interleaved_decode_hist",
     "offload_restore_hist",
+    "prefill_chunk_hist",
     "prefill_time_hist",
     "queue_time_hist",
     "render_phase_histograms",

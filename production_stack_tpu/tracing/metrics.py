@@ -48,12 +48,27 @@ offload_restore_hist = Histogram(
     "vllm:kv_offload_restore_seconds", RESTORE_BUCKETS,
     "KV offload-tier restore batch duration",
 )
+# dispatch-granular long-context prefill observability (ISSUE 6): per-chunk
+# device wall time, and decode step time per token WHILE a prefill is
+# resident — the pair the Grafana prefill-phase panel charts to show a 32k
+# prompt streaming through without starving co-scheduled decodes
+prefill_chunk_hist = Histogram(
+    "vllm:prefill_chunk_seconds", TPOT_BUCKETS + (5.0, 10.0),
+    "One chunked-prefill dispatch's device wall time",
+)
+interleaved_decode_hist = Histogram(
+    "vllm:interleaved_decode_step_seconds", TPOT_BUCKETS,
+    "Decode time per output token for bursts interleaved with an "
+    "in-flight prefill",
+)
 
 PHASE_HISTOGRAMS = (
     queue_time_hist,
     prefill_time_hist,
     decode_step_time_hist,
     offload_restore_hist,
+    prefill_chunk_hist,
+    interleaved_decode_hist,
 )
 
 

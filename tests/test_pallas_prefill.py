@@ -1,5 +1,9 @@
-"""Pallas flash prefill kernel vs the XLA oracle (ops/attention.flash_attention
-over gathered pages + stale_kv_positions — the write-after-attend contract)."""
+"""Pallas ragged prefill kernel v2 vs the XLA oracle
+(ops/attention.flash_attention over gathered pages + stale_kv_positions —
+the write-after-attend contract), plus the fused paged-KV write's
+bit-identity against the scatter path (ops/attention.write_kv_pages)."""
+
+import dataclasses
 
 import jax.numpy as jnp
 import numpy as np
@@ -9,6 +13,7 @@ from production_stack_tpu.ops.attention import (
     flash_attention,
     gather_kv_pages,
     stale_kv_positions,
+    write_kv_pages,
 )
 from production_stack_tpu.ops.pallas.prefill_attention import (
     ragged_paged_attention_prefill,
@@ -50,7 +55,7 @@ def _oracle(q, kp, vp, pt, positions, kv_lens, k_cur, v_cur, window=None,
     v = jnp.concatenate([vc, v_cur.astype(vc.dtype)], axis=1)
     return flash_attention(
         q, k, v, q_positions=positions, kv_lens=kv_lens,
-        window=window, kv_positions=kv_pos,
+        window=window, logit_softcap=softcap, kv_positions=kv_pos,
     )
 
 
@@ -172,3 +177,231 @@ class TestPrefillKernelVsOracle:
             np.testing.assert_allclose(
                 np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
             )
+
+
+def _case2(B, T, computed, chunks, page=8, maxp=8, P=64, NH=8, KH=2, D=64,
+           seed=0, dtype=jnp.float32):
+    """Like _case but with explicit per-row chunk sizes (0 = padded row)."""
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, T, NH, D), dtype)
+    kp = jnp.asarray(rng.randn(P, page, KH, D), dtype)
+    vp = jnp.asarray(rng.randn(P, page, KH, D), dtype)
+    k_cur = jnp.asarray(rng.randn(B, T, KH, D), dtype)
+    v_cur = jnp.asarray(rng.randn(B, T, KH, D), dtype)
+    pt = jnp.asarray(
+        rng.choice(P, (B * maxp), replace=False).reshape(B, maxp), jnp.int32
+    )
+    positions = np.full((B, T), -1, np.int32)
+    for b in range(B):
+        positions[b, : chunks[b]] = np.arange(
+            computed[b], computed[b] + chunks[b]
+        )
+    kv_lens = jnp.asarray(
+        [computed[b] + chunks[b] for b in range(B)], jnp.int32
+    )
+    cur_lens = jnp.asarray(chunks, jnp.int32)
+    return (q, kp, vp, pt, jnp.asarray(positions), kv_lens, k_cur, v_cur,
+            cur_lens)
+
+
+class TestRaggedGridV2:
+    """The packed ragged grid: mixed-length batches, knob sweeps, and the
+    write-after-attend boundary — all against the XLA oracle."""
+
+    def test_mixed_histories_one_batch(self):
+        """The ragged-scaling shape: one deep history, one shallow, one
+        zero-history, one fully padded row, in a single bucket."""
+        case = _case2(
+            B=4, T=16, computed=(56, 8, 0, 0), chunks=(16, 16, 16, 0),
+            maxp=16, P=96, seed=10,
+        )
+        q, kp, vp, pt, pos, lens, kc, vc, cl = case
+        ref = _oracle(q, kp, vp, pt, pos, lens, kc, vc)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True, q_block=8
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+        np.testing.assert_array_equal(np.asarray(out[3]), 0.0)
+
+    @pytest.mark.parametrize("n,r", [(1, 1), (2, 2), (2, 6), (4, 4)])
+    def test_pipeline_knob_sweep(self, n, r):
+        """pages_per_block / prefetch_pages only shape the memory pipeline,
+        never the numerics."""
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case2(
+            B=2, T=16, computed=(40, 64), chunks=(16, 12), seed=11,
+        )
+        ref = _oracle(q, kp, vp, pt, pos, lens, kc, vc)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True,
+            q_block=8, pages_per_block=n, prefetch_pages=r,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_window_plus_softcap(self):
+        """Sliding window and logit softcap together (the Gemma-2 even-layer
+        shape) — the window also shrinks the live page RANGE per query
+        block, which must not change the numbers."""
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case2(
+            B=2, T=32, computed=(40, 64), chunks=(32, 28), maxp=16, P=96,
+            seed=12,
+        )
+        ref = _oracle(q, kp, vp, pt, pos, lens, kc, vc, window=20,
+                      softcap=30.0)
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, window=20,
+            logit_softcap=30.0, interpret=True, q_block=16,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_stale_pool_slots_at_chunk_boundary_invisible(self):
+        """Write-after-attend masking: the pool slots the chunk WILL occupy
+        (positions >= kv_lens - cur_lens) hold stale garbage during the
+        attention pass; poisoning them must not move the output."""
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case2(
+            B=2, T=16, computed=(24, 8), chunks=(16, 16), seed=13,
+        )
+        out = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True, q_block=8
+        )
+        # poison every slot at/after each row's chunk start in its pages
+        kp_p, vp_p = np.asarray(kp).copy(), np.asarray(vp).copy()
+        page = kp_p.shape[1]
+        for b in range(2):
+            start = int(lens[b] - cl[b])
+            for lp in range(start // page, pt.shape[1]):
+                pid = int(pt[b, lp])
+                s0 = max(start - lp * page, 0)
+                kp_p[pid, s0:] = 1e4
+                vp_p[pid, s0:] = 1e4
+        out_p = ragged_paged_attention_prefill(
+            q, jnp.asarray(kp_p), jnp.asarray(vp_p), pt, pos, lens, kc, vc,
+            cl, interpret=True, q_block=8,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out_p))
+
+
+class TestFusedPagedKVWrite:
+    """fused_write=True must leave the pool BIT-IDENTICAL to the scatter
+    path (write_kv_pages drops padded positions and touches nothing else)
+    while returning the same attention output."""
+
+    def _check(self, case, q_block=8, window=None):
+        q, kp, vp, pt, pos, lens, kc, vc, cl = case
+        plain = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True,
+            q_block=q_block, window=window,
+        )
+        out, kp_f, vp_f = ragged_paged_attention_prefill(
+            q, kp, vp, pt, pos, lens, kc, vc, cl, interpret=True,
+            q_block=q_block, window=window, fused_write=True,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(plain))
+        kp_s, vp_s = write_kv_pages(kp, vp, kc, vc, pt, pos)
+        np.testing.assert_array_equal(np.asarray(kp_f), np.asarray(kp_s))
+        np.testing.assert_array_equal(np.asarray(vp_f), np.asarray(vp_s))
+
+    def test_aligned_chunks(self):
+        self._check(_case2(
+            B=2, T=32, computed=(8, 16), chunks=(32, 28), seed=20,
+        ), q_block=16)
+
+    def test_unaligned_chunk_start(self):
+        """Chunk starts mid-page: the head page is read-modify-written and
+        the prefix slots before the chunk keep their exact old bytes."""
+        self._check(_case2(
+            B=2, T=32, computed=(5, 13), chunks=(32, 19), seed=21,
+        ), q_block=16)
+
+    def test_partial_tail_page_and_padded_row(self):
+        self._check(_case2(
+            B=3, T=16, computed=(8, 3, 0), chunks=(10, 13, 0), seed=22,
+        ))
+
+    def test_with_sliding_window(self):
+        """The window shrinks the READ range; the write must stay whole."""
+        self._check(_case2(
+            B=2, T=16, computed=(40, 24), chunks=(16, 16), seed=23,
+        ), window=12)
+
+    def test_stacked_pools_write_one_layer(self):
+        """Stacked pools + layer index: only layer l's slice changes, and it
+        matches the scatter applied to that slice."""
+        q, kp, vp, pt, pos, lens, kc, vc, cl = _case2(
+            B=2, T=16, computed=(8, 16), chunks=(16, 12), seed=24,
+        )
+        L = 3
+        rng = np.random.RandomState(25)
+        kps = jnp.asarray(rng.randn(L, *kp.shape), kp.dtype)
+        vps = jnp.asarray(rng.randn(L, *vp.shape), vp.dtype)
+        out, kps_f, vps_f = ragged_paged_attention_prefill(
+            q, kps, vps, pt, pos, lens, kc, vc, cl, interpret=True,
+            q_block=8, layer=1, fused_write=True,
+        )
+        ref = _oracle(q, kps[1], vps[1], pt, pos, lens, kc, vc)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+        kp_s, vp_s = write_kv_pages(kps[1], vps[1], kc, vc, pt, pos)
+        np.testing.assert_array_equal(np.asarray(kps_f[1]), np.asarray(kp_s))
+        np.testing.assert_array_equal(np.asarray(vps_f[1]), np.asarray(vp_s))
+        for lyr in (0, 2):  # untouched layers keep every bit
+            np.testing.assert_array_equal(
+                np.asarray(kps_f[lyr]), np.asarray(kps[lyr])
+            )
+            np.testing.assert_array_equal(
+                np.asarray(vps_f[lyr]), np.asarray(vps[lyr])
+            )
+
+
+class TestModelLevelFusedPrefill:
+    """llama forward: the fused-prefill scan (pools as aliased carry, no
+    post-scan scatter) is BIT-identical to the stacked-output + scatter
+    path, and the kernel path tracks the XLA forward within bf16 noise."""
+
+    def test_forward_fused_equals_scatter_path(self):
+        import jax
+
+        from production_stack_tpu.models import llama
+
+        base = llama.PRESETS["llama-debug"]
+        B, page_size, num_pages, chunk = 2, 8, 32, 16
+        rng = np.random.RandomState(0)
+        input_ids = rng.randint(0, base.vocab_size, (B, chunk)).astype(np.int32)
+        pt = np.arange(B * 8, dtype=np.int32).reshape(B, 8)
+
+        def run(cfg):
+            params = llama.init_params(cfg, jax.random.key(0))
+            kp, vp = llama.init_kv_pages(cfg, num_pages, page_size)
+            outs = []
+            for c in range(2):  # chunk 0: no history; chunk 1: 16 computed
+                pos = np.arange(c * chunk, (c + 1) * chunk)[None].repeat(
+                    B, 0
+                ).astype(np.int32)
+                lg, kp, vp = llama.forward(
+                    params, cfg, input_ids=input_ids, positions=pos,
+                    k_pages=kp, v_pages=vp, page_table=pt,
+                    kv_lens=np.full((B,), (c + 1) * chunk, np.int32),
+                )
+                outs.append(np.asarray(lg))
+            return outs, np.asarray(kp), np.asarray(vp)
+
+        fused = dataclasses.replace(base, attn_impl="pallas_interpret")
+        plain = dataclasses.replace(
+            base, attn_impl="pallas_interpret", prefill_fused_kv_write=False
+        )
+        o_f, kp_f, vp_f = run(fused)
+        o_p, kp_p, vp_p = run(plain)
+        np.testing.assert_array_equal(kp_f, kp_p)
+        np.testing.assert_array_equal(vp_f, vp_p)
+        for a, b in zip(o_f, o_p):
+            np.testing.assert_array_equal(a, b)
+        # and the kernel path tracks XLA within bf16 tolerance
+        o_x, _, _ = run(dataclasses.replace(base, attn_impl="xla"))
+        for a, b in zip(o_f, o_x):
+            np.testing.assert_allclose(a, b, rtol=3e-2, atol=3e-2)

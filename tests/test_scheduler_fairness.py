@@ -250,3 +250,50 @@ def test_runahead_prefill_is_disjoint_from_chain():
         (len(chain.kv_lens), sched.decode_steps * chain.bursts), 7, np.int32
     )
     sched.apply_step(chain, toks, eos_token_id=-1)
+
+
+def test_interleave_gate_on_resident_decode_demand():
+    """A big resident decode batch must interleave even when the prefill
+    backlog is SHORT (< 2 chunks): each skipped interleave stalls that many
+    live streams for a whole chunk. The old backlog-only gate made them
+    wait out the entire prefill."""
+    sched = _mk_scheduler(prefill_batch=2)
+    # 4 sequences already decoding (>= max(2, prefill_batch) demand)
+    for i in range(4):
+        sched.add(Sequence(f"d{i}", prompt_ids=[1] * 8,
+                           params=SamplingParams(max_tokens=64,
+                                                 ignore_eos=True)))
+    kinds = _drive(sched, steps=1)
+    assert kinds == ["prefill"]
+    # one SHORT prompt arrives: backlog (24) < 2 * prefill_chunk (32)
+    sched.add(Sequence("short", prompt_ids=[2] * 24,
+                       params=SamplingParams(max_tokens=4, ignore_eos=True)))
+    kinds = _drive(sched, steps=4)
+    # the decode batch must not trail the whole prefill: alternation starts
+    # within one chunk of the prompt
+    assert "decode" in kinds[:2], kinds
+
+
+def test_lone_long_prompt_never_interleaves_without_decoders():
+    """No decode-ready sequences -> no interleave slots: a lone long prompt
+    runs chunk after chunk with zero decode dispatches in between."""
+    sched = _mk_scheduler()
+    sched.add(Sequence("long", prompt_ids=[2] * 128,
+                       params=SamplingParams(max_tokens=4, ignore_eos=True)))
+    kinds = _drive(sched, steps=8)  # 128 / 16 = 8 chunks
+    assert kinds == ["prefill"] * 8, kinds
+
+
+def test_small_decode_batch_short_backlog_keeps_strict_priority():
+    """One decoding row + a short prefill flurry (backlog < 2 chunks,
+    demand < prefill_batch): the fast strict-priority path clears the
+    flurry first — alternating would pay a fetch round trip per burst."""
+    sched = _mk_scheduler(prefill_batch=2)
+    dec = Sequence("dec", prompt_ids=[1] * 8,
+                   params=SamplingParams(max_tokens=64, ignore_eos=True))
+    sched.add(dec)
+    _drive(sched, steps=1)
+    sched.add(Sequence("p0", prompt_ids=[2] * 24,
+                       params=SamplingParams(max_tokens=4, ignore_eos=True)))
+    batch = sched.schedule()
+    assert batch.kind == "prefill"  # 24 < 2*16 backlog, demand 1 < 2
