@@ -742,6 +742,35 @@ def http_stack_metrics(on_tpu: bool, model_dir: "str | None" = None) -> dict:
             "http_concurrency": conc,
         })
 
+        # ---- sub-phase 2b: flight-recorder overhead (ISSUE 7) -------------
+        # The recorder rides the engine dispatch path (one dict append per
+        # sched/step event); acceptance: decode throughput with it ENABLED
+        # must stay >= 0.98x recorder-off. Measured in-process on the live
+        # engine: flip the recorder, rerun the identical decode passes,
+        # flip back. Ratio = on / off (>= 1.0 means no measurable cost).
+        try:
+            from production_stack_tpu.tracing import get_flightrecorder
+
+            _fr = get_flightrecorder()
+            _fr.set_enabled(False)
+            try:
+                off_passes = [decode_pass()[0] for _ in range(n_passes)]
+            finally:
+                _fr.set_enabled(True)
+            fr_off_tps = float(np.median(off_passes))
+            fr_ratio = decode_tps / fr_off_tps if fr_off_tps else None
+            out["flightrecorder_overhead_ratio"] = (
+                round(fr_ratio, 4) if fr_ratio is not None else None
+            )
+            if fr_ratio is not None and fr_ratio < 0.98:
+                print(
+                    f"WARNING: flight recorder costs "
+                    f"{(1 - fr_ratio) * 100:.1f}% decode throughput "
+                    f"(ratio {fr_ratio:.4f} < 0.98 acceptance)"
+                )
+        except Exception as e:  # noqa: BLE001 - fail-soft like every phase
+            print(f"flight-recorder overhead phase failed: {e}")
+
         # ---- sub-phase 2c: decode interference from a long prefill --------
         # Sustained decode streams at fixed concurrency, measured twice:
         # inter-token gaps with NO prefill in flight, then gaps inside the

@@ -242,6 +242,12 @@ class EngineServer:
             self._engine_accepts_trace = False
             self._engine_accepts_shed_exempt = False
         self.start_time = time.time()
+        # device telemetry sampler (engine/devicemon.py): HBM per device,
+        # KV pool vs headroom, compile activity, step duty cycle — rendered
+        # into /metrics on scrape (duck-typed engines degrade gracefully)
+        from production_stack_tpu.engine.devicemon import DeviceMonitor
+
+        self.devmon = DeviceMonitor(self.engine)
         # graceful drain (SIGTERM): /health flips to 503 so readiness
         # probes / router health checks pull the pod from rotation, new
         # generation requests are refused, and in-flight ones finish
@@ -285,6 +291,12 @@ class EngineServer:
         """Stop accepting generation work and wait for the engine to go
         idle (in-flight requests complete) or ``timeout`` to pass."""
         self.draining = True
+        # SIGTERM anomaly dump FIRST (forced — this process is going away):
+        # the pre-drain scheduler/KV window is what a rolling-restart
+        # postmortem needs, and waiting out the drain would overwrite it
+        from production_stack_tpu.tracing import get_flightrecorder
+
+        get_flightrecorder().dump("sigterm_drain", force=True)
         logger.info("draining: refusing new requests, waiting for %d in flight",
                     self.engine.scheduler.num_running())
         deadline = time.time() + timeout
@@ -421,10 +433,79 @@ class EngineServer:
         # per-phase histograms (tracing subsystem): queue wait, prefill,
         # time-per-output-token, offload restore — the dashboard's
         # phase-breakdown panels and bench.py's attribution read these
-        from production_stack_tpu.tracing import render_phase_histograms
+        from production_stack_tpu.tracing import (
+            render_collector_metrics,
+            render_flightrecorder_metrics,
+            render_phase_histograms,
+        )
 
         lines.extend(render_phase_histograms(f'model_name="{m}"'))
+        # span-loss + flight-recorder health (trace debugging is only
+        # trustworthy when its own drops are measurable)
+        lines.extend(render_collector_metrics(f'model_name="{m}"'))
+        lines.extend(render_flightrecorder_metrics(f'model_name="{m}"'))
+        # TPU device telemetry (engine/devicemon.py): HBM in use/limit per
+        # device, KV pool vs headroom, compile cache + seconds, duty cycle
+        try:
+            lines.extend(self.devmon.metrics_lines(m))
+        except Exception:  # noqa: BLE001 - telemetry must never break a scrape
+            logger.exception("device telemetry sampling failed")
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def slo_records(self, request: web.Request) -> web.Response:
+        """Per-request SLO terminal records since a cursor (docs/
+        observability.md). The router's stats scraper polls this with
+        ``?since=<last seq>`` each scrape interval and aggregates the
+        records into per-model/backend SLO attainment counters; the log is
+        a bounded ring, so a scraper further behind than its capacity sees
+        a gap (records dropped, not blocked)."""
+        try:
+            since = int(request.query.get("since", "0"))
+        except (TypeError, ValueError):
+            return web.json_response({"error": "since must be an int"}, status=400)
+        log = getattr(self.engine, "slo_records", None)
+        records: list = []
+        # an exhausted snapshot retry must NOT report head=0 — the scraper
+        # reads head < cursor as "engine restarted" and would reset its
+        # cursor, double-counting every retained record next round; head ==
+        # the caller's cursor is the safe "nothing new" answer
+        head = since
+        if log:
+            # the engine thread appends concurrently; iterating a mutating
+            # deque raises RuntimeError — snapshot with a bounded retry
+            for _ in range(3):
+                try:
+                    snap = list(log)
+                    # max, not snap[-1]: the device thread and the event
+                    # loop (api-shed records) both append, so the tail can
+                    # momentarily be out of seq order
+                    head = max((r["seq"] for r in snap), default=0)
+                    records = [r for r in snap if r["seq"] > since]
+                    break
+                except RuntimeError:
+                    continue
+        elif log is not None:
+            head = 0  # empty log: a true fresh-counter signal is correct
+        next_cursor = max((r["seq"] for r in records), default=since)
+        return web.json_response({
+            "model": self.cfg.name,
+            "since": since,
+            "next": next_cursor,
+            # current max record seq: a head BELOW the caller's cursor means
+            # this process restarted (fresh counter) — the scraper resets its
+            # cursor instead of waiting for the new counter to catch up
+            "head": head,
+            "records": records,
+        })
+
+    async def flightrecorder(self, request: web.Request) -> web.Response:
+        """Flight-recorder export (debug surface; docs/observability.md).
+        Filters: ?request_id= ?trace_id= ?kind= ?since_step= ?until_step=
+        ?limit=."""
+        from production_stack_tpu.tracing import flightrecorder
+
+        payload, status = flightrecorder.export_for_query(request.query)
+        return web.json_response(payload, status=status)
 
     async def stats(self, request: web.Request) -> web.Response:
         """JSON engine state snapshot (saturation, queue depths, KV pool,
@@ -458,6 +539,7 @@ class EngineServer:
         serving stats are untouched."""
         from production_stack_tpu.tracing import (
             get_collector,
+            get_flightrecorder,
             reset_phase_histograms,
         )
 
@@ -466,6 +548,7 @@ class EngineServer:
         _latency_hist.reset()
         reset_phase_histograms()
         get_collector().reset()
+        get_flightrecorder().reset()
         waits = getattr(self.engine, "admission_wait_ms", None)
         if waits is not None:
             waits.clear()
@@ -574,6 +657,11 @@ class EngineServer:
             # two writers on one dict slot would drop increments)
             if hasattr(self.engine, "api_requests_shed"):
                 self.engine.api_requests_shed += 1
+            note_shed = getattr(self.engine, "note_api_shed", None)
+            if note_shed is not None:
+                # flight-recorder shed event + burst trigger + SLO terminal
+                # record (no Sequence exists for a fast-path shed)
+                note_shed(request.headers.get("X-Request-Id"))
             retry = getattr(self.engine, "shed_retry_after", lambda: 1.0)()
             return _shed_response(
                 retry,
@@ -1256,13 +1344,19 @@ class EngineServer:
         r.add_get("/v1/models", self.models)
         r.add_get("/metrics", self.metrics)
         r.add_get("/stats", self.stats)
+        # SLO terminal records: an intra-cluster read-only surface like
+        # /stats (the router's scraper consumes it in production, so it is
+        # NOT debug-gated; it carries request ids and timings, no content)
+        r.add_get("/slo_records", self.slo_records)
         if self.cfg.enable_debug_endpoints:
             # unauthenticated debug surfaces — benchmark/debug runs only.
             # /v1/traces is read-only but exposes request ids and timings;
             # wiping the hop-quantile sample windows (/metrics/reset)
             # corrupts live observability, so production servers register
-            # neither
+            # neither. The flight recorder additionally exposes scheduler
+            # internals, so it rides the same gate.
             r.add_get("/v1/traces", self.traces)
+            r.add_get("/v1/debug/flightrecorder", self.flightrecorder)
             r.add_post("/metrics/reset", self.metrics_reset)
         r.add_post("/abort", self.abort)
         r.add_post("/tokenize", self.tokenize)

@@ -217,6 +217,26 @@ class EngineConfig:
     # turns span recording off entirely. Buffer size bounds tracer memory.
     trace_sample_rate: float = 1.0
     trace_buffer_size: int = 4096
+    # engine flight recorder (tracing/flightrecorder.py,
+    # docs/observability.md): a bounded ring of structured engine events —
+    # scheduler dispatches, KV evict/spill/restore, admission sheds, step
+    # timings, JAX compiles — exported via the debug-gated
+    # GET /v1/debug/flightrecorder and auto-dumped to disk on anomalies.
+    # Default ON: the hot-path cost is one dict append per dispatch
+    # (bench.py asserts < 2% decode overhead as flightrecorder_overhead_ratio).
+    flight_recorder: bool = True
+    flight_recorder_capacity: int = 8192
+    # anomaly-dump directory (engine crash / SIGTERM drain / shed burst /
+    # TTFT watermark breach write a JSON window here for postmortems); None
+    # falls back to $PSTPU_FLIGHTRECORDER_DIR, else disk dumps are disabled
+    # (the in-memory ring and the debug endpoint still work)
+    flight_recorder_dump_dir: Optional[str] = None
+    # TTFT breach watermark in ms: a request finishing with TTFT above this
+    # triggers a (rate-limited) anomaly dump; 0 disables
+    flight_recorder_ttft_watermark_ms: float = 0.0
+    # shed-burst trigger: this many admission sheds within a 5 s window
+    # dump the recorder (the overload-chaos postmortem); 0 disables
+    flight_recorder_shed_burst: int = 10
 
     @property
     def name(self) -> str:
@@ -261,6 +281,24 @@ _FLAG_HELP = {
     "warm_start_max_pages": (
         "cap on pages a warm-start manifest covers (highest-reuse-score "
         "chain heads kept first)"
+    ),
+    "flight_recorder": (
+        "record scheduler/KV/shed/compile engine events into a bounded ring "
+        "(GET /v1/debug/flightrecorder with --enable-debug-endpoints; "
+        "auto-dumped on anomalies; --no-flight-recorder disables)"
+    ),
+    "flight_recorder_dump_dir": (
+        "directory anomaly dumps (engine crash, SIGTERM drain, shed burst, "
+        "TTFT watermark breach) are written to as JSON; default "
+        "$PSTPU_FLIGHTRECORDER_DIR, unset = no disk dumps"
+    ),
+    "flight_recorder_ttft_watermark_ms": (
+        "dump the flight recorder when a request's TTFT exceeds this many "
+        "milliseconds (rate-limited; 0 = off)"
+    ),
+    "flight_recorder_shed_burst": (
+        "dump the flight recorder when this many admission sheds land "
+        "within 5 s (0 = off)"
     ),
 }
 

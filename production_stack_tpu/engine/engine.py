@@ -108,6 +108,23 @@ class LLMEngine:
             2 * model_cfg.num_layers * cfg.page_size * model_cfg.num_kv_heads
             * model_cfg.head_dim * 2  # k+v, bf16
         )
+        # device telemetry (engine/devicemon.py): page footprint for the KV
+        # pool-vs-headroom gauges, and the jax.monitoring compile listener
+        # feeding vllm:compile_seconds_total + flight-recorder compile events
+        self.kv_page_bytes = page_bytes
+        from production_stack_tpu.engine import devicemon
+
+        devicemon.install_compile_listener()
+        # engine flight recorder (tracing/flightrecorder.py): bounded ring of
+        # scheduler/KV/shed/step/compile events, auto-dumped on anomalies
+        self._fr = tracing.configure_flightrecorder(
+            capacity=cfg.flight_recorder_capacity,
+            enabled=cfg.flight_recorder,
+            dump_dir=(
+                cfg.flight_recorder_dump_dir
+                or os.environ.get("PSTPU_FLIGHTRECORDER_DIR")
+            ),
+        )
         num_pages = cfg.num_pages or max(64, int(cfg.kv_cache_memory_gb * 1e9 / page_bytes))
         from production_stack_tpu.parallel.mesh import make_mesh
 
@@ -338,6 +355,22 @@ class LLMEngine:
             "wait": 0.0, "schedule": 0.0, "step": 0.0, "apply": 0.0,
             "emit": 0.0, "chain_dispatch": 0.0, "chain_fetch": 0.0,
         }
+        # per-request SLO accounting (ISSUE 7 tentpole b): every finished
+        # sequence appends a terminal record (queue wait, TTFT, tokens,
+        # inter-token p99, KV pages peak, outcome) to this bounded log; the
+        # router scrapes GET /slo_records with a cursor and aggregates the
+        # records into per-model/backend SLO attainment counters. Single
+        # writer (this device thread); /slo_records snapshots with a retry.
+        import itertools
+
+        self.slo_records: collections.deque = collections.deque(maxlen=2048)
+        self._slo_seq = itertools.count(1)
+        # engine step index: every dispatched batch increments it; flight
+        # recorder events carry it so a debug window can be cut by step range
+        self.step_idx = 0
+        # shed-burst anomaly trigger (flight recorder): timestamps of recent
+        # sheds across BOTH writer threads (deque.append is thread-safe)
+        self._shed_times: collections.deque = collections.deque(maxlen=64)
 
     # -- admission control / load shedding ----------------------------------
 
@@ -360,6 +393,59 @@ class LLMEngine:
             or self.scheduler.max_waiting_seqs > 0
         )
 
+    def _note_shed(self, reason: str, seq: "Optional[Sequence]" = None) -> None:
+        """Flight-recorder shed event + burst detection: a burst of sheds is
+        THE overload postmortem moment — dump the surrounding scheduler/KV
+        window while it is still in the ring. Thread-safe (called from the
+        device thread for engine sheds and the event loop for API-layer
+        fast-path sheds)."""
+        fr = self._fr
+        now = time.monotonic()
+        self._shed_times.append(now)
+        if not fr.enabled:
+            return
+        tr = getattr(seq, "trace", None)
+        fr.record(
+            "shed", step=self.step_idx, reason=reason,
+            seq_id=seq.seq_id if seq is not None else None,
+            waiting=self.scheduler.num_waiting(),
+            running=self.scheduler.num_running(),
+            trace_id=getattr(tr, "trace_id", None),
+        )
+        burst = self.cfg.flight_recorder_shed_burst
+        if burst > 0:
+            recent = sum(1 for t in list(self._shed_times) if now - t <= 5.0)
+            if recent >= burst:
+                # async: sheds fire on the event loop (API fast path) and
+                # the device thread — neither may pay the ring serialization
+                fr.dump_async("shed_burst")
+
+    def note_api_shed(self, request_id: Optional[str] = None) -> None:
+        """API-layer fast-path shed (api_server owns that counter; the event,
+        burst accounting, AND the SLO terminal record land here so neither
+        the recorder nor the router's availability counters are blind to the
+        most common overload shed — no Sequence ever exists for these).
+        Thread-safe: deque.append and the itertools cursor are atomic, and
+        this is the only writer on the event loop."""
+        self._note_shed("api_queue_full")
+        self.slo_records.append({
+            "seq": next(self._slo_seq),
+            "request_id": request_id or "unknown",
+            "model": self.cfg.name,
+            "outcome": "shed",
+            "finish_reason": "shed",
+            "queue_ms": 0.0,
+            "ttft_ms": None,
+            "e2e_ms": None,
+            "prompt_tokens": 0,
+            "output_tokens": 0,
+            "cached_tokens": 0,
+            "itl_p99_ms": None,
+            "kv_pages_peak": 0,
+            "trace_id": None,
+            "t": time.time(),
+        })
+
     def _shed_expired(self) -> None:
         """Shed waiting requests past the queue deadline: finish with reason
         'shed' and emit the terminal output so the consumer (blocked on its
@@ -372,6 +458,7 @@ class LLMEngine:
                 continue
             self.scheduler._finish(s, "shed")
             self.requests_shed["queue_deadline"] += 1
+            self._note_shed("queue_deadline", s)
             self._emit(s, "")
 
     def _recent_arrival_rate(self, window: float = 1.0) -> float:
@@ -675,6 +762,7 @@ class LLMEngine:
         if sched.saturated() and not seq.shed_exempt:
             sched._finish(seq, "shed")
             self.requests_shed["queue_full"] += 1
+            self._note_shed("queue_full", seq)
             self._emit(seq, "")
             return
         sched.add(seq)
@@ -708,6 +796,7 @@ class LLMEngine:
             self.loop_seconds["schedule"] += time.perf_counter() - t0
             if batch is None:
                 continue
+            self._record_sched_event(batch)
             if batch.kind == "prefill":
                 self._note_first_dispatch(batch)
             fetched = True
@@ -919,8 +1008,15 @@ class LLMEngine:
                 else:
                     ids, _ = self.runner.step(inp)
                     tokens = np.asarray(ids)
-            except Exception:
+            except Exception as step_err:
                 logger.exception("engine step failed; aborting batch")
+                # postmortem: the window of scheduler/KV/compile events that
+                # led INTO this failure, while it is still in the ring
+                self._fr.record(
+                    "error", step=self.step_idx, batch_kind=batch.kind,
+                    error=repr(step_err)[:500],
+                )
+                self._fr.dump("engine_step_error", force=True)
                 if self.cfg.distributed_num_processes > 1:
                     # multi-host: catch-and-continue would leave the leader
                     # serving while followers are dead or desynced (a broadcast
@@ -945,6 +1041,15 @@ class LLMEngine:
                 continue
             step_wall = time.perf_counter() - t_step - inline_ae
             self.loop_seconds["step"] += step_wall
+            if self._fr.enabled:
+                # runner step timing, dispatch-granular: a fetched step's
+                # wall is real device time; a skip-fetch dispatch's wall is
+                # enqueue-only (the trailing fetched step absorbs its compute)
+                self._fr.record(
+                    "step", step=self.step_idx, batch_kind=batch.kind,
+                    wall_ms=round(step_wall * 1000, 3), bursts=batch.bursts,
+                    fetched=fetched,
+                )
             if fetched:
                 self._unfetched.clear()  # a real fetch retires prior dispatches
                 # dispatch-granular prefill-phase observability (the
@@ -967,6 +1072,33 @@ class LLMEngine:
             if tokens is not None:
                 self._apply_and_emit(batch, tokens, lp_data)
         logger.info("engine loop exited")
+
+    def _record_sched_event(self, batch) -> None:
+        """Flight-recorder "sched" event: the batch composition and the
+        interleave-gate inputs that produced it, stamped with the step index
+        and the members' trace ids so a slow request's spans cross-link to
+        the exact dispatches that served (or starved) it."""
+        self.step_idx += 1
+        fr = self._fr
+        if not fr.enabled:
+            return
+        trace_ids = [
+            s.trace.trace_id
+            for s in batch.seqs
+            if s.trace is not None and getattr(s.trace, "sampled", False)
+        ][:4]
+        fr.record(
+            "sched", step=self.step_idx, batch_kind=batch.kind,
+            rows=len(batch.seqs), bursts=batch.bursts,
+            chunk_tokens=sum(batch.chunk_sizes) if batch.chunk_sizes else 0,
+            seq_ids=[s.seq_id for s in batch.seqs[:8]],
+            trace_ids=trace_ids,
+            gate=getattr(self.scheduler, "last_gate", None),
+            running=self.scheduler.num_running(),
+            waiting=self.scheduler.num_waiting(),
+            kv_usage=round(self.kv.usage(), 4),
+            trace_id=trace_ids[0] if trace_ids else None,
+        )
 
     def _note_first_dispatch(self, batch) -> None:
         """Record the admission-wait hop (arrival -> first prefill dispatch)
@@ -1011,6 +1143,7 @@ class LLMEngine:
             )
             if ra is None:
                 break
+            self._record_sched_event(ra)
             self._note_first_dispatch(ra)
             self.runahead_prefill_dispatches_total += 1
             inp = StepInput(
@@ -1234,6 +1367,58 @@ class LLMEngine:
                 anchor + ft, decode_s, **attrs,
             )
 
+    def _record_slo(self, seq: Sequence, error: bool = False) -> None:
+        """Attribute the finished sequence its SLO terminal record: queue
+        wait, TTFT, token counts, inter-token p99, peak KV footprint, and the
+        terminal outcome. Appended to the bounded ``slo_records`` log the
+        router scrapes (GET /slo_records) and mirrored as a flight-recorder
+        event so anomaly dumps carry the requests that were in flight."""
+        seq.slo_done = True
+        end = seq.finish_time or time.monotonic()
+        fd, ft = seq.first_dispatch_time, seq.first_token_time
+        reason = "error" if error else (seq.finish_reason or "error")
+        outcome = (
+            "ok" if reason in ("stop", "length", "tool_calls") else reason
+        )
+        itl_p99_ms = None
+        if seq.itl_samples:
+            s = sorted(seq.itl_samples)
+            itl_p99_ms = round(
+                s[min(len(s) - 1, int(len(s) * 0.99))] * 1000, 3
+            )
+        ttft_ms = (
+            round((ft - seq.arrival_time) * 1000, 3) if ft is not None else None
+        )
+        rec = {
+            "seq": next(self._slo_seq),
+            "request_id": seq.seq_id,
+            "model": self.cfg.name,
+            "outcome": outcome,
+            "finish_reason": reason,
+            "queue_ms": round(((fd if fd is not None else end)
+                               - seq.arrival_time) * 1000, 3),
+            "ttft_ms": ttft_ms,
+            "e2e_ms": round((end - seq.arrival_time) * 1000, 3),
+            "prompt_tokens": len(seq.prompt_ids),
+            "output_tokens": len(seq.output_ids),
+            "cached_tokens": seq.num_cached,
+            "itl_p99_ms": itl_p99_ms,
+            "kv_pages_peak": seq.pages_peak,
+            "trace_id": getattr(seq.trace, "trace_id", None),
+            "t": time.time(),
+        }
+        self.slo_records.append(rec)
+        fr = self._fr
+        if fr.enabled:
+            fr.record(
+                "slo", step=self.step_idx, trace_id=rec["trace_id"],
+                request_id=seq.seq_id, outcome=outcome, ttft_ms=ttft_ms,
+                itl_p99_ms=itl_p99_ms, output_tokens=rec["output_tokens"],
+            )
+            watermark = self.cfg.flight_recorder_ttft_watermark_ms
+            if watermark > 0 and ttft_ms is not None and ttft_ms > watermark:
+                fr.dump_async("ttft_breach")  # off the device thread
+
     def _emit(
         self,
         seq: Sequence,
@@ -1242,11 +1427,28 @@ class LLMEngine:
         error: bool = False,
         logprobs: Optional[list] = None,
     ) -> None:
+        if tokens:
+            # inter-token latency accounting for the SLO terminal record: a
+            # burst emit of k tokens contributes its gap/k, so the p99 below
+            # approximates what a streaming client measures. Capped — a long
+            # stream must not grow an unbounded list (the p99 of the first
+            # 4096 emits is representative; steady-state decode is stationary)
+            now_m = time.monotonic()
+            if seq.last_emit_time is not None and len(seq.itl_samples) < 4096:
+                seq.itl_samples.append(
+                    (now_m - seq.last_emit_time) / len(tokens)
+                )
+            seq.last_emit_time = now_m
         if seq.finished and not seq.trace_done:
             try:
                 self._record_phase_trace(seq)
             except Exception:  # noqa: BLE001 - tracing must never break serving
                 logger.exception("phase trace recording failed")
+        if seq.finished and not seq.slo_done:
+            try:
+                self._record_slo(seq, error=error)
+            except Exception:  # noqa: BLE001 - accounting must never break serving
+                logger.exception("SLO terminal record failed")
         with self._lock:
             entry = self._outputs.get(seq.seq_id)
         if entry is None:

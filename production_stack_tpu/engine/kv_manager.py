@@ -20,6 +20,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+from production_stack_tpu.tracing import get_flightrecorder
+
 
 def chunk_hash(prev_hash: bytes, tokens: Sequence[int]) -> bytes:
     h = hashlib.blake2b(prev_hash, digest_size=16)
@@ -196,6 +198,12 @@ class KVPageManager:
         if self.num_free() < n:
             return None
         out, spill = [], []
+        # flight-recorder accounting for this allocation's evictions (one
+        # event per evicting allocate call, not per page — the batch IS the
+        # engine-level action); scores only gathered when the recorder is on
+        fr = get_flightrecorder()
+        n_evicted = n_hot = 0
+        evict_scores: list = []
         for _ in range(n):
             if self.free_list:
                 pid = self.free_list.pop()
@@ -203,8 +211,12 @@ class KVPageManager:
                 pid = self._pop_coldest()
                 info = self.pages[pid]
                 self.evicted_pages_total += 1
+                n_evicted += 1
+                if fr.enabled and len(evict_scores) < 8:
+                    evict_scores.append(round(self._evict_score(info), 4))
                 if info.hits > 0:
                     self.evicted_hot_pages_total += 1
+                    n_hot += 1
                 if info.hash is not None:
                     # already-offloaded pages (proactive spill / earlier
                     # restore) skip the spill batch — their blob is in the
@@ -248,6 +260,16 @@ class KVPageManager:
                     "engine.kv_spill", ctx.child(), t_wall,
                     time_mod.perf_counter() - t0, pages=len(spill),
                 )
+        if n_evicted and fr.enabled:
+            from production_stack_tpu import tracing as _tr
+
+            ctx = _tr.current_context()
+            fr.record(
+                "kv", op="evict", pages=n_evicted, hot=n_hot,
+                spilled=len(spill), victim_scores=evict_scores,
+                usage=round(self.usage(), 4),
+                trace_id=ctx.trace_id if ctx is not None else None,
+            )
         return out
 
     def free(self, page_ids: Sequence[int]) -> None:
@@ -313,6 +335,11 @@ class KVPageManager:
             # call (the tier may have recovered)
             self._spill_dirty = True
         self.proactive_spilled_pages_total += n
+        if n:
+            get_flightrecorder().record(
+                "kv", op="spill", pages=n, planned=len(batch),
+                usage=round(self.usage(), 4),
+            )
         return n
 
     # -- prefix cache -------------------------------------------------------
@@ -422,6 +449,11 @@ class KVPageManager:
                     "engine.kv_restore", ctx.child(), t_wall, dt,
                     pages_planned=n_restore, pages_restored=restored,
                 )
+            tracing.get_flightrecorder().record(
+                "kv", op="restore", pages_planned=n_restore,
+                pages_restored=restored, seconds=round(dt, 4),
+                trace_id=ctx.trace_id if ctx is not None else None,
+            )
         # stitch the final chain: a failed restore truncates it there;
         # shares past the truncation un-ref, unused restore slots free
         ri = 0
@@ -524,6 +556,10 @@ class KVPageManager:
             restored += 1
         # hashed pages land in the evictable pool; failed ones free outright
         self.free(pids)
+        if restored:
+            get_flightrecorder().record(
+                "kv", op="warm_restore", pages=restored, planned=len(todo)
+            )
         return restored
 
     def register_filled(

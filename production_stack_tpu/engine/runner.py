@@ -267,9 +267,23 @@ class ModelRunner:
             )
         return staged
 
+    def _note_program_variant(self, family: str, sig) -> None:
+        """Flight-recorder marker at a jit-cache miss: a NEW program variant
+        is about to trace + compile (the actual XLA compile seconds land via
+        the jax.monitoring listener in engine/devicemon.py — this event ties
+        them to WHICH serving shape caused the compile). Steady-state serving
+        should record none of these; a stream of them mid-traffic means the
+        shape bucketing regressed and the engine is retracing."""
+        from production_stack_tpu.tracing import get_flightrecorder
+
+        get_flightrecorder().record(
+            "compile", event="program_variant", family=family, sig=repr(sig)
+        )
+
     def _get_step(self, want_lp: bool, want_pen: bool):
         sig = (want_lp, want_pen)
         if sig not in self._steps:
+            self._note_program_variant("step", sig)
             rep, n = self._rep, None
             outs = (rep, n, rep, rep, rep, n, n) if want_lp else (rep, n, n, n)
             self._steps[sig] = jax.jit(
@@ -332,6 +346,7 @@ class ModelRunner:
         want_pen = "pen" in s
         sig = (k, want_logprobs, want_pen)
         if sig not in self._multi_steps:
+            self._note_program_variant("multi_step", sig)
             rep, n = self._rep, None
             outs = (
                 (rep, rep, rep, rep, rep, n, n)
@@ -473,6 +488,7 @@ class ModelRunner:
         """
         sig = (steps, spec_k, ngram)
         if sig not in self._spec_fns:
+            self._note_program_variant("spec_step", sig)
             self._spec_fns[sig] = jax.jit(
                 functools.partial(
                     _spec_fn, self._forward, self.cfg, steps, spec_k, ngram

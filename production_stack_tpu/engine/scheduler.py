@@ -71,6 +71,9 @@ class Sequence:
     arrival_time: float = field(default_factory=time.monotonic)
     output_ids: list[int] = field(default_factory=list)
     pages: list[int] = field(default_factory=list)
+    # high-watermark of pages ever owned (SLO terminal records report it —
+    # the request's real KV footprint, which free() at finish erases)
+    pages_peak: int = 0
     num_computed: int = 0          # prompt tokens already prefilled (incl. cached)
     num_cached: int = 0            # tokens served from the prefix cache
     finished: bool = False
@@ -92,6 +95,13 @@ class Sequence:
     trace: Optional[object] = None
     trace_done: bool = False       # phase spans recorded (guard against dupes)
     finish_time: Optional[float] = None  # monotonic, set by _finish
+    # per-request SLO accounting (engine terminal records): inter-emit gaps
+    # normalized per token (a burst emit of k tokens contributes gap/k), so
+    # the record's itl_p99_ms reflects what a streaming client experienced.
+    # Capped — a 32k-token stream must not grow an unbounded list.
+    last_emit_time: Optional[float] = None
+    itl_samples: list = field(default_factory=list)
+    slo_done: bool = False         # terminal record emitted (guard)
     # phase-span contexts, pre-allocated at first admission attempt so
     # offload spill/restore spans triggered inside the scheduler can parent
     # under the phase whose wall window contains them (first admission ->
@@ -354,6 +364,7 @@ class Scheduler:
                 self.kv.free(shared)
                 return
             seq.pages = shared + fresh
+            seq.pages_peak = max(seq.pages_peak, len(seq.pages))
             seq.num_cached = cached
             seq.num_computed = cached
             self.waiting.pop(0)
@@ -395,6 +406,7 @@ class Scheduler:
         if extra is None:
             return False
         seq.pages.extend(extra)
+        seq.pages_peak = max(seq.pages_peak, len(seq.pages))
         return True
 
     def _finish(self, seq: Sequence, reason: str) -> None:
@@ -449,6 +461,15 @@ class Scheduler:
                 or demand >= max(2, self.prefill_batch)
             )
         )
+        # interleave-gate decision surface (flight recorder "sched" events):
+        # WHY the loop ran a chunk vs a decode burst is unreconstructable
+        # after the fact without these inputs
+        self.last_gate = {
+            "backlog_tokens": backlog,
+            "decode_demand": demand,
+            "alternate": alternate,
+            "waiting": len(self.waiting),
+        }
         if prefilling and not alternate:
             return self._take_prefill(prefilling)
         self._last_kind = "decode"

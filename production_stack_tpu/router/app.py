@@ -116,6 +116,13 @@ class RouterApp:
         scraper = initialize_engine_stats_scraper(args.engine_stats_interval)
         await scraper.start()
         initialize_request_stats_monitor(args.request_stats_window)
+        from production_stack_tpu.router.slo import initialize_slo_monitor
+
+        initialize_slo_monitor(
+            ttft_ms=getattr(args, "slo_ttft_ms", 2000.0),
+            itl_ms=getattr(args, "slo_itl_ms", 200.0),
+            saturation_queue_ref=getattr(args, "saturation_queue_ref", 8),
+        )
         initialize_routing_logic(
             args.routing_logic,
             session_key=args.session_key,
@@ -395,6 +402,22 @@ class RouterApp:
         # per-backend vllm_router:circuit_state (0=closed 1=half-open 2=open)
         # and vllm_router:circuit_open_events_total
         lines.extend(render_resilience_metrics())
+        # SLO accounting (router/slo.py): vllm_router:slo_attained_total /
+        # vllm_router:slo_violated_total per (objective, model, server),
+        # vllm_router:slo_request_outcomes_total, vllm_router:slo_records_total,
+        # and the vllm_router:fleet_saturation autoscaling gauge (computed
+        # fresh per scrape from the live engine stats + shed windows)
+        from production_stack_tpu.router.resilience import get_saturation_registry
+        from production_stack_tpu.router.slo import get_slo_monitor
+
+        slo = get_slo_monitor()
+        sat = get_saturation_registry()
+        shedding = [url for url in estats if sat.is_saturated(url)]
+        lines.extend(
+            slo.render(
+                fleet_saturation=slo.fleet_saturation(estats, shedding)
+            )
+        )
         # per-hop TTFT breakdown (receive->route->backend-headers->first
         # chunk): attributes tail latency to a stage instead of "the stack".
         # One TYPE line per metric name (duplicates fail the whole scrape).
@@ -419,9 +442,17 @@ class RouterApp:
         # (bench.py) both endpoints render the same process-global counts
         # under different labels, so the dashboard's phase panels filter on
         # model_name!="" to count the engine's series exactly once
-        from production_stack_tpu.tracing import render_phase_histograms
+        from production_stack_tpu.tracing import (
+            render_collector_metrics,
+            render_phase_histograms,
+        )
 
         lines.extend(render_phase_histograms('source="router"'))
+        # span-loss visibility for THIS process's collector (satellite of
+        # ISSUE 7): ring-wrap overwrites and head-sampling rejections are
+        # silent by design — the counters make the loss measurable before
+        # someone debugs a tail with an incomplete trace
+        lines.extend(render_collector_metrics('source="router"'))
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
     async def traces(self, request: web.Request) -> web.Response:

@@ -115,7 +115,40 @@ class EngineStatsScraper(metaclass=SingletonMeta):
                 )
             except Exception:
                 logger.exception("engine stats scrape failed")
+            try:
+                # per-request SLO terminal records (router/slo.py): the same
+                # scrape cadence pulls each backend's /slo_records tail and
+                # feeds the attainment counters. Separate try: a broken SLO
+                # surface on one pod must not cost the fleet its load stats.
+                endpoints = get_service_discovery().get_endpoint_info()
+                await asyncio.gather(
+                    *[self._scrape_slo_records(ep.url) for ep in endpoints]
+                )
+            except Exception:
+                logger.exception("slo records scrape failed")
             await asyncio.sleep(self.scrape_interval)
+
+    async def _scrape_slo_records(self, url: str) -> None:
+        """Pull one backend's new SLO terminal records (cursor-based) into
+        the SLO monitor. Best-effort per backend: plain-vLLM pods without
+        /slo_records (404) and dead pods are silently skipped."""
+        from production_stack_tpu.router.request_service import get_client_session
+        from production_stack_tpu.router.slo import get_slo_monitor
+
+        slo = get_slo_monitor()
+        try:
+            session = await get_client_session()
+            async with session.get(
+                f"{url}/slo_records",
+                params={"since": str(slo.cursor(url))},
+                timeout=aiohttp.ClientTimeout(total=5),
+            ) as resp:
+                if resp.status != 200:
+                    return
+                payload = await resp.json()
+        except Exception:  # noqa: BLE001 - scrape is best-effort
+            return
+        slo.ingest(url, payload)
 
     def apply_scrape_results(
         self, urls: list[str], results: list[Optional[EngineStats]], now: float
@@ -170,6 +203,13 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         for url in list(self.epochs):
             if url not in current:
                 del self.epochs[url]
+                # deliberately NOT resetting the SLO cursor here: a backend
+                # can drop out of discovery without restarting (health-check
+                # flap under overload — exactly when SLO data matters), and
+                # a reset would re-ingest its retained records on rejoin,
+                # double-counting attainment. A genuinely reborn process
+                # starts a fresh record counter, which ingest() detects via
+                # head < cursor and resets on its own.
         cutoff = now - self.STALE_INTERVALS * self.scrape_interval
         for url in list(self.engine_stats):
             if self.last_success.get(url, now) < cutoff:

@@ -133,6 +133,19 @@ def parse_args(argv=None):
     p.add_argument("--breaker-cooldown", type=float, default=30.0,
                    help="seconds an open breaker waits before admitting a "
                         "half-open probe request")
+    # per-request SLO accounting (router/slo.py, docs/observability.md):
+    # objectives applied to the terminal records scraped from each engine's
+    # /slo_records, exported as vllm_router:slo_{attained,violated}_total
+    p.add_argument("--slo-ttft-ms", type=float, default=2000.0,
+                   help="TTFT objective in ms for the per-backend SLO "
+                        "attainment counters (objective=\"ttft\")")
+    p.add_argument("--slo-itl-ms", type=float, default=200.0,
+                   help="inter-token-latency p99 objective in ms for the "
+                        "SLO attainment counters (objective=\"itl\")")
+    p.add_argument("--saturation-queue-ref", type=int, default=8,
+                   help="waiting-queue depth that counts one backend as "
+                        "fully saturated in vllm_router:fleet_saturation "
+                        "(the prometheus-adapter autoscaling gauge)")
     args = p.parse_args(argv)
     validate_args(args)
     return args
@@ -163,6 +176,10 @@ def validate_args(args) -> None:
             raise ValueError(f"--{flag.replace('_', '-')} must be >= 0 (0 disables)")
     if args.trace_buffer_size < 1:
         raise ValueError("--trace-buffer-size must be >= 1")
+    if args.slo_ttft_ms <= 0 or args.slo_itl_ms <= 0:
+        raise ValueError("--slo-ttft-ms/--slo-itl-ms must be > 0")
+    if args.saturation_queue_ref < 1:
+        raise ValueError("--saturation-queue-ref must be >= 1")
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("session routing requires --session-key")
     if args.routing_logic == "kvaware" and not args.kv_controller_url:

@@ -32,6 +32,15 @@ scripts/chaos_check.py):
                          ``vllm:warm_start_restored_pages M`` (+ manifest
                          age), so rolling-restart chaos runs can assert the
                          warm-start surface without a real engine
+- ``--slo-itl-ms X``     the synthetic SLO terminal records report X as
+                         their inter-token p99 (``GET /slo_records``, same
+                         wire shape as the real engine) — set above the
+                         router's objective to drive its violation counters
+- ``--compile-stall-ms X``  the first generation stalls X ms and records a
+                         flight-recorder ``compile`` event (cold-XLA model)
+- ``--flight-dump-dir D``  arm flight-recorder anomaly dumps (SIGTERM
+                         drain, shed bursts) into D; the synthetic
+                         sched/kv/shed event feed matches the real engine's
 - ``POST /abort``        cancels an in-flight request by X-Request-Id, like
                          the real engine's abort endpoint
 
@@ -58,12 +67,19 @@ import uuid
 
 from aiohttp import web
 
+import collections
+
 from production_stack_tpu.tracing import (
+    configure_flightrecorder,
     decode_step_time_hist,
     export_for_query,
+    flightrecorder,
     get_collector,
+    get_flightrecorder,
     prefill_time_hist,
     queue_time_hist,
+    render_collector_metrics,
+    render_flightrecorder_metrics,
     render_phase_histograms,
 )
 
@@ -78,7 +94,47 @@ STATE = {
     "aborts": 0,            # POST /abort calls received (router reclaims)
     "shed": 0,              # 429s emitted (saturate-after-n / shed-rate)
     "inflight": {},         # req_id -> handler asyncio.Task (for /abort)
+    # per-request SLO terminal records (same wire shape as the real engine's
+    # GET /slo_records) so router-side SLO aggregation is testable sans TPU
+    "slo_seq": 0,
+    "slo_records": collections.deque(maxlen=2048),
+    # shed timestamps feeding the flight recorder's shed-burst anomaly dump
+    "shed_times": collections.deque(maxlen=64),
+    "compile_stalled": False,  # --compile-stall-ms fires once, on request 1
 }
+
+
+def _push_slo_record(model: str, req_id: str, outcome: str, *,
+                     ttft_ms=None, itl_p99_ms=None, output_tokens=0,
+                     queue_ms=0.0, e2e_ms=None, trace_id=None) -> None:
+    """Synthetic terminal record, same fields the real engine attributes
+    (engine.LLMEngine._record_slo) so the router's scraper cannot tell the
+    difference."""
+    STATE["slo_seq"] += 1
+    # mirrored into the flight recorder too, like the real engine's
+    # _record_slo — anomaly dumps carry the requests that were in flight
+    get_flightrecorder().record(
+        "slo", step=STATE["slo_seq"], trace_id=trace_id,
+        request_id=req_id, outcome=outcome, ttft_ms=ttft_ms,
+        itl_p99_ms=itl_p99_ms, output_tokens=output_tokens,
+    )
+    STATE["slo_records"].append({
+        "seq": STATE["slo_seq"],
+        "request_id": req_id,
+        "model": model,
+        "outcome": outcome,
+        "finish_reason": "length" if outcome == "ok" else outcome,
+        "queue_ms": round(queue_ms, 3),
+        "ttft_ms": None if ttft_ms is None else round(ttft_ms, 3),
+        "e2e_ms": None if e2e_ms is None else round(e2e_ms, 3),
+        "prompt_tokens": 10,
+        "output_tokens": output_tokens,
+        "cached_tokens": 0,
+        "itl_p99_ms": None if itl_p99_ms is None else round(itl_p99_ms, 3),
+        "kv_pages_peak": max(1, output_tokens // 16 + 1),
+        "trace_id": trace_id,
+        "t": time.time(),
+    })
 
 
 def make_app(model: str, speed: float, ttft: float, model_label: str | None = None,
@@ -94,6 +150,15 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     retry_after = f"{float(faults.get('retry_after') or 1):g}"
     crash_after_n = faults.get("crash_after_n")
     restore_pages = int(faults.get("restart_restore_pages") or 0)
+    # synthetic observability feed (ISSUE 7): --slo-itl-ms sets the ITL p99
+    # the terminal records report (drives router-side SLO violation paths);
+    # --compile-stall-ms injects one compile stall + flight-recorder compile
+    # event; --flight-dump-dir arms anomaly dumps (SIGTERM / shed burst)
+    slo_itl_ms = faults.get("slo_itl_ms")
+    compile_stall_ms = float(faults.get("compile_stall_ms") or 0.0)
+    flight_dump_dir = faults.get("flight_dump_dir")
+    if flight_dump_dir:
+        configure_flightrecorder(dump_dir=flight_dump_dir)
     start_time = time.time()
 
     def _hard_crash():
@@ -106,8 +171,21 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         sys.stdout.flush()
         os._exit(9)
 
-    def shed_response(reason: str):
+    def shed_response(reason: str, req_id: str = ""):
         STATE["shed"] += 1
+        # flight-recorder shed event + burst-triggered anomaly dump, same
+        # trigger shape as the real engine (_note_shed): the overload chaos
+        # scenario asserts a parseable dump lands during the shed storm
+        fr = get_flightrecorder()
+        now = time.monotonic()
+        STATE["shed_times"].append(now)
+        fr.record(
+            "shed", step=STATE["served"], reason=reason, seq_id=req_id,
+            running=STATE["running"],
+        )
+        if sum(1 for t in list(STATE["shed_times"]) if now - t <= 5.0) >= 5:
+            fr.dump_async("shed_burst")  # keep the event loop serving
+        _push_slo_record(model, req_id or "unknown", "shed")
         return web.json_response(
             {"error": {"message": f"saturated (injected: {reason})",
                        "type": "overloaded_error", "code": 429}},
@@ -170,10 +248,36 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         # per-phase histograms, same names as the real engine's /metrics so
         # smoke tests and dashboard queries exercise the fake identically
         text += "\n".join(render_phase_histograms(f'model_name="{model}"')) + "\n"
+        # span-loss + flight-recorder health, same surface as the real engine
+        text += "\n".join(render_collector_metrics(f'model_name="{model}"')) + "\n"
+        text += "\n".join(
+            render_flightrecorder_metrics(f'model_name="{model}"')
+        ) + "\n"
         return web.Response(text=text, content_type="text/plain")
 
     async def traces(request):
         payload, status = export_for_query(request.query)
+        return web.json_response(payload, status=status)
+
+    async def slo_records(request):
+        """Same wire contract as the real engine's GET /slo_records."""
+        try:
+            since = int(request.query.get("since", "0"))
+        except (TypeError, ValueError):
+            return web.json_response({"error": "since must be an int"}, status=400)
+        snap = list(STATE["slo_records"])
+        head = snap[-1]["seq"] if snap else 0
+        records = [r for r in snap if r["seq"] > since]
+        return web.json_response({
+            "model": model,
+            "since": since,
+            "next": max((r["seq"] for r in records), default=since),
+            "head": head,
+            "records": records,
+        })
+
+    async def flightrecorder_export(request):
+        payload, status = flightrecorder.export_for_query(request.query)
         return web.json_response(payload, status=status)
 
     async def completions(request):
@@ -220,9 +324,9 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         # in-flight count is provably bounded by saturate_after_n (the
         # overload chaos scenario asserts on running_peak)
         if saturate_after_n is not None and STATE["running"] >= int(saturate_after_n):
-            return shed_response("saturate-after-n")
+            return shed_response("saturate-after-n", req_id)
         if shed_rate and random.random() < shed_rate:
-            return shed_response("shed-rate")
+            return shed_response("shed-rate", req_id)
         # distributed tracing, same span model as the real engine
         # (engine.request > queue/prefill/decode) so router e2e tests can
         # assert full-stack trace propagation without a TPU
@@ -232,6 +336,24 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         STATE["running"] += 1
         STATE["running_peak"] = max(STATE["running_peak"], STATE["running"])
         STATE["total"] += 1
+        # synthetic flight-recorder feed, same event shapes as the real
+        # engine loop (sched + kv per dispatch, cross-linked by trace id) so
+        # anomaly-dump consumers are testable without a TPU
+        fr = get_flightrecorder()
+        fr_trace = trace_ctx.trace_id if trace_ctx.sampled else None
+        fr.record(
+            "sched", step=STATE["served"], batch_kind="decode",
+            rows=STATE["running"], bursts=1, chunk_tokens=0,
+            seq_ids=[req_id], trace_ids=[fr_trace] if fr_trace else [],
+            gate={"backlog_tokens": 0, "decode_demand": STATE["running"],
+                  "alternate": False, "waiting": 0},
+            running=STATE["running"], waiting=0,
+            trace_id=fr_trace,
+        )
+        fr.record(
+            "kv", step=STATE["served"], op="alloc",
+            pages=max(1, max_tokens // 16), trace_id=fr_trace,
+        )
         # registered while holding a slot so POST /abort can cancel this
         # handler and free the slot, like the real engine's abort endpoint
         STATE["inflight"][req_id] = asyncio.current_task()
@@ -252,6 +374,25 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                 decode_step_time_hist.observe(
                     (t_done - t_first) / (max_tokens - 1)
                 )
+            # terminal SLO record: measured TTFT; ITL p99 is --slo-itl-ms
+            # when injected (drives router-side violation counters), else
+            # the stream's real pacing
+            measured_itl = (
+                (t_done - t_first) * 1000 / max(1, max_tokens - 1)
+                if max_tokens > 1 else None
+            )
+            _push_slo_record(
+                model, req_id, "ok",
+                ttft_ms=(t_first - t_accept) * 1000,
+                itl_p99_ms=(
+                    float(slo_itl_ms) if slo_itl_ms is not None
+                    else measured_itl
+                ),
+                output_tokens=max_tokens,
+                queue_ms=0.0,
+                e2e_ms=(t_done - t_accept) * 1000,
+                trace_id=fr_trace,
+            )
 
         try:
             if hang:
@@ -262,6 +403,19 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             t_q = time.time()
             _phase("engine.queue", t_accept, t_q - t_accept)
             queue_time_hist.observe(t_q - t_accept)
+            if compile_stall_ms > 0 and not STATE["compile_stalled"]:
+                # one injected compile stall on the first generation: the
+                # first request of a real engine pays tracing + XLA compile,
+                # and the recorder's compile event is how a postmortem tells
+                # a compile stall from a scheduling stall
+                STATE["compile_stalled"] = True
+                fr.record(
+                    "compile", step=STATE["served"],
+                    event="backend_compile",
+                    seconds=round(compile_stall_ms / 1000.0, 4),
+                    trace_id=fr_trace,
+                )
+                await asyncio.sleep(compile_stall_ms / 1000.0)
             await asyncio.sleep(ttft)  # injected prefill time
             t_first = time.time()
             _phase("engine.prefill", t_q, t_first - t_q, prompt_tokens=10)
@@ -324,6 +478,11 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
+        except asyncio.CancelledError:
+            # router-initiated abort (POST /abort) or client disconnect: the
+            # real engine attributes these a terminal 'abort' record too
+            _push_slo_record(model, req_id, "abort", trace_id=fr_trace)
+            raise
         finally:
             STATE["running"] -= 1
             STATE["inflight"].pop(req_id, None)
@@ -369,6 +528,8 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     app.router.add_get("/v1/models", models)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/v1/traces", traces)
+    app.router.add_get("/slo_records", slo_records)
+    app.router.add_get("/v1/debug/flightrecorder", flightrecorder_export)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_post("/abort", abort)
@@ -393,6 +554,9 @@ async def _serve_until_sigterm(app, port: int) -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     STATE["draining"] = True
+    # SIGTERM anomaly dump, same trigger as the real engine's drain path
+    # (rolling-restart chaos parses these for the pre-restart window)
+    get_flightrecorder().dump("sigterm_drain", force=True)
     deadline = time.time() + 5.0
     while STATE["running"] > 0 and time.time() < deadline:
         await asyncio.sleep(0.1)
@@ -432,6 +596,18 @@ def main():
     p.add_argument("--restart-restore-pages", type=int, default=None,
                    help="model a warm restart: advertise "
                         "vllm:warm_start_restored_pages N on /metrics")
+    p.add_argument("--slo-itl-ms", type=float, default=None,
+                   help="inter-token p99 the synthetic SLO terminal records "
+                        "report (default: the stream's real pacing) — set "
+                        "above the router's --slo-itl-ms to drive its "
+                        "violation counters")
+    p.add_argument("--compile-stall-ms", type=float, default=0.0,
+                   help="stall the FIRST generation this many ms and record "
+                        "a flight-recorder compile event (models a cold "
+                        "XLA compile)")
+    p.add_argument("--flight-dump-dir", type=str, default=None,
+                   help="arm flight-recorder anomaly dumps (SIGTERM drain, "
+                        "shed bursts) into this directory")
     args = p.parse_args()
     app = make_app(
         args.model, args.speed, args.ttft, args.model_label,
@@ -446,6 +622,9 @@ def main():
             "retry_after": args.retry_after,
             "crash_after_n": args.crash_after_n,
             "restart_restore_pages": args.restart_restore_pages,
+            "slo_itl_ms": args.slo_itl_ms,
+            "compile_stall_ms": args.compile_stall_ms,
+            "flight_dump_dir": args.flight_dump_dir,
         },
     )
     asyncio.run(_serve_until_sigterm(app, args.port))

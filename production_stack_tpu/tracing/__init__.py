@@ -17,8 +17,15 @@ from production_stack_tpu.tracing.collector import (
     current_context,
     export_for_query,
     get_collector,
+    render_collector_metrics,
     reset_current,
     set_current,
+)
+from production_stack_tpu.tracing.flightrecorder import (
+    FlightRecorder,
+    configure_flightrecorder,
+    get_flightrecorder,
+    render_flightrecorder_metrics,
 )
 from production_stack_tpu.tracing.context import (
     TRACEPARENT_HEADER,
@@ -38,10 +45,12 @@ from production_stack_tpu.tracing.metrics import (
 )
 
 __all__ = [
+    "FlightRecorder",
     "Span",
     "SpanCollector",
     "SpanContext",
     "TRACEPARENT_HEADER",
+    "configure_flightrecorder",
     "configure_tracing",
     "current_context",
     "decode_step_time_hist",
@@ -49,11 +58,14 @@ __all__ = [
     "gen_span_id",
     "gen_trace_id",
     "get_collector",
+    "get_flightrecorder",
     "interleaved_decode_hist",
     "offload_restore_hist",
     "prefill_chunk_hist",
     "prefill_time_hist",
     "queue_time_hist",
+    "render_collector_metrics",
+    "render_flightrecorder_metrics",
     "render_phase_histograms",
     "reset_current",
     "reset_phase_histograms",

@@ -64,6 +64,11 @@ class SpanCollector:
         self.sample_rate = min(1.0, max(0.0, float(sample_rate)))
         self._slots: list = [None] * self.capacity
         self._cursor = itertools.count()
+        # head-sampling rejections: record() calls that arrived with a real
+        # but UNSAMPLED context. Counted (atomically, same count trick as the
+        # slot cursor) so span loss is visible on /metrics BEFORE someone
+        # debugs a latency tail with a trace that silently isn't there.
+        self._rejected = itertools.count()
 
     @property
     def recorded(self) -> int:
@@ -73,6 +78,20 @@ class SpanCollector:
         way a separate ``+= 1`` (a non-atomic read-modify-write) would."""
         # count.__reduce__() -> (count, (next_value,)) without consuming
         return self._cursor.__reduce__()[1][0]
+
+    @property
+    def overwritten(self) -> int:
+        """Spans lost to the ring wrapping: every record past ``capacity``
+        overwrote the oldest surviving span. The exact silent-loss count the
+        trace_spans_dropped_total{reason="ring_wrap"} series exposes."""
+        return max(0, self.recorded - self.capacity)
+
+    @property
+    def sampling_rejected(self) -> int:
+        """record() calls dropped because their context was unsampled
+        (head-sampling). Expected under a <1.0 sample rate — the counter
+        makes the loss *visible*, it does not make it wrong."""
+        return self._rejected.__reduce__()[1][0]
 
     # -- sampling -----------------------------------------------------------
 
@@ -121,8 +140,13 @@ class SpanCollector:
         **attrs,
     ) -> None:
         """Store one completed span. No-op for missing/unsampled contexts —
-        this is the entire overhead of tracing when sampling is off."""
-        if ctx is None or not ctx.sampled:
+        this is the entire overhead of tracing when sampling is off (plus one
+        atomic counter bump for unsampled contexts, so trace loss is
+        observable)."""
+        if ctx is None:
+            return
+        if not ctx.sampled:
+            next(self._rejected)
             return
         span = Span(
             trace_id=ctx.trace_id,
@@ -186,6 +210,7 @@ class SpanCollector:
         that phase."""
         self._slots = [None] * self.capacity
         self._cursor = itertools.count()
+        self._rejected = itertools.count()
 
 
 # -- process-global collector -------------------------------------------------
@@ -215,6 +240,25 @@ def configure_tracing(
 
 def get_collector() -> SpanCollector:
     return _collector
+
+
+def render_collector_metrics(labels: str) -> list[str]:
+    """Prometheus lines for span-loss visibility (rendered by every server
+    hosting the collector — engine, router, fake engine): the ring wrapping
+    and head-sampling both drop spans BY DESIGN, and an attribution built on
+    an incomplete trace is misleading unless the loss is measurable."""
+    col = get_collector()
+    return [
+        "# TYPE vllm:trace_spans_recorded_total counter",
+        f"vllm:trace_spans_recorded_total{{{labels}}} {col.recorded}",
+        "# TYPE vllm:trace_spans_dropped_total counter",
+        f'vllm:trace_spans_dropped_total{{{labels},reason="ring_wrap"}} '
+        f"{col.overwritten}",
+        f'vllm:trace_spans_dropped_total{{{labels},reason="unsampled"}} '
+        f"{col.sampling_rejected}",
+        "# TYPE vllm:trace_buffer_capacity gauge",
+        f"vllm:trace_buffer_capacity{{{labels}}} {col.capacity}",
+    ]
 
 
 def export_for_query(query) -> "tuple[dict, int]":

@@ -62,6 +62,53 @@ from production_stack_tpu.testing.procs import (  # noqa: E402
 CIRCUIT_RE = re.compile(r'vllm_router:circuit_state\{backend="([^"]+)"\} (\d+)')
 
 
+def _router_trace_ids(base: str, limit: int = 16384) -> set:
+    """Trace ids currently in the router's span ring (needs
+    --enable-debug-endpoints on the router)."""
+    try:
+        traces = requests.get(
+            f"{base}/v1/traces", params={"limit": str(limit)}, timeout=10
+        ).json()
+    except requests.RequestException:
+        return set()
+    return {t["trace_id"] for t in traces.get("traces", [])}
+
+
+def _check_anomaly_dumps(
+    dump_dir: str, reason: str, router_trace_ids: set
+) -> dict:
+    """Validate the flight-recorder anomaly dumps a chaos event should have
+    produced: at least one parseable dump for ``reason`` whose window holds
+    scheduler AND KV events, cross-linked to at least one PR-1 trace id the
+    router also recorded. Returns a summary dict the scenarios assert on."""
+    import glob
+    import os
+
+    paths = sorted(glob.glob(
+        os.path.join(dump_dir, f"flightrecorder-{reason}-*.json")
+    ))
+    out = {
+        "dump_dir": dump_dir, "reason": reason, "dumps": len(paths),
+        "parseable": 0, "sched_events": 0, "kv_events": 0,
+        "crosslinked_trace_ids": 0,
+    }
+    for path in paths:
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            events = payload["events"]
+        except (OSError, ValueError, KeyError):
+            continue
+        out["parseable"] += 1
+        out["sched_events"] += sum(1 for e in events if e["kind"] == "sched")
+        out["kv_events"] += sum(1 for e in events if e["kind"] == "kv")
+        dumped_ids = {
+            e.get("trace_id") for e in events if e.get("trace_id")
+        }
+        out["crosslinked_trace_ids"] += len(dumped_ids & router_trace_ids)
+    return out
+
+
 def run_chaos(
     num_requests: int = 200,
     retry_budget: int = 3,
@@ -168,18 +215,24 @@ def run_overload(
     come back as a clean 429 + Retry-After (never a 5xx, never a hang).
     Returns a summary dict; callers assert on it."""
     import concurrent.futures as cf
+    import tempfile
 
     fakes, urls = [], []
+    # per-engine flight-recorder dump dirs: the shed storm must trigger a
+    # shed_burst anomaly dump whose window cross-links to router traces
+    dump_dirs = []
     try:
         for _ in range(2):
             port = free_port()
+            dump_dirs.append(tempfile.mkdtemp(prefix="pstpu-fr-overload-"))
             fakes.append(start_proc(
                 ["-m", "production_stack_tpu.testing.fake_engine",
                  "--port", str(port), "--model", "fake/model",
                  # slow enough that requests overlap and saturation is real
                  "--speed", "60",
                  "--saturate-after-n", str(seats),
-                 "--retry-after", "1"]
+                 "--retry-after", "1",
+                 "--flight-dump-dir", dump_dirs[-1]]
             ))
             urls.append(f"http://127.0.0.1:{port}")
         router_port = free_port()
@@ -193,6 +246,9 @@ def run_overload(
             "--retry-backoff-base", "0.01",
             "--breaker-failure-threshold", "3",
             "--breaker-cooldown", "300",
+            # anomaly-dump cross-link check reads the router's span ring
+            "--trace-buffer-size", "65536",
+            "--enable-debug-endpoints",
         ])
         fakes.append(router)
         base = f"http://127.0.0.1:{router_port}"
@@ -240,7 +296,16 @@ def run_overload(
             # None (metric missing) must FAIL the bounded-depth check, not
             # sail past it — a dropped metric is a broken invariant probe
             peaks[url] = int(m.group(1)) if m else None
+        # shed-burst anomaly dumps: the storm must have produced at least
+        # one parseable dump whose window carries scheduler + KV events
+        # cross-linked (by trace id) to traces the router recorded
+        router_ids = _router_trace_ids(base)
+        anomaly_dumps = [
+            _check_anomaly_dumps(d, "shed_burst", router_ids)
+            for d in dump_dirs
+        ]
         return {
+            "anomaly_dumps": anomaly_dumps,
             "statuses": dict(statuses),
             "non_429_errors": sum(
                 n for s, n in statuses.items() if s not in (200, 429)
@@ -274,17 +339,27 @@ def run_rolling_restart(
     also checks the warm-start metric surface a real ``--warm-start`` engine
     exports after restoring its manifest."""
     import signal as signal_mod
+    import tempfile
     import time
 
-    def start_fake(port: int, extra: list) -> "object":
+    # one dump dir per engine SLOT, shared across its incarnations: the
+    # SIGTERM drain of each dying process must leave a parseable anomaly
+    # dump behind (timestamped filenames keep incarnations apart)
+    dump_dirs = [
+        tempfile.mkdtemp(prefix="pstpu-fr-restart-") for _ in range(engines)
+    ]
+
+    def start_fake(port: int, extra: list, dump_dir: str = "") -> "object":
         return start_proc(
             ["-m", "production_stack_tpu.testing.fake_engine",
              "--port", str(port), "--model", "fake/model",
-             "--speed", "200"] + extra
+             "--speed", "200"]
+            + (["--flight-dump-dir", dump_dir] if dump_dir else [])
+            + extra
         )
 
     ports = [free_port() for _ in range(engines)]
-    fakes = [start_fake(p, []) for p in ports]
+    fakes = [start_fake(p, [], d) for p, d in zip(ports, dump_dirs)]
     urls = [f"http://127.0.0.1:{p}" for p in ports]
     router = None
     stop_load = threading.Event()
@@ -308,6 +383,10 @@ def run_rolling_restart(
             # path a K8s rotation takes (readiness gates + probes)
             "--static-backend-health-checks",
             "--health-check-interval", "0.25",
+            # anomaly-dump cross-link check reads the router's span ring
+            # (sized for the whole sustained-load run)
+            "--trace-buffer-size", "65536",
+            "--enable-debug-endpoints",
         ])
         base = f"http://127.0.0.1:{router_port}"
         for proc, url in zip(fakes, urls):
@@ -362,7 +441,8 @@ def run_rolling_restart(
             rc = fakes[i].wait(timeout=20)
             # rebirth on the SAME address, warm (modelled manifest restore)
             fakes[i] = start_fake(
-                port, ["--restart-restore-pages", str(restore_pages)]
+                port, ["--restart-restore-pages", str(restore_pages)],
+                dump_dirs[i],
             )
             wait_healthy(f"{urls[i]}/health", fakes[i], timeout=30)
             # traffic must RETURN to the reborn backend within the breaker
@@ -393,7 +473,16 @@ def run_rolling_restart(
         metrics = requests.get(f"{base}/metrics", timeout=10).text
         circuit = {m.group(1): int(m.group(2))
                    for m in CIRCUIT_RE.finditer(metrics)}
+        # SIGTERM anomaly dumps: each rotated engine's drain must have left
+        # a parseable flight-recorder dump carrying the pre-restart
+        # scheduler + KV window, cross-linked to router-recorded trace ids
+        router_ids = _router_trace_ids(base)
+        anomaly_dumps = [
+            _check_anomaly_dumps(d, "sigterm_drain", router_ids)
+            for d in dump_dirs
+        ]
         return {
+            "anomaly_dumps": anomaly_dumps,
             "statuses": dict(statuses),
             "non_429_errors": len(errors),
             "errors": errors[:10],
@@ -443,6 +532,14 @@ def main() -> int:
                     f"{r['url']} reborn without warm-start surface "
                     f"({r['warm_restored_pages']} != {s['restore_pages']})"
                 )
+        for d in s["anomaly_dumps"]:
+            if not (
+                d["parseable"] > 0 and d["sched_events"] > 0
+                and d["kv_events"] > 0 and d["crosslinked_trace_ids"] > 0
+            ):
+                failures.append(
+                    f"missing/incomplete sigterm anomaly dump: {d}"
+                )
         if failures:
             print("ROLLING-RESTART CHECK FAILED: " + "; ".join(failures))
             return 1
@@ -473,6 +570,14 @@ def main() -> int:
         for url in s["urls"]:
             if s["circuit_state"].get(url) == OPEN:
                 failures.append(f"sheds tripped the breaker for {url}")
+        if not any(
+            d["parseable"] > 0 and d["sched_events"] > 0
+            and d["kv_events"] > 0 and d["crosslinked_trace_ids"] > 0
+            for d in s["anomaly_dumps"]
+        ):
+            failures.append(
+                f"no complete shed-burst anomaly dump: {s['anomaly_dumps']}"
+            )
         if failures:
             print("OVERLOAD CHECK FAILED: " + "; ".join(failures))
             return 1

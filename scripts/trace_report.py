@@ -14,6 +14,17 @@ Usage:
     curl -s $ENGINE/v1/traces > e.json
     python scripts/trace_report.py r.json e.json
 
+Cross-link mode (the "why was this request slow" one-liner): given a trace
+id and a flight-recorder export (``GET /v1/debug/flightrecorder`` on the
+engine, or an anomaly dump file), render the trace's spans interleaved
+chronologically with the engine events from the matching window — the
+scheduler dispatches, KV evictions/restores, sheds, and compiles that
+surrounded the request:
+
+    curl -s $ENGINE/v1/debug/flightrecorder > fr.json
+    python scripts/trace_report.py r.json e.json \
+        --flightrecorder fr.json --trace-id <32-hex id>
+
 ``bench.py`` imports ``merge_exports`` / ``phase_table`` / ``render_table``
 to emit the same attribution from its in-run trace scrapes.
 """
@@ -156,6 +167,86 @@ def render_table(table: dict) -> str:
     return "\n".join(lines)
 
 
+# -- cross-link mode (trace spans x flight-recorder events) -------------------
+
+
+def _recorder_events(export) -> list[dict]:
+    """Accept a /v1/debug/flightrecorder export, an anomaly dump, or a bare
+    event list."""
+    if isinstance(export, dict):
+        export = export.get("events", [])
+    return [e for e in export if isinstance(e, dict) and "kind" in e]
+
+
+def _event_line(ev: dict) -> str:
+    d = ev.get("data") or {}
+    kind = ev["kind"]
+    if kind == "sched":
+        gate = d.get("gate") or {}
+        detail = (
+            f"{d.get('batch_kind')} rows={d.get('rows')} "
+            f"bursts={d.get('bursts')} chunk_tokens={d.get('chunk_tokens')} "
+            f"waiting={d.get('waiting')} alternate={gate.get('alternate')}"
+        )
+    elif kind == "step":
+        detail = (
+            f"{d.get('batch_kind')} wall={d.get('wall_ms')}ms "
+            f"fetched={d.get('fetched')}"
+        )
+    elif kind == "kv":
+        detail = " ".join(
+            f"{k}={v}" for k, v in d.items() if k != "victim_scores"
+        )
+    else:
+        detail = " ".join(f"{k}={v}" for k, v in sorted(d.items()))
+    return f"event  {kind:<8} step={ev.get('step', -1):<6} {detail}"
+
+
+def crosslink_report(
+    merged: dict[str, list[dict]],
+    recorder_export,
+    trace_id: str,
+    window_slack_s: float = 1.0,
+) -> str:
+    """Render one trace's spans interleaved (chronologically, by wall-clock
+    start) with the flight-recorder events of the matching window: events
+    stamped with the trace id itself, plus every event inside the trace's
+    [start - slack, end + slack] wall window — the dispatches that served
+    OTHER requests in between are exactly what explains a queue-shaped gap."""
+    spans = merged.get(trace_id)
+    if not spans:
+        return f"trace {trace_id} not found in the supplied exports"
+    events = _recorder_events(recorder_export)
+    t0 = min(s["start"] for s in spans)
+    t1 = max(s["start"] + s.get("duration_ms", 0.0) / 1000 for s in spans)
+    window = [
+        e for e in events
+        if e.get("trace_id") == trace_id
+        or (t0 - window_slack_s) <= e.get("t", 0.0) <= (t1 + window_slack_s)
+    ]
+    rows: list[tuple[float, str]] = []
+    for s in sorted(spans, key=lambda s: s["start"]):
+        rows.append((
+            s["start"],
+            f" span  {s['name']:<26} +{(s['start'] - t0) * 1000:8.1f}ms "
+            f"dur={s.get('duration_ms', 0.0):.1f}ms",
+        ))
+    for e in window:
+        linked = "*" if e.get("trace_id") == trace_id else " "
+        rows.append((
+            e.get("t", t0),
+            f"{linked}{_event_line(e)}  +{(e.get('t', t0) - t0) * 1000:.1f}ms",
+        ))
+    rows.sort(key=lambda r: r[0])
+    linked_n = sum(1 for e in window if e.get("trace_id") == trace_id)
+    head = (
+        f"trace {trace_id}: {len(spans)} spans over "
+        f"{(t1 - t0) * 1000:.1f} ms; {len(window)} engine events in window "
+        f"({linked_n} cross-linked by trace id; * marks them)"
+    )
+    return "\n".join([head] + [r[1] for r in rows])
+
+
 def report(paths: Iterable[str]) -> str:
     exports = []
     for p in paths:
@@ -170,7 +261,24 @@ def main() -> None:
     )
     ap.add_argument("paths", nargs="+", help="JSON export file(s); exports "
                     "from router and engine merge by trace id")
+    ap.add_argument("--flightrecorder", default=None,
+                    help="flight-recorder export or anomaly dump (JSON); "
+                         "with --trace-id, renders the trace's spans "
+                         "interleaved with the matching engine-event window")
+    ap.add_argument("--trace-id", default=None,
+                    help="32-hex trace id for cross-link mode")
     args = ap.parse_args()
+    if args.flightrecorder or args.trace_id:
+        if not (args.flightrecorder and args.trace_id):
+            ap.error("cross-link mode needs BOTH --flightrecorder and --trace-id")
+        exports = []
+        for p in args.paths:
+            with open(p) as f:
+                exports.append(json.load(f))
+        with open(args.flightrecorder) as f:
+            recorder = json.load(f)
+        print(crosslink_report(merge_exports(*exports), recorder, args.trace_id))
+        return
     print(report(args.paths))
 
 

@@ -101,6 +101,14 @@ def test_overload_sheds_cleanly_with_bounded_queue_depth():
     # sheds never feed the breaker
     for url in s["urls"]:
         assert s["circuit_state"].get(url, 0) != OPEN, s["circuit_state"]
+    # acceptance (ISSUE 7): the shed burst produced a parseable anomaly
+    # dump whose window carries scheduler + KV events, cross-linked to at
+    # least one trace id the router also recorded
+    assert any(
+        d["parseable"] > 0 and d["sched_events"] > 0 and d["kv_events"] > 0
+        and d["crosslinked_trace_ids"] > 0
+        for d in s["anomaly_dumps"]
+    ), s["anomaly_dumps"]
 
 
 def test_rolling_restart_under_load_zero_errors_and_traffic_returns():
@@ -125,6 +133,13 @@ def test_rolling_restart_under_load_zero_errors_and_traffic_returns():
         assert r["traffic_returned_s"] <= s["return_window"], r
         # warm-start surface present on the reborn process
         assert r["warm_restored_pages"] == 32, r
+    # acceptance (ISSUE 7): every rotated engine's SIGTERM drain left a
+    # parseable flight-recorder dump with the pre-restart scheduler + KV
+    # window, cross-linked to router-recorded trace ids
+    for d in s["anomaly_dumps"]:
+        assert d["parseable"] > 0, d
+        assert d["sched_events"] > 0 and d["kv_events"] > 0, d
+        assert d["crosslinked_trace_ids"] > 0, d
 
 
 def test_inter_chunk_stall_aborts_engine_and_sends_sse_error():

@@ -133,8 +133,10 @@ class TestObservability:
         """Dashboard router metrics must match names the router exports
         (app.py renders them directly or via resilience.py)."""
         dash = _load("observability/tpu-stack-dashboard.json")
-        exported = _load("production_stack_tpu/router/app.py") + _load(
-            "production_stack_tpu/router/resilience.py"
+        exported = (
+            _load("production_stack_tpu/router/app.py")
+            + _load("production_stack_tpu/router/resilience.py")
+            + _load("production_stack_tpu/router/slo.py")
         )
         for name in set(re.findall(r"vllm_router:[a-z_]+", dash)):
             assert name in exported, f"dashboard references unexported metric {name}"
@@ -166,7 +168,13 @@ class TestObservability:
 
     def test_prom_adapter_and_stack_values(self):
         adapter = yaml.safe_load(_load("observability/prom-adapter.yaml"))
-        assert adapter["rules"]["custom"][0]["name"]["as"] == "tpu_num_requests_waiting"
+        # primary autoscaling signal: the router's normalized fleet
+        # saturation gauge (ISSUE 7); raw queue depth stays as a secondary
+        names = [r["name"]["as"] for r in adapter["rules"]["custom"]]
+        assert names[0] == "tpu_fleet_saturation"
+        assert "tpu_num_requests_waiting" in names
+        sat_rule = adapter["rules"]["custom"][0]
+        assert "vllm_router:fleet_saturation" in sat_rule["seriesQuery"]
         stack = yaml.safe_load(_load("observability/kube-prom-stack.yaml"))
         assert "prometheus" in stack
 

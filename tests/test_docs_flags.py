@@ -24,7 +24,8 @@ def _known_flags() -> set:
     for rel in (("production_stack_tpu", "router", "parser.py"),
                 ("production_stack_tpu", "testing", "fake_engine.py"),
                 ("benchmarks", "multi_round_qa.py"),
-                ("scripts", "chaos_check.py")):
+                ("scripts", "chaos_check.py"),
+                ("scripts", "trace_report.py")):
         src = REPO.joinpath(*rel).read_text()
         flags.update(re.findall(r'add_argument\(\s*"(--[a-z0-9-]+)"', src))
     return flags

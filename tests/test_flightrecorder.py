@@ -1,0 +1,337 @@
+"""Flight recorder + SLO accounting + device telemetry (ISSUE 7).
+
+Unit: recorder ring bounds/filters/dumps/rate limits, SLOMonitor objective
+math + restart-cursor handling + fleet saturation, DeviceMonitor CPU
+fallback rows.
+
+E2E (tier-1-safe, fake engine + router subprocesses): the fake engine's
+synthetic feed drives the debug endpoint, /slo_records cursor protocol,
+shed-burst anomaly dumps, and the cross-link report."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+import requests
+
+from production_stack_tpu.router.slo import SLOMonitor
+from production_stack_tpu.router.utils import SingletonMeta
+from production_stack_tpu.testing.procs import (
+    free_port,
+    start_proc,
+    stop_proc,
+    wait_healthy,
+)
+from production_stack_tpu.tracing import FlightRecorder
+from production_stack_tpu.tracing import flightrecorder as fr_mod
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir, "scripts")
+)
+import trace_report  # noqa: E402
+
+
+# -- recorder ring ------------------------------------------------------------
+
+
+def test_ring_bounded_and_ordered_under_concurrent_writers():
+    fr = FlightRecorder(capacity=64, enabled=True)
+    n_threads, per_thread = 8, 400
+
+    def writer(i):
+        for j in range(per_thread):
+            fr.record("sched", step=j, writer=i)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fr.recorded == n_threads * per_thread
+    assert fr.dropped == n_threads * per_thread - 64
+    evs = fr.events()
+    assert len(evs) == 64
+    # chronological by sequence, no torn slots
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+    assert all(e["kind"] == "sched" for e in evs)
+
+
+def test_disabled_recorder_records_nothing():
+    fr = FlightRecorder(capacity=16, enabled=False)
+    for _ in range(10):
+        fr.record("kv", op="evict")
+    assert fr.recorded == 0 and fr.events() == []
+    fr.set_enabled(True)
+    fr.record("kv", op="evict")
+    assert fr.recorded == 1
+
+
+def test_event_filters():
+    fr = FlightRecorder(capacity=128)
+    tid = "a" * 32
+    fr.record("sched", step=1, trace_id=tid, seq_ids=["req-1", "req-2"])
+    fr.record("kv", step=2, op="evict")
+    fr.record("sched", step=3, seq_id="req-3")
+    fr.record("slo", step=4, request_id="req-1")
+    assert [e["step"] for e in fr.events(kind="sched")] == [1, 3]
+    assert [e["step"] for e in fr.events(trace_id=tid)] == [1]
+    # request-id matches seq_id, request_id, and seq_ids membership
+    assert [e["step"] for e in fr.events(request_id="req-1")] == [1, 4]
+    assert [e["step"] for e in fr.events(request_id="req-3")] == [3]
+    assert [e["step"] for e in fr.events(since_step=2, until_step=3)] == [2, 3]
+    assert len(fr.events(limit=2)) == 2
+    # step-less events (KV manager ops, compile listener: step=-1) are
+    # ALWAYS inside a step-range window — a postmortem cut by step range
+    # must not silently read as "no evictions, no compiles"
+    fr.record("compile", event="backend_compile", seconds=0.5)
+    kinds = {e["kind"] for e in fr.events(since_step=2, until_step=3)}
+    assert "compile" in kinds
+
+
+def test_export_for_query_validates_ints():
+    payload, status = fr_mod.export_for_query({"since_step": "bogus"})
+    assert status == 400 and "error" in payload
+
+
+def test_dump_writes_parseable_json_and_rate_limits(tmp_path):
+    fr = FlightRecorder(capacity=32, dump_dir=str(tmp_path))
+    fr.record("sched", step=1)
+    p1 = fr.dump("test_reason")
+    assert p1 is not None and os.path.exists(p1)
+    with open(p1) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "test_reason"
+    kinds = [e["kind"] for e in payload["events"]]
+    # the trigger itself is recorded into the window before export
+    assert "sched" in kinds and "anomaly" in kinds
+    # rate limit: an immediate second dump for the same reason is refused...
+    assert fr.dump("test_reason") is None
+    # ...but a forced dump (crash/SIGTERM semantics) bypasses it
+    assert fr.dump("test_reason", force=True) is not None
+    assert fr.dumps_total == 2
+
+
+def test_dump_without_dir_is_noop():
+    fr = FlightRecorder(capacity=8)
+    assert fr.dump("anything", force=True) is None
+    assert fr.dumps_total == 0
+
+
+# -- SLO monitor --------------------------------------------------------------
+
+
+def _rec(seq, outcome="ok", ttft=100.0, itl=10.0, model="m"):
+    return {
+        "seq": seq, "request_id": f"r{seq}", "model": model,
+        "outcome": outcome, "ttft_ms": ttft, "itl_p99_ms": itl,
+    }
+
+
+@pytest.fixture()
+def slo():
+    SingletonMeta._reset(SLOMonitor)
+    yield SLOMonitor(ttft_ms=200.0, itl_ms=50.0, saturation_queue_ref=4)
+    SingletonMeta._reset(SLOMonitor)
+
+
+def test_slo_objectives_and_outcomes(slo):
+    url = "http://e1"
+    n = slo.ingest(url, {"head": 4, "next": 4, "records": [
+        _rec(1, ttft=100.0, itl=10.0),      # attains both
+        _rec(2, ttft=500.0, itl=10.0),      # violates ttft
+        _rec(3, outcome="shed", ttft=None, itl=None),  # violates availability
+        _rec(4, ttft=100.0, itl=90.0),      # violates itl
+    ]})
+    assert n == 4 and slo.cursor(url) == 4
+    c = slo._counters
+    assert c[(url, "m", "ttft")] == [2, 1]
+    assert c[(url, "m", "itl")] == [2, 1]
+    assert c[(url, "m", "availability")] == [3, 1]
+    # a shed abstains from the latency objectives (no double charge)
+    lines = "\n".join(slo.render(fleet_saturation=0.25))
+    assert 'vllm_router:slo_attained_total{objective="ttft",model="m",server="http://e1"} 2' in lines
+    assert 'vllm_router:slo_violated_total{objective="availability",model="m",server="http://e1"} 1' in lines
+    assert 'outcome="shed"' in lines
+    assert "vllm_router:fleet_saturation 0.25" in lines
+
+
+def test_slo_cursor_resets_on_engine_restart(slo):
+    url = "http://e1"
+    slo.ingest(url, {"head": 10, "next": 10, "records": [_rec(10)]})
+    assert slo.cursor(url) == 10
+    # reborn engine: head regressed below our cursor -> reset to 0 so the
+    # next scrape picks the new incarnation's records from the start
+    slo.ingest(url, {"head": 2, "next": 10, "records": []})
+    assert slo.cursor(url) == 0
+    n = slo.ingest(url, {"head": 2, "next": 2, "records": [_rec(1), _rec(2)]})
+    assert n == 2 and slo.cursor(url) == 2
+
+
+def test_slo_malformed_records_skipped(slo):
+    n = slo.ingest("u", {"head": 2, "next": 2, "records": [
+        "not-a-dict-entry", _rec(2),
+    ]})
+    assert n == 1
+
+
+def test_fleet_saturation_scores(slo):
+    class ES:
+        def __init__(self, saturated=0, waiting=0):
+            self.engine_saturated = saturated
+            self.num_queuing_requests = waiting
+
+    stats = {"a": ES(saturated=1), "b": ES(waiting=2), "c": ES(waiting=0)}
+    # a: 1.0 (saturated flag), b: 2/4, c: 0 -> mean 0.5
+    assert slo.fleet_saturation(stats) == pytest.approx(0.5)
+    # a backend inside a shed Retry-After window scores 1.0 even without
+    # the scraped flag
+    assert slo.fleet_saturation(stats, shedding_urls=["c"]) == pytest.approx(
+        (1.0 + 0.5 + 1.0) / 3
+    )
+    assert slo.fleet_saturation({}) == 0.0
+
+
+# -- device monitor -----------------------------------------------------------
+
+
+def test_devicemon_renders_fallback_rows_without_engine():
+    from production_stack_tpu.engine.devicemon import DeviceMonitor
+
+    lines = DeviceMonitor(engine=None).metrics_lines("m")
+    text = "\n".join(lines)
+    # memory rows always present (host fallback at worst), compile + duty
+    # gauges always rendered
+    assert "vllm:tpu_hbm_bytes_in_use{" in text
+    assert "vllm:hbm_headroom_bytes{" in text
+    assert "vllm:compile_seconds_total{" in text
+    assert 'vllm:engine_step_duty_cycle{model_name="m"} 0.0' in text
+    # no KV gauges without a kv manager (duck-typed engine degradation)
+    assert "kv_pool_device_bytes" not in text
+
+
+# -- e2e: fake engine surfaces ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fr_stack(tmp_path_factory):
+    """Fake engine (with dump dir + synthetic feed knobs) behind a router."""
+    dump_dir = str(tmp_path_factory.mktemp("frdumps"))
+    eport, rport = free_port(), free_port()
+    fake = start_proc(
+        ["-m", "production_stack_tpu.testing.fake_engine",
+         "--port", str(eport), "--model", "fake/model", "--speed", "500",
+         "--flight-dump-dir", dump_dir,
+         "--compile-stall-ms", "30",
+         "--slo-itl-ms", "123.0"]
+    )
+    engine_url = f"http://127.0.0.1:{eport}"
+    wait_healthy(f"{engine_url}/health", fake, timeout=60)
+    router = start_proc(
+        ["-m", "production_stack_tpu.router.app", "--port", str(rport),
+         "--static-backends", engine_url, "--static-models", "fake/model",
+         "--engine-stats-interval", "1", "--enable-debug-endpoints"]
+    )
+    router_url = f"http://127.0.0.1:{rport}"
+    wait_healthy(f"{router_url}/health", router, timeout=60)
+    try:
+        yield router_url, engine_url, dump_dir
+    finally:
+        stop_proc(router)
+        stop_proc(fake)
+
+
+def test_e2e_flightrecorder_export_cross_links_to_trace(fr_stack):
+    router_url, engine_url, _ = fr_stack
+    r = requests.post(
+        f"{router_url}/v1/completions",
+        json={"model": "fake/model", "prompt": "x", "max_tokens": 8},
+        timeout=15,
+    )
+    assert r.status_code == 200
+    export = requests.get(
+        f"{engine_url}/v1/debug/flightrecorder", timeout=10
+    ).json()
+    kinds = {e["kind"] for e in export["events"]}
+    assert {"sched", "kv", "compile", "slo"} <= kinds
+    # sched events carry trace ids that the router's span ring also holds
+    traces = requests.get(f"{router_url}/v1/traces?limit=100", timeout=10).json()
+    router_ids = {t["trace_id"] for t in traces["traces"]}
+    linked = {
+        e["trace_id"] for e in export["events"] if e.get("trace_id")
+    }
+    assert linked & router_ids
+    # filter surface: request-scoped view is non-empty for a served request
+    req_id = r.headers["X-Request-Id"]
+    scoped = requests.get(
+        f"{engine_url}/v1/debug/flightrecorder",
+        params={"request_id": req_id}, timeout=10,
+    ).json()
+    assert scoped["events"], "request-id filter returned nothing"
+
+
+def test_e2e_slo_records_cursor_protocol(fr_stack):
+    router_url, engine_url, _ = fr_stack
+    requests.post(
+        f"{router_url}/v1/completions",
+        json={"model": "fake/model", "prompt": "x", "max_tokens": 4},
+        timeout=15,
+    )
+    first = requests.get(f"{engine_url}/slo_records?since=0", timeout=10).json()
+    assert first["records"] and first["head"] >= first["records"][-1]["seq"]
+    rec = first["records"][-1]
+    assert rec["outcome"] == "ok"
+    assert rec["itl_p99_ms"] == 123.0  # --slo-itl-ms injected value
+    assert rec["ttft_ms"] is not None and rec["kv_pages_peak"] >= 1
+    # cursor advance: nothing new since the head
+    again = requests.get(
+        f"{engine_url}/slo_records?since={first['next']}", timeout=10
+    ).json()
+    assert again["records"] == []
+    assert requests.get(
+        f"{engine_url}/slo_records?since=bogus", timeout=10
+    ).status_code == 400
+
+
+def test_e2e_crosslink_report_renders(fr_stack):
+    router_url, engine_url, _ = fr_stack
+    r = requests.post(
+        f"{router_url}/v1/completions",
+        json={"model": "fake/model", "prompt": "x", "max_tokens": 8},
+        timeout=15,
+    )
+    assert r.status_code == 200
+    merged = trace_report.merge_exports(*(
+        requests.get(f"{u}/v1/traces?limit=200", timeout=10).json()
+        for u in (router_url, engine_url)
+    ))
+    export = requests.get(
+        f"{engine_url}/v1/debug/flightrecorder", timeout=10
+    ).json()
+    # newest trace that has recorder events cross-linked to it
+    linked_ids = {e["trace_id"] for e in export["events"] if e.get("trace_id")}
+    target = next(t for t in merged if t in linked_ids)
+    out = trace_report.crosslink_report(merged, export, target)
+    assert "cross-linked by trace id" in out
+    assert " span " in out and "event" in out
+    assert trace_report.crosslink_report(merged, export, "f" * 32).startswith(
+        "trace"
+    )
+
+
+def test_e2e_metrics_expose_recorder_and_span_loss_counters(fr_stack):
+    router_url, engine_url, _ = fr_stack
+    etext = requests.get(f"{engine_url}/metrics", timeout=10).text
+    for name in (
+        "vllm:trace_spans_dropped_total",
+        "vllm:trace_buffer_capacity",
+        "vllm:flightrecorder_events_total",
+        "vllm:flightrecorder_dropped_events_total",
+        "vllm:flightrecorder_dumps_total",
+    ):
+        assert name in etext, f"{name} missing on fake engine /metrics"
+    rtext = requests.get(f"{router_url}/metrics", timeout=10).text
+    assert 'vllm:trace_spans_dropped_total{source="router"' in rtext

@@ -1,0 +1,48 @@
+"""Tier-1 wiring for scripts/check_metrics_coverage.py: every emitted
+vllm:/vllm_router:/fake: metric must be documented (docs/) and dashboarded
+(or justified in the script's allowlist). PRs 2-6 each hand-added panels
+and nothing caught a forgotten metric — this does."""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir, "scripts")
+)
+import check_metrics_coverage as cmc  # noqa: E402
+
+
+def test_all_emitted_metrics_covered():
+    violations = cmc.check()
+    assert not violations, (
+        "metrics coverage guard failed (document the metric in "
+        "docs/observability.md and chart it, or justify it in "
+        "scripts/check_metrics_coverage.py DASHBOARD_ALLOWLIST):\n"
+        + "\n".join(violations)
+    )
+
+
+def test_extraction_sees_the_known_surfaces():
+    """The extractor must keep seeing each emission mechanism — a refactor
+    that silently empties one layer would turn the guard into a no-op."""
+    names = cmc.emitted_metrics()
+    # full-name literal (router resilience)
+    assert "vllm_router:retries_total" in names
+    # emit("<name>") first arg in api_server
+    assert "vllm:num_requests_running" in names
+    # engine stats() dict key forwarded under vllm:
+    assert "vllm:kv_evicted_pages_total" in names
+    # warmstart stats key
+    assert "vllm:warm_start_restored_pages" in names
+    # GENERATED dynamic family
+    assert "vllm:engine_loop_step_seconds_total" in names
+    # f-string family prefixes must NOT leak as truncated names
+    assert not any(n.endswith(("_", "hop")) for n in names)
+
+
+def test_brace_family_expansion():
+    text = cmc._expand_brace_families(
+        "docs mention vllm:engine_loop_{wait,step}_seconds_total here"
+    )
+    assert "vllm:engine_loop_wait_seconds_total" in text
+    assert "vllm:engine_loop_step_seconds_total" in text

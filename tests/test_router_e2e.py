@@ -51,6 +51,90 @@ def _routed_endpoints(log: str) -> list[str]:
     return [m.group(3) for m in ROUTE_RE.finditer(log)]
 
 
+class TestSLOAccounting:
+    """Acceptance (ISSUE 7): the router exports per-objective SLO attainment
+    counters and a prometheus-adapter-consumable fleet saturation gauge,
+    fed end-to-end by the fake engines' /slo_records terminal records."""
+
+    def test_slo_counters_and_fleet_saturation_end_to_end(self):
+        # backend A is fast (attains both objectives); backend B injects a
+        # slow TTFT and reports a 500 ms ITL p99 (violates both)
+        pa, pb = free_port(), free_port()
+        procs = [
+            start_proc(["-m", "production_stack_tpu.testing.fake_engine",
+                        "--port", str(pa), "--model", "fake/model",
+                        "--speed", "500"]),
+            start_proc(["-m", "production_stack_tpu.testing.fake_engine",
+                        "--port", str(pb), "--model", "fake/model",
+                        "--speed", "500", "--ttft", "0.4",
+                        "--slo-itl-ms", "500"]),
+        ]
+        urls = [f"http://127.0.0.1:{pa}", f"http://127.0.0.1:{pb}"]
+        router = None
+        try:
+            for proc, url in zip(procs, urls):
+                wait_healthy(f"{url}/health", proc, timeout=30)
+            router, base = _start_router(
+                urls, extra=["--slo-ttft-ms", "200", "--slo-itl-ms", "100"]
+            )
+            for _ in range(8):  # roundrobin: 4 requests per backend
+                r = requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "x",
+                          "max_tokens": 4},
+                    timeout=20,
+                )
+                assert r.status_code == 200, r.text
+
+            def counters():
+                text = requests.get(f"{base}/metrics", timeout=10).text
+                out = {}
+                for line in text.splitlines():
+                    if line.startswith((
+                        "vllm_router:slo_", "vllm_router:fleet_saturation"
+                    )):
+                        name, val = line.rsplit(" ", 1)
+                        out[name] = float(val)
+                return out
+
+            # the scraper pulls /slo_records on the engine-stats cadence
+            deadline = time.time() + 15
+            c = {}
+            while time.time() < deadline:
+                c = counters()
+                if sum(
+                    v for k, v in c.items() if "slo_records_total" in k
+                ) >= 8:
+                    break
+                time.sleep(0.5)
+
+            def val(name, objective, server):
+                return c.get(
+                    f"vllm_router:{name}"
+                    f'{{objective="{objective}",model="fake/model",'
+                    f'server="{server}"}}', 0.0
+                )
+
+            fast, slow = urls
+            # fast backend attains, slow backend violates — per objective
+            for objective in ("ttft", "itl"):
+                assert val("slo_attained_total", objective, fast) >= 4, c
+                assert val("slo_violated_total", objective, fast) == 0, c
+                assert val("slo_violated_total", objective, slow) >= 4, c
+                assert val("slo_attained_total", objective, slow) == 0, c
+            # availability attained everywhere (all requests finished ok)
+            for url in urls:
+                assert val("slo_attained_total", "availability", url) >= 4, c
+            # the autoscaling gauge is present and sane (idle fleet ~0)
+            assert "vllm_router:fleet_saturation" in c, c
+            assert 0.0 <= c["vllm_router:fleet_saturation"] <= 1.0, c
+        finally:
+            if router is not None:
+                stop_proc(router)
+            for p in procs:
+                stop_proc(p)
+
+
 class TestRoundRobin:
     def test_distribution(self):
         fakes, urls = _start_fakes(2)

@@ -413,6 +413,100 @@ def test_e2e_trace_id_filter(stack):
     ).status_code == 400
 
 
+def test_collector_counts_ring_wrap_and_sampling_drops():
+    """Satellite (ISSUE 7): span loss was silent — ring-wrap overwrites and
+    head-sampling rejections must be countable before someone debugs with
+    an incomplete trace."""
+    col = SpanCollector(capacity=4, sample_rate=1.0)
+    ctx = SpanContext.new_root()
+    for i in range(10):
+        col.record("s", ctx.child(), float(i), 0.1)
+    assert col.overwritten == 6  # 10 recorded into 4 slots
+    unsampled = SpanContext.new_root(sampled=False)
+    for _ in range(3):
+        col.record("s", unsampled.child(), 0.0, 0.1)
+    assert col.sampling_rejected == 3
+    assert col.recorded == 10  # rejections never consumed slots
+    from production_stack_tpu.tracing.collector import render_collector_metrics
+
+    # the render helper reads the PROCESS-global collector; just assert the
+    # series names and label plumbing (values belong to that collector)
+    lines = "\n".join(render_collector_metrics('model_name="m"'))
+    assert 'vllm:trace_spans_dropped_total{model_name="m",reason="ring_wrap"}' in lines
+    assert 'vllm:trace_spans_dropped_total{model_name="m",reason="unsampled"}' in lines
+    assert 'vllm:trace_buffer_capacity{model_name="m"}' in lines
+    col.reset()
+    assert col.overwritten == 0 and col.sampling_rejected == 0
+
+
+def test_flightrecorder_hot_path_overhead_micro():
+    """Satellite (ISSUE 7): the recorder rides the engine's dispatch path —
+    its per-event cost must stay micro-scale (the bench-level guarantee is
+    flightrecorder_overhead_ratio >= 0.98; this is the unit-scale tripwire).
+    Bounds are deliberately loose for noisy CI hosts."""
+    from production_stack_tpu.tracing import FlightRecorder
+
+    fr = FlightRecorder(capacity=8192, enabled=True)
+    n = 20000
+    t0 = time.perf_counter()
+    for i in range(n):
+        fr.record("sched", step=i, batch_kind="decode", rows=8, bursts=4)
+    per_enabled = (time.perf_counter() - t0) / n
+    fr.set_enabled(False)
+    t0 = time.perf_counter()
+    for i in range(n):
+        fr.record("sched", step=i, batch_kind="decode", rows=8, bursts=4)
+    per_disabled = (time.perf_counter() - t0) / n
+    assert per_enabled < 100e-6, f"record() cost {per_enabled * 1e6:.1f}us"
+    assert per_disabled < 20e-6, (
+        f"disabled record() cost {per_disabled * 1e6:.1f}us"
+    )
+
+
+def _parse_label_sets(metrics_text):
+    import re
+
+    pair_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+    out = {}
+    for line in metrics_text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        label_blob = line[line.index("{") + 1:line.rindex("}")]
+        for key, value in pair_re.findall(label_blob):
+            out.setdefault(key, set()).add(value)
+    return out
+
+
+def test_metric_label_cardinality_bounded(stack):
+    """Satellite (ISSUE 7): no Prometheus series may carry per-request
+    labels — one label key whose values track request ids turns a scrape
+    into an unbounded time-series explosion. Drive traffic, then assert
+    label keys are a closed set and per-key value counts stay small."""
+    router_url, engine_url = stack
+    for _ in range(5):
+        requests.post(
+            f"{router_url}/v1/completions",
+            json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+            timeout=15,
+        )
+    allowed = {
+        "model_name", "server", "backend", "quantile", "le", "kind",
+        "source", "device", "reason", "objective", "model", "outcome",
+    }
+    forbidden = {"request_id", "seq_id", "trace_id", "x_request_id"}
+    for url in (router_url, engine_url):
+        labels = _parse_label_sets(requests.get(f"{url}/metrics", timeout=10).text)
+        assert not (set(labels) & forbidden), (url, set(labels) & forbidden)
+        assert set(labels) <= allowed, (url, set(labels) - allowed)
+        for key, values in labels.items():
+            assert len(values) < 64, (url, key, len(values))
+            # no label VALUE smuggling a request id either (uuid4-shaped or
+            # the engine's req- prefix)
+            for v in values:
+                assert not v.startswith(("req-", "cmpl-", "chatcmpl-")), (key, v)
+                assert len(v) < 80, (key, v)
+
+
 def test_smoke_both_metrics_endpoints_expose_phase_histograms(stack):
     """Tier-1 smoke: the four per-phase histograms are present on BOTH
     /metrics surfaces under their vLLM-compatible names (the dashboard's
