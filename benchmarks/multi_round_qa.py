@@ -264,8 +264,14 @@ class UserSessionManager:
             # Only conversations long enough for FULL sessions are kept
             # (reference filter: num_round >= 2 * num_rounds) so request
             # count and history depth stay comparable across runs.
-            with open(a.sharegpt) as f:
-                data = json.load(f)
+            # ShareGPT dumps run tens of MB: read off the event loop so a
+            # slow disk cannot delay the load generator's first requests
+            # (graftcheck GC001)
+            def _read_sharegpt():
+                with open(a.sharegpt) as f:
+                    return json.load(f)
+
+            data = await asyncio.to_thread(_read_sharegpt)
             convs = [
                 d["conversations"] for d in data
                 if d.get("num_round", len(d.get("conversations", [])))

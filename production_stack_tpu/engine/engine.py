@@ -303,8 +303,11 @@ class LLMEngine:
         # prefill dispatches whose results were never fetched (skip-fetch
         # optimization); a deferred device error taints these sequences
         self._unfetched: list = []
-        self._outputs: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
-        self._texts: dict[str, str] = {}
+        # two-writer maps (event-loop generate() registers/pops, device
+        # thread _emit/_process_token reads/writes): every touch goes
+        # through _lock — graftcheck GC004 enforces the discipline
+        self._outputs: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}  # guarded-by: _lock
+        self._texts: dict[str, str] = {}  # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._sleeping = False
@@ -1267,7 +1270,10 @@ class LLMEngine:
             # (per-token, burst, or speculative round) must not change the
             # streamed text. Held-back chars flush on the finishing emit.
             full = full.rstrip("�")
-        prev = self._texts.get(seq.seq_id, "")
+        # under _lock: generate()'s finally pops this entry from the event
+        # loop concurrently (unlocked read found by graftcheck GC004)
+        with self._lock:
+            prev = self._texts.get(seq.seq_id, "")
         delta = full[len(prev):] if full.startswith(prev) else full
         if seq.params.stop and any(s in raw for s in seq.params.stop):
             # Stop detection must not depend on emission boundaries (per-token
@@ -1305,7 +1311,12 @@ class LLMEngine:
                     # appeared; the emitted text ends at the stop, so report it
                     seq.finish_reason = "stop"
         with self._lock:
-            self._texts[seq.seq_id] = prev + delta
+            # presence-gated: generate()'s finally may have popped the entry
+            # since the read above (client abandoned the stream) — an
+            # unconditional write would RESURRECT it, and with the only
+            # removal site already run, leak the full text forever
+            if seq.seq_id in self._texts:
+                self._texts[seq.seq_id] = prev + delta
         self._emit(seq, delta, tokens=new_tokens, logprobs=logprobs)
 
     def _record_phase_trace(self, seq: Sequence) -> None:

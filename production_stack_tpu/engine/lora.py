@@ -57,10 +57,13 @@ class LoRAManager:
         self.max_loras = max_loras  # concurrent adapters (slot 0 = base, extra)
         self.max_rank = max_rank
         self._lock = threading.Lock()
-        self._slots: dict[str, int] = {}  # name -> slot (1-based; 0 = base)
+        # name -> slot (1-based; 0 = base), generation of the current load,
+        # and slot -> in-flight request count: the HTTP executor threads
+        # load/unload while requests resolve/pin — all under _lock
+        self._slots: dict[str, int] = {}  # guarded-by: _lock
         self._gen = 0  # bumped per load: versions the prefix-cache salt
-        self._salt_gen: dict[str, int] = {}  # name -> generation of current load
-        self._refs: dict[int, int] = {}  # slot -> in-flight request count
+        self._salt_gen: dict[str, int] = {}  # guarded-by: _lock
+        self._refs: dict[int, int] = {}  # guarded-by: _lock
 
     # -- queries -------------------------------------------------------------
 

@@ -227,8 +227,11 @@ class TieredKVStore:
         self.remote = RemoteTier(remote_url) if remote_url else None
         self.on_local_drop = on_local_drop
         self._lock = threading.RLock()
-        self.hits = {"cpu": 0, "disk": 0, "remote": 0}
-        self.misses = 0
+        # bumped from every thread that reads the store (engine device
+        # thread, transfer receiver, proactive spill) — shared `+=` on a
+        # dict slot loses increments without the lock (graftcheck GC004)
+        self.hits = {"cpu": 0, "disk": 0, "remote": 0}  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
         # blobs evicted out the BOTTOM of the local hierarchy (disk-tier
         # eviction, or CPU-tier eviction with no disk tier). Without a remote
         # tier this is permanent KV loss — it used to happen silently;
@@ -340,8 +343,11 @@ class TieredKVStore:
         if self.remote is not None:
             blob = self.remote.get(key)
             if blob is not None and self._verified(key, blob, "remote", self.remote):
-                self.hits["remote"] += 1
                 with self._lock:
+                    # counter bump inside the promote's lock window: the
+                    # unlocked `+=` raced the cpu/disk paths' locked bumps
+                    # and dropped increments (found by graftcheck GC004)
+                    self.hits["remote"] += 1
                     if self.cpu is not None:
                         self._spill(self.cpu.put(key, blob))
                 return blob

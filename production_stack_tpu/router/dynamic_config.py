@@ -69,7 +69,12 @@ class DynamicConfigWatcher:
                 mtime = os.path.getmtime(self.config_path)
                 if mtime != self._mtime:
                     self._mtime = mtime
-                    cfg = DynamicRouterConfig.from_json(self.config_path)
+                    # config read off the loop: a ConfigMap mount mid-update
+                    # (or any slow volume) must not stall in-flight streaming
+                    # proxies for the duration of a sync read (GC001)
+                    cfg = await asyncio.to_thread(
+                        DynamicRouterConfig.from_json, self.config_path
+                    )
                     await self._apply(cfg)
             except FileNotFoundError:
                 pass

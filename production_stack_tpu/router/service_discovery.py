@@ -278,8 +278,13 @@ class K8sPodIPServiceDiscovery(ServiceDiscovery):
             params["labelSelector"] = self.label_selector
         while True:
             try:
+                # token read off the loop: _auth_headers re-reads the mounted
+                # serviceaccount token file on every watch (re)connect (kubelet
+                # rotates it), and a slow/overloaded kubelet volume must not
+                # stall in-flight streaming proxies (graftcheck GC001)
+                headers = await asyncio.to_thread(self._auth_headers)
                 async with aiohttp.ClientSession(
-                    headers=self._auth_headers(),
+                    headers=headers,
                     timeout=aiohttp.ClientTimeout(total=None, sock_read=60),
                 ) as session:
                     async with session.get(url, params=params, ssl=self._ssl_ctx()) as resp:

@@ -523,6 +523,115 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             {"tokens": list(text.encode()), "count": len(text.encode()), "max_model_len": 4096}
         )
 
+    # -- real-engine route parity (graftcheck GC005): every engine route the
+    # router proxies or probes must answer here too, or e2e runs against the
+    # fake 404 where production would not. Deterministic dummy payloads in
+    # the real wire shapes.
+
+    async def detokenize(request):
+        body = await request.json()
+        toks = body.get("tokens", [])
+        return web.json_response(
+            {"prompt": bytes(t & 0xFF for t in toks).decode(errors="replace")}
+        )
+
+    def _fake_embedding(text: str, dim: int = 8) -> list[float]:
+        """Deterministic unit vector from the text bytes — stable across
+        processes so reranking/scoring assertions are reproducible."""
+        import hashlib
+
+        h = hashlib.blake2b(str(text).encode(), digest_size=dim).digest()
+        v = [b / 255.0 + 1e-3 for b in h]
+        n = sum(x * x for x in v) ** 0.5
+        return [x / n for x in v]
+
+    async def embeddings(request):
+        body = await request.json()
+        raw = body.get("input", [])
+        items = [raw] if isinstance(raw, str) else list(raw)
+        if not items:
+            return web.json_response(
+                {"error": {"message": "'input' is required"}}, status=400
+            )
+        return web.json_response({
+            "object": "list",
+            "model": body.get("model", model),
+            "data": [
+                {"object": "embedding", "index": i,
+                 "embedding": _fake_embedding(t)}
+                for i, t in enumerate(items)
+            ],
+            "usage": {"prompt_tokens": len(items), "total_tokens": len(items)},
+        })
+
+    def _cosine(a: list, b: list) -> float:
+        return sum(x * y for x, y in zip(a, b))
+
+    async def rerank(request):
+        body = await request.json()
+        try:
+            query, documents = body["query"], list(body["documents"])
+        except (KeyError, TypeError) as e:
+            return web.json_response(
+                {"error": {"message": f"invalid request: {e}"}}, status=400
+            )
+        qv = _fake_embedding(query)
+        scores = [_cosine(qv, _fake_embedding(d)) for d in documents]
+        top_n = int(body.get("top_n", len(documents)))
+        order = sorted(range(len(documents)), key=lambda i: -scores[i])[:top_n]
+        return web.json_response({
+            "id": f"rerank-{uuid.uuid4().hex[:16]}",
+            "model": body.get("model", model),
+            "results": [
+                {"index": i, "document": {"text": documents[i]},
+                 "relevance_score": scores[i]}
+                for i in order
+            ],
+        })
+
+    async def score(request):
+        body = await request.json()
+        try:
+            t1, t2 = body["text_1"], body["text_2"]
+        except (KeyError, TypeError) as e:
+            return web.json_response(
+                {"error": {"message": f"invalid request: {e}"}}, status=400
+            )
+        left = [t1] if isinstance(t1, str) else list(t1)
+        right = [t2] if isinstance(t2, str) else list(t2)
+        if len(left) == 1:
+            left = left * len(right)
+        if len(left) != len(right):
+            return web.json_response(
+                {"error": {"message": "text_1 and text_2 lengths do not match"}},
+                status=400,
+            )
+        return web.json_response({
+            "id": f"score-{uuid.uuid4().hex[:16]}",
+            "object": "list",
+            "model": body.get("model", model),
+            "data": [
+                {"index": i, "object": "score",
+                 "score": _cosine(_fake_embedding(a), _fake_embedding(b))}
+                for i, (a, b) in enumerate(zip(left, right))
+            ],
+            "usage": {"prompt_tokens": len(left) + len(right)},
+        })
+
+    async def version(request):
+        return web.json_response({"version": "fake-engine"})
+
+    async def metrics_reset(request):
+        """Same debug contract as the real engine's POST /metrics/reset:
+        clear the per-phase sample windows so a bench phase's quantiles
+        describe that phase (counters stay)."""
+        from production_stack_tpu.tracing import reset_phase_histograms
+
+        reset_phase_histograms()
+        get_collector().reset()
+        get_flightrecorder().reset()
+        return web.json_response({"status": "ok"})
+
     app = web.Application()
     app.router.add_get("/health", health)
     app.router.add_get("/v1/models", models)
@@ -537,6 +646,13 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     app.router.add_post("/wake_up", wake_up)
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/tokenize", tokenize)
+    app.router.add_post("/detokenize", detokenize)
+    app.router.add_post("/v1/embeddings", embeddings)
+    app.router.add_post("/v1/rerank", rerank)
+    app.router.add_post("/v2/rerank", rerank)
+    app.router.add_post("/v1/score", score)
+    app.router.add_get("/version", version)
+    app.router.add_post("/metrics/reset", metrics_reset)
     return app
 
 

@@ -71,7 +71,7 @@ class FlightRecorder:
         self.dump_dir = dump_dir
         self._slots: list = [None] * self.capacity
         self._cursor = itertools.count()
-        self._last_dump: dict[str, float] = {}
+        self._last_dump: dict[str, float] = {}  # guarded-by: _dump_lock
         self._dump_lock = threading.Lock()
         self.dumps_total = 0
 
@@ -219,10 +219,10 @@ class FlightRecorder:
         stay synchronous — the process is about to die."""
         if not self.dump_dir:
             return
-        if (
-            time.monotonic() - self._last_dump.get(reason, -1e18)
-            < DUMP_MIN_INTERVAL_S
-        ):
+        # dump() re-reads _last_dump under _dump_lock authoritatively; the
+        # worst a torn read here costs is one spare no-op thread
+        last = self._last_dump.get(reason, -1e18)  # graftcheck: disable=GC004 — racy-by-design rate-limit pre-check, dump() re-checks under the lock
+        if time.monotonic() - last < DUMP_MIN_INTERVAL_S:
             return
         threading.Thread(
             target=self.dump, args=(reason,), daemon=True

@@ -1,0 +1,42 @@
+"""graftcheck — repo-native static analysis for the hazard classes this
+stack has actually shipped bugs in (docs/static-analysis.md).
+
+Four AST checkers plus an endpoint-contract guard, sharing one parsed view
+of the tree (core.RepoIndex — same single-scan shape as
+scripts/check_metrics_coverage.py):
+
+- GC001 event-loop blocking: blocking primitives (time.sleep, sync file/
+  HTTP/subprocess I/O, unbounded lock.acquire, jax.block_until_ready)
+  reachable from an ``async def``, including one level of intra-package
+  transitive calls. (PR 5's chaos harness found the router event loop wedged
+  by exactly this — blocking log-pipe writes.)
+- GC002 donation/aliasing safety: intra-function use of an array after it
+  was passed at a donated argnum of a jitted callable, and operand reuse
+  after a ``pallas_call`` with live ``input_output_aliases``. (PR 6's fused
+  in-kernel KV write aliases the pools; seven donate_argnums sites in
+  runner.py.)
+- GC003 tracer/jit hygiene: Python branching, host conversions
+  (float/int/bool/.item()/np.asarray), and logging/f-strings on traced
+  values inside functions handed to jax.jit / lax.scan / Pallas — every one
+  is a silent recompile or host sync (PR 7's vllm:compile_seconds_total
+  exists to catch the aftermath).
+- GC004 lock discipline: attributes annotated ``# guarded-by: <lock>`` may
+  only be touched inside ``with <lock>`` (single-file scope; __init__ /
+  module top level exempt as pre-thread initialization).
+- GC005 endpoint-contract parity: every engine route the router names must
+  exist on BOTH the real engine (api_server.py) and the fake engine
+  (testing/fake_engine.py) — fake/real drift otherwise only surfaces as
+  flaky e2e failures.
+
+Suppression: ``# graftcheck: disable=GCnnn — <reason>`` on the finding's
+line (or a standalone comment on the line above). The reason is mandatory,
+and an unused suppression is itself a violation — same rot policy as the
+metrics guard's allowlist. Pre-existing findings whose fix is not local live
+in ``baseline.json`` with a mandatory justification; a baseline entry that
+no longer matches a finding is rot and fails the guard.
+
+Run: ``python -m scripts.graftcheck`` (pure ast — no JAX import), or through
+tier-1 via tests/test_graftcheck.py.
+"""
+
+from .core import Finding, RepoIndex, run_graftcheck  # noqa: F401

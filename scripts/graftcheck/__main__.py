@@ -1,0 +1,78 @@
+"""CLI: ``python -m scripts.graftcheck [--rule GCnnn] [--all-findings]``.
+
+Exit 0 when the tree has zero unsuppressed, un-baselined findings (the
+tier-1 contract tests/test_graftcheck.py enforces); exit 1 with a report
+otherwise. Pure ast — no JAX import — so it runs as a fast standalone CI
+step next to check_metrics_coverage.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import RepoIndex, load_baseline, run_graftcheck
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        "graftcheck", description="repo-native static analysis "
+        "(GC001 event-loop blocking, GC002 donation/aliasing, GC003 "
+        "tracer hygiene, GC004 lock discipline, GC005 endpoint parity)")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only these rule ids (repeatable), e.g. GC001")
+    ap.add_argument("--all-findings", action="store_true",
+                    help="also print findings silenced by suppressions/"
+                    "baseline (audit view)")
+    args = ap.parse_args(argv)
+
+    checkers = None
+    if args.rule:
+        from . import (gc001_eventloop, gc002_donation, gc003_tracer,
+                       gc004_locks, gc005_endpoints)
+
+        all_checkers = {c.RULE: c for c in (
+            gc001_eventloop, gc002_donation, gc003_tracer, gc004_locks,
+            gc005_endpoints,
+        )}
+        unknown = [r for r in args.rule if r not in all_checkers]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}")
+            return 2
+        checkers = [all_checkers[r] for r in args.rule]
+
+    if args.all_findings:
+        index = RepoIndex()
+        raw = []
+        from .core import _checkers
+
+        for c in (checkers if checkers is not None else _checkers()):
+            raw.extend(c.check(index))
+        for f in sorted(raw, key=lambda f: (f.path, f.line)):
+            print(f.render())
+        print(f"\n{len(raw)} raw finding(s) before suppression/baseline")
+        return 0
+
+    violations, stats = run_graftcheck(
+        checkers=checkers, baseline=load_baseline(),
+    )
+    print(
+        f"graftcheck: {stats['files']} files, {stats['raw_findings']} raw, "
+        f"{stats['suppressed']} suppressed, {stats['baselined']} baselined"
+    )
+    if violations:
+        print("GRAFTCHECK FAILED:")
+        for f in sorted(violations, key=lambda f: (f.path, f.line)):
+            print(f"  - {f.render()}")
+        print(
+            "\nFix the hazard, or silence it with a reasoned\n"
+            "'# graftcheck: disable=GCnnn — <reason>' on the line (see\n"
+            "docs/static-analysis.md for the suppression/baseline policy)."
+        )
+        return 1
+    print("GRAFTCHECK PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,227 @@
+"""GC001 — event-loop blocking.
+
+A blocking primitive anywhere under an ``async def`` stalls EVERY request the
+loop is serving, not just the one that called it: PR 5's rolling-restart
+chaos found the router wedged by blocking log-pipe writes, and PR 7 had to
+move flight-recorder serialization off the loop (``dump_async``) for exactly
+this reason. This checker flags the mechanically detectable core of that
+class:
+
+- direct blocking calls in an ``async def`` body (``time.sleep``, sync HTTP
+  via ``requests``/``urllib``, ``subprocess``, builtin ``open``, unbounded
+  ``lock.acquire()``, ``jax.block_until_ready``, ``os.system``), and
+- ONE level of intra-package transitive calls: an ``async def`` calling a
+  sync function (same module, same class, or an imported
+  ``production_stack_tpu`` module) whose own body contains a blocking call.
+
+Nested function definitions are skipped in both passes: a def nested inside
+an async handler is almost always an executor thunk
+(``asyncio.to_thread(_write)`` — the files-service pattern), which is the
+CORRECT way to do blocking work. ``.acquire()`` is exempt when awaited
+(asyncio locks) or called with ``blocking=False``/a ``timeout=``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import (
+    Finding,
+    PyFile,
+    RepoIndex,
+    dotted_name,
+    iter_nodes_skipping_nested_defs,
+)
+
+RULE = "GC001"
+
+# dotted-call-name prefixes that block the calling thread
+_BLOCKING_EXACT = {
+    "time.sleep": "time.sleep blocks the event loop — use asyncio.sleep",
+    "os.system": "os.system blocks the event loop",
+    "socket.create_connection": "sync socket connect blocks the event loop",
+    "urllib.request.urlopen": "sync HTTP (urllib) blocks the event loop",
+    "jax.block_until_ready":
+        "jax.block_until_ready stalls the loop on device completion",
+}
+_BLOCKING_PREFIX = {
+    "requests.": "sync HTTP (requests) blocks the event loop",
+    "subprocess.": "sync subprocess call blocks the event loop",
+}
+_BLOCKING_METHODS = {
+    "block_until_ready":
+        ".block_until_ready() stalls the loop on device completion",
+}
+
+
+def _blocking_reason(call: ast.Call, awaited: bool) -> Optional[tuple[str, str]]:
+    """(detail, message) when `call` is a blocking primitive, else None."""
+    name = dotted_name(call.func)
+    if name is not None:
+        if name in _BLOCKING_EXACT:
+            return name, _BLOCKING_EXACT[name]
+        for prefix, msg in _BLOCKING_PREFIX.items():
+            if name.startswith(prefix):
+                return name, msg
+        if name == "open":
+            return "open", (
+                "builtin open() is sync file I/O — wrap in asyncio.to_thread"
+            )
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr in _BLOCKING_METHODS:
+            return attr, _BLOCKING_METHODS[attr]
+        if attr == "acquire" and not awaited:
+            kw = {k.arg for k in call.keywords}
+            has_bound = bool({"timeout", "blocking"} & kw) or call.args
+            if not has_bound:
+                return "acquire", (
+                    "unbounded lock.acquire() can block the event loop "
+                    "indefinitely — await an asyncio lock or bound it"
+                )
+    return None
+
+
+def _blocking_in_body(fn: ast.AST) -> list[tuple[ast.Call, str, str]]:
+    """Blocking calls directly in `fn`'s body (nested defs skipped).
+    Returns (call_node, detail, message)."""
+    out = []
+    awaited_calls = set()
+    for node in iter_nodes_skipping_nested_defs(fn.body):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            awaited_calls.add(id(node.value))
+    for node in iter_nodes_skipping_nested_defs(fn.body):
+        if isinstance(node, ast.Call):
+            hit = _blocking_reason(node, awaited=id(node) in awaited_calls)
+            if hit is not None:
+                out.append((node, hit[0], hit[1]))
+    return out
+
+
+class _ModuleMaps:
+    """Per-file resolution tables for one-level transitive calls."""
+
+    def __init__(self, pf: PyFile, index: RepoIndex):
+        self.functions: dict[str, ast.AST] = {}          # module-level defs
+        self.methods: dict[tuple[str, str], ast.AST] = {}  # (class, name)
+        self.imports: dict[str, str] = {}                # alias -> module
+        self.from_imports: dict[str, tuple[str, str]] = {}  # name -> (mod, orig)
+        if pf.tree is None:
+            return
+        for node in pf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.methods[(node.name, sub.name)] = sub
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.from_imports[a.asname or a.name] = (node.module, a.name)
+
+    def resolve(self, call: ast.Call, cls: Optional[str],
+                index: RepoIndex) -> Optional[tuple[ast.AST, str]]:
+        """Resolve a call to an intra-package function def, one level deep.
+        Returns (def_node, display_name) or None."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            if fn.id in self.functions:
+                return self.functions[fn.id], fn.id
+            hit = self.from_imports.get(fn.id)
+            if hit is not None:
+                mod, orig = hit
+                target = index.by_module.get(mod)
+                if target is not None:
+                    maps = _maps_for(target, index)
+                    if orig in maps.functions:
+                        return maps.functions[orig], f"{mod}.{orig}"
+            return None
+        if isinstance(fn, ast.Attribute):
+            # self.method() / ClassName.method() in the same file
+            if isinstance(fn.value, ast.Name):
+                base = fn.value.id
+                if base == "self" and cls is not None:
+                    hit = self.methods.get((cls, fn.attr))
+                    if hit is not None:
+                        return hit, f"self.{fn.attr}"
+                for (kls, name), node in self.methods.items():
+                    if base == kls and name == fn.attr:
+                        return node, f"{kls}.{fn.attr}"
+                # imported_module.func()
+                mod = self.imports.get(base)
+                if mod is None and base in self.from_imports:
+                    sub_mod, orig = self.from_imports[base]
+                    mod = f"{sub_mod}.{orig}"
+                if mod is not None:
+                    target = index.by_module.get(mod)
+                    if target is not None:
+                        maps = _maps_for(target, index)
+                        if fn.attr in maps.functions:
+                            return maps.functions[fn.attr], f"{mod}.{fn.attr}"
+        return None
+
+
+_maps_cache: dict[str, _ModuleMaps] = {}
+
+
+def _maps_for(pf: PyFile, index: RepoIndex) -> _ModuleMaps:
+    maps = _maps_cache.get(pf.path)
+    if maps is None:
+        maps = _maps_cache[pf.path] = _ModuleMaps(pf, index)
+    return maps
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    _maps_cache.clear()
+    findings: list[Finding] = []
+    for pf in index.files:
+        if pf.tree is None:
+            continue
+        maps = _maps_for(pf, index)
+        # every async def, wherever it nests
+        for scope, node in _async_defs(pf.tree):
+            cls = scope.split(".")[-2] if "." in scope else None
+            # direct blocking calls
+            for call, detail, msg in _blocking_in_body(node):
+                findings.append(Finding(
+                    RULE, pf.path, call.lineno, scope, detail,
+                    f"{msg} (in async def {node.name})",
+                ))
+            # one-level transitive: sync callee with a blocking body
+            for sub in iter_nodes_skipping_nested_defs(node.body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                resolved = maps.resolve(sub, cls, index)
+                if resolved is None:
+                    continue
+                callee, display = resolved
+                if isinstance(callee, ast.AsyncFunctionDef):
+                    continue  # awaited coroutine — its own body is checked
+                for _, detail, msg in _blocking_in_body(callee):
+                    findings.append(Finding(
+                        RULE, pf.path, sub.lineno, scope,
+                        f"{detail} via {display}",
+                        f"{msg} — reached through sync call {display}() "
+                        f"from async def {node.name}",
+                    ))
+    return findings
+
+
+def _async_defs(tree: ast.Module):
+    """(dotted scope, AsyncFunctionDef) pairs, at any nesting depth."""
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                if isinstance(child, ast.AsyncFunctionDef):
+                    yield sub, child
+                yield from visit(child, sub)
+            else:
+                yield from visit(child, scope)
+    yield from visit(tree, "")

@@ -1,0 +1,318 @@
+"""GC002 — donation / aliasing safety.
+
+``jax.jit(..., donate_argnums=...)`` hands the donated buffers to XLA: after
+the call the Python-side array is INVALID, and touching it returns garbage
+(or raises under a runtime that checks). The runner threads its KV pools
+through seven donating dispatch sites, and PR 6's fused in-kernel KV write
+additionally aliases the pools through ``pallas_call``'s
+``input_output_aliases`` — both patterns are correct ONLY because every call
+site immediately rebinds the donated names (``self.k_pages, self.v_pages =
+fn(...)``). This checker enforces that shape mechanically, intra-function:
+
+- Track callables created by ``jax.jit(..., donate_argnums=(i, ...))``,
+  whether bound to a local, an attribute (``self._set_page_fn``), a
+  subscripted cache (``self._steps[sig] = ...``), or returned by a same-class
+  helper whose return expression is one of those caches.
+- At each call of a tracked callable, resolve the argument expressions at
+  the donated positions (``*args`` expands through a tuple literal assigned
+  earlier in the same function) and flag any LOAD of the same expression
+  later in the function before it is rebound.
+- Same use-after logic for array operands of a ``pl.pallas_call(...)``
+  carrying a non-empty ``input_output_aliases`` — the aliased pool outputs
+  own the buffer; the old operand handles are dead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Finding, RepoIndex, dotted_name, expr_text
+
+RULE = "GC002"
+
+
+def _donated_positions(call: ast.Call) -> Optional[tuple[int, ...]]:
+    """donate_argnums of a jax.jit(...) call, when literal."""
+    name = dotted_name(call.func)
+    if name not in ("jax.jit", "jit"):
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Tuple):
+                out = []
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        out.append(el.value)
+                return tuple(out)
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+    return None
+
+
+def _is_pallas_aliased(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name is None or not name.endswith("pallas_call"):
+        return False
+    for kw in call.keywords:
+        if kw.arg == "input_output_aliases":
+            v = kw.value
+            if isinstance(v, ast.Dict) and not v.keys:
+                return False  # literally empty — nothing aliased
+            return True
+    return False
+
+
+def _target_keys(target: ast.AST) -> list[str]:
+    """Identity keys a binding target invalidates: the exact expression text,
+    and for subscripted caches the base container too."""
+    keys = [expr_text(target)]
+    if isinstance(target, ast.Subscript):
+        keys.append(expr_text(target.value))
+    return keys
+
+
+def _cache_base(node: ast.AST) -> Optional[str]:
+    """'self._steps' for self._steps[sig]; None for non-subscripts."""
+    if isinstance(node, ast.Subscript):
+        return expr_text(node.value)
+    return None
+
+
+class _FunctionChecker:
+    def __init__(self, pf, scope: str, fn: ast.AST,
+                 file_jit_map: dict[str, tuple[int, ...]],
+                 helper_returns: dict[tuple[str, str], tuple[int, ...]],
+                 cls: Optional[str]):
+        self.pf = pf
+        self.scope = scope
+        self.fn = fn
+        self.file_jit_map = file_jit_map
+        self.helper_returns = helper_returns
+        self.cls = cls
+        self.findings: list[Finding] = []
+
+    def run(self) -> list[Finding]:
+        local_jit: dict[str, tuple[int, ...]] = {}
+        tuple_literals: dict[str, list[ast.expr]] = {}
+        # text -> (line donated, via what) for still-dead expressions
+        dead: dict[str, tuple[int, str]] = {}
+
+        for stmt in self._linear_statements(self.fn):
+            # uses BEFORE this statement's (re)bindings take effect
+            self._flag_uses(stmt, dead)
+            donate_call = None
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    pos = self._call_donates(node, local_jit)
+                    if pos is not None:
+                        donate_call = (node, pos)
+                    elif _is_pallas_aliased(node):
+                        # the returned kernel is called immediately or bound;
+                        # either way its operands die at the invocation
+                        invoke = self._pallas_invocation(stmt, node)
+                        if invoke is not None:
+                            for arg in invoke.args:
+                                if isinstance(arg, ast.Starred):
+                                    continue
+                                if isinstance(arg, ast.Name):
+                                    dead[expr_text(arg)] = (
+                                        node.lineno, "pallas input_output_aliases"
+                                    )
+            if donate_call is not None:
+                call, positions = donate_call
+                args = self._positional_args(call, tuple_literals)
+                for p in positions:
+                    if p < len(args):
+                        t = expr_text(args[p])
+                        dead[t] = (call.lineno, f"donated argnum {p}")
+            # bindings: jit-map registration, tuple literals, revival
+            if isinstance(stmt, ast.Assign):
+                if isinstance(stmt.value, ast.Call):
+                    pos = _donated_positions(stmt.value)
+                    if pos is not None:
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                local_jit[t.id] = pos
+                if isinstance(stmt.value, ast.Tuple):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            tuple_literals[t.id] = list(stmt.value.elts)
+            for target in self._binding_targets(stmt):
+                for k in _target_keys(target):
+                    dead.pop(k, None)
+        return self.findings
+
+    # -- helpers -------------------------------------------------------------
+
+    def _linear_statements(self, fn: ast.AST):
+        """Statements in source order, descending into compound statements
+        but not nested defs. Branch-insensitive by design: a donate in one
+        branch and a use in the other is a false positive we accept over
+        missing the straight-line case (none exist in this tree)."""
+        out: list[ast.stmt] = []
+
+        def rec(body):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                out.append(stmt)
+                for field in ("body", "orelse", "finalbody"):
+                    rec(getattr(stmt, field, []) or [])
+                for h in getattr(stmt, "handlers", []) or []:
+                    rec(h.body)
+        rec(fn.body)
+        return out
+
+    def _call_donates(self, call: ast.Call,
+                      local_jit: dict[str, tuple[int, ...]]
+                      ) -> Optional[tuple[int, ...]]:
+        """Donated positions when `call` invokes a tracked jitted callable."""
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id in local_jit:
+            return local_jit[fn.id]
+        text = expr_text(fn)
+        if text in self.file_jit_map:
+            return self.file_jit_map[text]
+        base = _cache_base(fn)
+        if base is not None and base in self.file_jit_map:
+            return self.file_jit_map[base]
+        # same-class helper returning a jit cache: self._get_step(...)(...)
+        if (isinstance(fn, ast.Call) and isinstance(fn.func, ast.Attribute)
+                and isinstance(fn.func.value, ast.Name)
+                and fn.func.value.id == "self" and self.cls is not None):
+            return self.helper_returns.get((self.cls, fn.func.attr))
+        return None
+
+    def _positional_args(self, call: ast.Call,
+                         tuple_literals: dict[str, list[ast.expr]]
+                         ) -> list[ast.expr]:
+        out: list[ast.expr] = []
+        for a in call.args:
+            if isinstance(a, ast.Starred):
+                if (isinstance(a.value, ast.Name)
+                        and a.value.id in tuple_literals):
+                    out.extend(tuple_literals[a.value.id])
+                else:
+                    break  # unknown expansion — stop mapping positions
+            else:
+                out.append(a)
+        return out
+
+    def _pallas_invocation(self, stmt: ast.stmt,
+                           pallas: ast.Call) -> Optional[ast.Call]:
+        """The Call whose func IS the pallas_call(...) expression (the
+        immediate-invoke idiom: pl.pallas_call(...)(operands...))."""
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and node.func is pallas:
+                return node
+        return None
+
+    def _binding_targets(self, stmt: ast.stmt):
+        targets: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if getattr(stmt, "value", None) is not None or isinstance(stmt, ast.AugAssign):
+                targets = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            targets = [stmt.target]
+        flat: list[ast.AST] = []
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                flat.extend(t.elts)
+            else:
+                flat.append(t)
+        return flat
+
+    def _flag_uses(self, stmt: ast.stmt, dead: dict[str, tuple[int, str]]):
+        if not dead:
+            return
+        # ignore the binding targets themselves (store context)
+        target_ids = {id(t) for t in self._binding_targets(stmt)}
+        for node in ast.walk(stmt):
+            if id(node) in target_ids:
+                continue
+            if not isinstance(node, (ast.Name, ast.Attribute, ast.Subscript)):
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            t = expr_text(node)
+            hit = dead.get(t)
+            if hit is not None:
+                line_donated, via = hit
+                self.findings.append(Finding(
+                    RULE, self.pf.path, node.lineno, self.scope,
+                    f"use-after-donate:{t}",
+                    f"{t} was donated at line {line_donated} ({via}) and is "
+                    "used again before being rebound — the buffer is dead",
+                ))
+                dead.pop(t, None)  # one report per donation
+
+
+def _collect_file_maps(pf) -> "tuple[dict, dict]":
+    """(file_jit_map, helper_returns): expression-text -> donated positions
+    for jit stores anywhere in the file, and same-class helpers whose return
+    expression resolves to one of those stores."""
+    file_jit_map: dict[str, tuple[int, ...]] = {}
+    if pf.tree is None:
+        return {}, {}
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            pos = _donated_positions(node.value)
+            if pos is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        continue  # plain locals are function-scoped — they
+                        # live in local_jit only, or names would collide
+                        # across functions in the same file
+                    for k in _target_keys(t):
+                        file_jit_map[k] = pos
+    helper_returns: dict[tuple[str, str], tuple[int, ...]] = {}
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in node.body:
+            if not isinstance(sub, ast.FunctionDef):
+                continue
+            for r in ast.walk(sub):
+                if isinstance(r, ast.Return) and r.value is not None:
+                    t = expr_text(r.value)
+                    base = _cache_base(r.value)
+                    pos = file_jit_map.get(t) or (
+                        file_jit_map.get(base) if base else None
+                    )
+                    if pos is not None:
+                        helper_returns[(node.name, sub.name)] = pos
+    return file_jit_map, helper_returns
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in index.files:
+        if pf.tree is None:
+            continue
+        file_jit_map, helper_returns = _collect_file_maps(pf)
+        for scope, node in _defs(pf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls = scope.split(".")[-2] if "." in scope else None
+            findings.extend(_FunctionChecker(
+                pf, scope, node, file_jit_map, helper_returns, cls
+            ).run())
+    return findings
+
+
+def _defs(tree: ast.Module):
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                yield sub, child
+                yield from visit(child, sub)
+            else:
+                yield from visit(child, scope)
+    yield from visit(tree, "")

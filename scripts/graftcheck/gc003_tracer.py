@@ -1,0 +1,346 @@
+"""GC003 — tracer / jit hygiene.
+
+Inside a function handed to ``jax.jit`` / ``lax.scan`` / ``pl.pallas_call``,
+array arguments are TRACERS. Host-flavored operations on them either crash
+(ConcretizationTypeError), silently force a device sync, or — worst for a
+serving engine — make the traced program shape-dependent so every new batch
+mints a fresh XLA compile (the failure mode PR 7's
+``vllm:compile_seconds_total`` telemetry was built to expose). Flagged, on
+values tainted by a traced parameter:
+
+- Python branching (``if``/``while`` tests, chained bool on tracers);
+  ``x is None`` / ``isinstance`` tests are exempt (static structure checks);
+- host conversions: ``float()``/``int()``/``bool()``/``len()`` on tainted
+  values, ``.item()``, ``np.asarray``/``np.array``, ``jax.device_get``;
+- ``range()`` iteration bounds on tainted values (concretization);
+- logging/printing: any ``print``/``logger.*`` call and any f-string
+  interpolating a tainted value (runs at trace time at best, host-sync at
+  worst — use ``jax.debug.print``).
+
+What counts as traced: for ``jax.jit(f)`` every parameter of ``f``; for
+``jax.jit(functools.partial(f, a, b))`` the parameters AFTER the bound
+prefix (partial-bound values are Python constants); ``static_argnames`` /
+``static_argnums`` are excluded; ``lax.scan`` body and Pallas kernel
+parameters are all traced. Taint propagates through simple assignments and
+into nested defs; it is dropped through ``.shape``/``.ndim``/``.dtype``/
+``.size`` (static on tracers).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Finding, RepoIndex, dotted_name
+
+RULE = "GC003"
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_HOST_CONVERSIONS = {"float", "int", "bool"}
+_NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+            "jax.device_get", "onp.asarray"}
+
+
+def _decorated_traced_params(fn: ast.FunctionDef) -> Optional[set[str]]:
+    """Traced parameter names when `fn` is jit-decorated, else None."""
+    for dec in fn.decorator_list:
+        name = dotted_name(dec) or (
+            dotted_name(dec.func) if isinstance(dec, ast.Call) else None
+        )
+        if name in ("jax.jit", "jit"):
+            return _params_minus_static(fn, dec if isinstance(dec, ast.Call) else None)
+        if name in ("functools.partial", "partial") and isinstance(dec, ast.Call):
+            if dec.args and dotted_name(dec.args[0]) in ("jax.jit", "jit"):
+                return _params_minus_static(fn, dec)
+    return None
+
+
+def _params_minus_static(fn: ast.FunctionDef,
+                         call: Optional[ast.Call]) -> set[str]:
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args
+              + fn.args.kwonlyargs]
+    static: set[str] = set()
+    if call is not None:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames" and isinstance(kw.value, (ast.Tuple, ast.List)):
+                static |= {
+                    el.value for el in kw.value.elts
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str)
+                }
+            if kw.arg == "static_argnums" and isinstance(kw.value, (ast.Tuple, ast.List)):
+                for el in kw.value.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        if el.value < len(params):
+                            static.add(params[el.value])
+    return set(params) - static - {"self"}
+
+
+def _registration_sites(tree: ast.Module):
+    """(function_name, n_bound, static_names) for functions handed to
+    jax.jit / lax.scan / pallas_call by NAME somewhere in the module.
+    One aliasing hop is resolved: ``kernel = functools.partial(_f, **cfg)``
+    then ``pl.pallas_call(kernel, ...)`` registers ``_f``."""
+    aliases: dict[str, ast.expr] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            if isinstance(node.value, (ast.Call, ast.Name)):
+                aliases[node.targets[0].id] = node.value
+    for name, n_bound, static in _raw_registration_sites(tree):
+        resolved = aliases.get(name)
+        if isinstance(resolved, ast.Call):
+            tname = dotted_name(resolved.func)
+            if tname in ("functools.partial", "partial") and resolved.args:
+                fn_ref = resolved.args[0]
+                if isinstance(fn_ref, ast.Name):
+                    yield fn_ref.id, n_bound + len(resolved.args) - 1, (
+                        static | {kw.arg for kw in resolved.keywords if kw.arg}
+                    )
+                    continue
+        elif isinstance(resolved, ast.Name):
+            yield resolved.id, n_bound, static
+            continue
+        yield name, n_bound, static
+
+
+def _raw_registration_sites(tree: ast.Module):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name in ("jax.jit", "jit") and node.args:
+            target = node.args[0]
+            static: set[str] = set()
+            for kw in node.keywords:
+                if kw.arg == "static_argnames" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    static |= {
+                        el.value for el in kw.value.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)
+                    }
+            if isinstance(target, ast.Name):
+                yield target.id, 0, static
+            elif isinstance(target, ast.Call):
+                tname = dotted_name(target.func)
+                if tname in ("functools.partial", "partial") and target.args:
+                    fn_ref = target.args[0]
+                    if isinstance(fn_ref, (ast.Name, ast.Attribute)):
+                        base = (fn_ref.id if isinstance(fn_ref, ast.Name)
+                                else fn_ref.attr)
+                        yield base, len(target.args) - 1, static
+        elif name is not None and (name.endswith("lax.scan")
+                                   or name == "scan") and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                yield target.id, 0, set()
+        elif name is not None and name.endswith("pallas_call"):
+            target = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "kernel":
+                    target = kw.value
+            if isinstance(target, ast.Name):
+                yield target.id, 0, set()
+            elif isinstance(target, ast.Call):
+                tname = dotted_name(target.func)
+                if tname in ("functools.partial", "partial") and target.args:
+                    fn_ref = target.args[0]
+                    if isinstance(fn_ref, ast.Name):
+                        # partial KWARGS bind kernel config (static);
+                        # positional binds offset the traced refs
+                        yield fn_ref.id, len(target.args) - 1, {
+                            kw.arg for kw in target.keywords if kw.arg
+                        }
+
+
+class _TraceChecker(ast.NodeVisitor):
+    def __init__(self, pf, scope: str, fn: ast.AST, tainted: set[str]):
+        self.pf = pf
+        self.scope = scope
+        self.fn = fn
+        self.tainted = set(tainted)
+        self.findings: list[Finding] = []
+
+    # -- taint ----------------------------------------------------------------
+
+    def _is_tainted(self, node: ast.AST) -> bool:
+        """Any tainted Name in the expression, not counting names that only
+        appear under a static-attr read (x.shape / x.ndim / x.dtype are
+        concrete even on tracers)."""
+        found = False
+
+        def rec(n: ast.AST) -> None:
+            nonlocal found
+            if found:
+                return
+            if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+                return  # static read — do not descend
+            if isinstance(n, ast.Name) and n.id in self.tainted:
+                found = True
+                return
+            for c in ast.iter_child_nodes(n):
+                rec(c)
+
+        rec(node)
+        return found
+
+    def _flag(self, node: ast.AST, detail: str, msg: str) -> None:
+        self.findings.append(Finding(
+            RULE, self.pf.path, getattr(node, "lineno", 0),
+            self.scope, detail, msg,
+        ))
+
+    # -- visitors -------------------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # nested defs (scan bodies defined inline) trace too: their params
+        # receive carried tracers
+        inner = set(a.arg for a in node.args.args + node.args.kwonlyargs)
+        self.tainted |= inner
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node: ast.Assign):
+        # a structural test (`x is None`, `k in pytree`) yields a Python
+        # bool even when x is a tracer — it does not propagate taint
+        if self._is_tainted(node.value) and not _is_structural_test(node.value):
+            for t in node.targets:
+                for el in ([t] if not isinstance(t, (ast.Tuple, ast.List))
+                           else t.elts):
+                    if isinstance(el, ast.Name):
+                        self.tainted.add(el.id)
+        else:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.tainted.discard(t.id)
+        self.generic_visit(node)
+
+    def _check_test(self, node, test: ast.AST, kind: str):
+        if _is_structural_test(test):
+            return
+        if self._is_tainted(test):
+            self._flag(
+                node, f"branch:{kind}",
+                f"Python `{kind}` on a traced value — the condition is "
+                "abstract at trace time; use lax.cond/jnp.where "
+                "(or mark the argument static)",
+            )
+
+    def visit_If(self, node: ast.If):
+        self._check_test(node, node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        self._check_test(node, node.test, "while")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        name = dotted_name(node.func)
+        if name in _HOST_CONVERSIONS or name == "len":
+            if node.args and self._is_tainted(node.args[0]):
+                self._flag(
+                    node, f"host-conversion:{name}",
+                    f"{name}() on a traced value forces host concretization "
+                    "— a silent device sync (or a trace error)",
+                )
+        elif name in _NP_SYNC:
+            if node.args and self._is_tainted(node.args[0]):
+                self._flag(
+                    node, f"host-sync:{name}",
+                    f"{name}() inside a traced function pulls the value to "
+                    "host — use jnp, or move the conversion outside jit",
+                )
+        elif name == "range":
+            if any(self._is_tainted(a) for a in node.args):
+                self._flag(
+                    node, "range-on-tracer",
+                    "range() over a traced value concretizes it — use "
+                    "lax.fori_loop / lax.scan",
+                )
+        elif name == "print" or (name is not None and (
+                name.startswith("logger.") or name.startswith("logging."))):
+            self._flag(
+                node, f"logging:{name}",
+                f"{name}() inside a traced function runs at trace time only "
+                "(or host-syncs a tracer) — use jax.debug.print",
+            )
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            if self._is_tainted(node.func.value):
+                self._flag(
+                    node, "host-conversion:item",
+                    ".item() on a traced value is a blocking device→host "
+                    "sync inside the program",
+                )
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr):
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue) and self._is_tainted(v.value):
+                self._flag(
+                    node, "fstring-on-tracer",
+                    "f-string interpolates a traced value — formats the "
+                    "abstract tracer (or host-syncs); use jax.debug.print",
+                )
+                break
+        self.generic_visit(node)
+
+
+def _is_structural_test(test: ast.AST) -> bool:
+    """Tests that are static at trace time: `x is None`, `x is not None`,
+    isinstance(...), and boolean combinations thereof."""
+    if isinstance(test, ast.BoolOp):
+        return all(_is_structural_test(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_structural_test(test.operand)
+    if isinstance(test, ast.Compare):
+        # is/is not: identity, always static. in/not in: on traced pytrees
+        # this is a dict-KEY membership check — static structure, not data
+        return all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in test.ops)
+    if isinstance(test, ast.Call):
+        return dotted_name(test.func) in ("isinstance", "hasattr", "callable",
+                                          "getattr")
+    return False
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in index.files:
+        if pf.tree is None:
+            continue
+        # registrations by name anywhere in the file
+        registered: dict[str, tuple[int, set]] = {}
+        for name, n_bound, static in _registration_sites(pf.tree):
+            prev = registered.get(name)
+            if prev is None or n_bound < prev[0]:
+                registered[name] = (n_bound, static)
+        for scope, node in _defs(pf.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            tainted: Optional[set[str]] = _decorated_traced_params(node)
+            if tainted is None and node.name in registered:
+                n_bound, static = registered[node.name]
+                params = [a.arg for a in node.args.posonlyargs + node.args.args
+                          + node.args.kwonlyargs]
+                tainted = set(params[n_bound:]) - static - {"self"}
+            if not tainted:
+                continue
+            checker = _TraceChecker(pf, scope, node, tainted)
+            for stmt in node.body:
+                checker.visit(stmt)
+            findings.extend(checker.findings)
+    return findings
+
+
+def _defs(tree: ast.Module):
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                yield sub, child
+                yield from visit(child, sub)
+            else:
+                yield from visit(child, scope)
+    yield from visit(tree, "")

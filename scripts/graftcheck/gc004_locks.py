@@ -1,0 +1,218 @@
+"""GC004 — lock discipline for annotated shared state.
+
+The engine is a two-writer system (device thread + event loop), the
+collector/flight-recorder rings take writes from both, and the offload tiers
+take a third (transfer threads). Attributes that NEED a lock declare it at
+their initializing assignment:
+
+    self._outputs = {}  # guarded-by: _lock
+
+From then on, every access to that attribute IN THE SAME FILE must sit
+lexically inside ``with self._lock:`` (or ``with <lock>:`` for module-level
+state guarded by a module-level lock). Exempt:
+
+- the declaring assignment itself and the rest of ``__init__`` (or module
+  top level for globals) — no second thread exists yet;
+- lines carrying a reasoned ``# graftcheck: disable=GC004`` suppression
+  (the documented-racy patterns: benign unlocked reads of atomically
+  rebound references, racy-by-design rate-limit pre-checks).
+
+The checker is deliberately lexical (no inter-procedural lock tracking):
+the repo's locking idiom is short ``with`` blocks, and a helper that
+assumes its caller holds the lock should say so with a suppression — that
+is documentation the next reader needs anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .core import Finding, RepoIndex, expr_text
+
+RULE = "GC004"
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+def _annotations(pf) -> "list[tuple[str, Optional[str], str, int]]":
+    """(attr, class_name or None for module globals, lock_name, line) for
+    every '# guarded-by: <lock>' annotation sitting on an assignment."""
+    out = []
+    if pf.tree is None:
+        return out
+    ann_lines: dict[int, str] = {}
+    for i, line in enumerate(pf.lines, start=1):
+        m = _GUARD_RE.search(line)
+        if m:
+            ann_lines[i] = m.group(1)
+    if not ann_lines:
+        return out
+
+    def scan(body, cls: Optional[str]):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                scan(node.body, node.name)
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(node.body, cls)
+                continue
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                lock = ann_lines.get(node.lineno)
+                if lock is None:
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id == "self":
+                        out.append((t.attr, cls, lock, node.lineno))
+                    elif isinstance(t, ast.Name) and cls is None:
+                        out.append((t.id, None, lock, node.lineno))
+            # descend into EVERY compound statement (loops, try/except/
+            # finally, with, if): an annotated assignment on a recovery or
+            # loop path must register, or the checker is a silent no-op for
+            # that attribute
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(node, field, None)
+                if isinstance(sub, list) and sub and isinstance(
+                        sub[0], ast.stmt):
+                    scan(sub, cls)
+            for handler in getattr(node, "handlers", []) or []:
+                scan(handler.body, cls)
+
+    scan(pf.tree.body, None)
+    return out
+
+
+def _lock_exprs(lock: str, is_attr: bool) -> set[str]:
+    """Source texts that count as holding `lock` in a with-statement."""
+    if is_attr:
+        return {f"self.{lock}", lock}
+    return {lock, f"self.{lock}"}
+
+
+class _AccessVisitor(ast.NodeVisitor):
+    """Walk one top-level def tracking the lexical with-lock stack."""
+
+    def __init__(self, pf, scope: str, guarded: dict, cls: Optional[str],
+                 findings: list):
+        self.pf = pf
+        self.scope = scope
+        self.guarded = guarded      # attr -> lock texts
+        self.cls = cls
+        self.findings = findings
+        self.held: list[set] = []
+        self._reported: set = set()
+
+    def _currently_held(self) -> set:
+        out: set = set()
+        for h in self.held:
+            out |= h
+        return out
+
+    def visit_With(self, node: ast.With):
+        acquired: set = set()
+        for item in node.items:
+            acquired.add(expr_text(item.context_expr))
+        # visit the context expressions OUTSIDE the lock scope (evaluating
+        # `self._lock` itself is not an access to guarded state)
+        for item in node.items:
+            self.visit(item.context_expr)
+        self.held.append(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held.pop()
+
+    # `async with lock:` holds the lock exactly like `with lock:` — the
+    # asyncio-lock case is the event-loop code this suite polices
+    visit_AsyncWith = visit_With
+
+    def visit_FunctionDef(self, node):
+        # nested defs run later, without this frame's locks — they are
+        # visited separately by check() with their own (empty) lock stack
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and node.attr in self.guarded):
+            self._check(node, node.attr)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        if node.id in self.guarded and self.guarded[node.id].get("module"):
+            self._check(node, node.id)
+        self.generic_visit(node)
+
+    def _check(self, node, attr: str) -> None:
+        lock_texts = self.guarded[attr]["locks"]
+        if lock_texts & self._currently_held():
+            return
+        # one finding per (attr, line): a read-modify-write touches the
+        # attribute twice on one line but is ONE violation
+        key = (attr, node.lineno)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.findings.append(Finding(
+            RULE, self.pf.path, node.lineno, self.scope,
+            f"unlocked:{attr}",
+            f"access to {attr!r} (guarded-by: "
+            f"{self.guarded[attr]['lock']}) outside `with "
+            f"{sorted(lock_texts)[0]}:`",
+        ))
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in index.files:
+        if pf.tree is None:
+            continue
+        anns = _annotations(pf)
+        if not anns:
+            continue
+        per_class: dict[Optional[str], dict] = {}
+        for attr, cls, lock, _line in anns:
+            per_class.setdefault(cls, {})[attr] = {
+                "lock": lock,
+                "locks": _lock_exprs(lock, is_attr=cls is not None),
+                "module": cls is None,
+            }
+        # walk every def; skip __init__ of the annotating class and module
+        # top level (initialization happens before any second thread)
+        for scope, node in _defs(pf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            parts = scope.split(".")
+            cls = parts[-2] if len(parts) > 1 else None
+            guarded = dict(per_class.get(cls, {}))
+            guarded.update(per_class.get(None, {}))  # module globals apply
+            if not guarded:
+                continue
+            if node.name == "__init__" and cls in per_class:
+                # attribute state may initialize unlocked; module globals
+                # accessed from __init__ still need their lock
+                guarded = {k: v for k, v in guarded.items() if v["module"]}
+                if not guarded:
+                    continue
+            v = _AccessVisitor(pf, scope, guarded, cls, findings)
+            for stmt in node.body:
+                v.visit(stmt)
+    return findings
+
+
+def _defs(tree: ast.Module):
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                yield sub, child
+                yield from visit(child, sub)
+            else:
+                yield from visit(child, scope)
+    yield from visit(tree, "")

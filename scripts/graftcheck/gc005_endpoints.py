@@ -1,0 +1,127 @@
+"""GC005 — endpoint-contract parity between the real and fake engines.
+
+The fake engine (testing/fake_engine.py) is the keystone fixture: chaos
+runs, router e2e tests, and the SLO scraper all talk to it AS IF it were the
+real engine. When the real engine grows a route the router starts calling
+and the fake never learns it, the drift only surfaces as a flaky e2e 404 —
+exactly the bug class this guard removes.
+
+Statically extracted, pure ast:
+
+- **engine routes**: ``r.add_get("/path", ...)`` / ``add_post`` registrations
+  in engine/api_server.py;
+- **fake routes**: the same registrations in testing/fake_engine.py;
+- **router-called paths**: every path literal the router package names —
+  plain string constants, trailing constants of client f-strings
+  (``f"{url}/metrics"`` → ``/metrics``), and literal arguments to
+  ``route_sleep_wakeup_request`` — intersected with the engine's route
+  table, so incidental strings ("/v1/files" is a router-own route) drop out.
+
+Violations:
+
+- a router-called engine route missing from the fake engine (fake/real
+  drift — the e2e surface lies), and
+- a router-called path that no engine route serves (client drift — the
+  router calls something the engine already removed). Extraction noise is
+  impossible for this direction by construction (the set is pre-intersected
+  with the union of both route tables).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, PyFile, RepoIndex
+
+RULE = "GC005"
+
+ENGINE_FILE = "production_stack_tpu/engine/api_server.py"
+FAKE_FILE = "production_stack_tpu/testing/fake_engine.py"
+ROUTER_DIR = "production_stack_tpu/router/"
+
+
+def extract_routes(pf: PyFile) -> dict[str, int]:
+    """{path: first registration line} from add_get/add_post calls."""
+    out: dict[str, int] = {}
+    if pf.tree is None:
+        return out
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr not in ("add_get", "add_post", "add_route"):
+            continue
+        args = node.args[1:] if node.func.attr == "add_route" else node.args
+        if args and isinstance(args[0], ast.Constant) and isinstance(
+                args[0].value, str):
+            out.setdefault(args[0].value, node.lineno)
+    return out
+
+
+def extract_router_paths(files: list[PyFile]) -> dict[str, tuple[str, int]]:
+    """{path: (file, line)} for every engine-path literal the router names."""
+    out: dict[str, tuple[str, int]] = {}
+
+    def note(path: str, pf: PyFile, line: int) -> None:
+        path = path.split("?")[0]
+        # path-shaped only: docstrings start with "/" too ("/sleep, /wake_up
+        # and ..."), but prose never survives the charset check
+        if (path.startswith("/") and len(path) > 1
+                and re.fullmatch(r"/[A-Za-z0-9_{}./-]+", path)):
+            out.setdefault(path, (pf.path, line))
+
+    for pf in files:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if node.value.startswith("/"):
+                    note(node.value, pf, node.lineno)
+            elif isinstance(node, ast.JoinedStr):
+                # f"{url}/metrics" → the trailing constant after the last
+                # formatted value is the client path
+                tail = node.values[-1] if node.values else None
+                if (isinstance(tail, ast.Constant)
+                        and isinstance(tail.value, str)
+                        and tail.value.startswith("/")
+                        and len(node.values) > 1):
+                    note(tail.value, pf, node.lineno)
+    return out
+
+
+def check_parity(engine_pf: PyFile, fake_pf: PyFile,
+                 router_files: list[PyFile]) -> list[Finding]:
+    engine_routes = extract_routes(engine_pf)
+    fake_routes = extract_routes(fake_pf)
+    called = extract_router_paths(router_files)
+    known = set(engine_routes) | set(fake_routes)
+    findings: list[Finding] = []
+    for path, (src, line) in sorted(called.items()):
+        if path not in known:
+            continue  # a router-own route or incidental literal
+        if path not in fake_routes:
+            findings.append(Finding(
+                RULE, FAKE_FILE, 1, "<routes>", f"fake-missing:{path}",
+                f"router calls {path} (seen at {src}:{line}) and the real "
+                "engine serves it, but testing/fake_engine.py does not — "
+                "e2e tests against the fake will 404 where production "
+                "would not",
+            ))
+        if path not in engine_routes:
+            findings.append(Finding(
+                RULE, src, line, "<routes>", f"engine-missing:{path}",
+                f"router calls {path} but engine/api_server.py has no such "
+                "route (only the fake serves it) — client/engine drift",
+            ))
+    return findings
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    engine_pf = index.get(ENGINE_FILE)
+    fake_pf = index.get(FAKE_FILE)
+    if engine_pf is None or fake_pf is None:
+        return []
+    router_files = [f for f in index.files if f.path.startswith(ROUTER_DIR)]
+    return check_parity(engine_pf, fake_pf, router_files)

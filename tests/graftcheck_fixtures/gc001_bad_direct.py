@@ -1,0 +1,34 @@
+"""GC001 violation fixture: blocking primitives directly in async defs.
+
+Never imported/executed — static-analysis corpus only (see README.md).
+Expected findings: 4 (time.sleep, requests.get, open, unbounded acquire).
+"""
+
+import threading
+import time
+
+import requests  # noqa: F401 - fixture import
+
+_lock = threading.Lock()
+
+
+async def handler_sleeps():
+    time.sleep(0.5)  # finding: time.sleep in async def
+    return "done"
+
+
+async def handler_sync_http(url):
+    return requests.get(url)  # finding: sync HTTP in async def
+
+
+async def handler_sync_file(path):
+    with open(path) as f:  # finding: sync open in async def
+        return f.read()
+
+
+async def handler_unbounded_lock():
+    _lock.acquire()  # finding: unbounded threading acquire in async def
+    try:
+        return 1
+    finally:
+        _lock.release()

@@ -1,0 +1,30 @@
+"""GC001 violation fixture: blocking call ONE sync hop away from an async
+def — the dynamic_config / service_discovery shape this PR fixed (an async
+watch loop calling a sync helper that opens a file).
+
+Expected findings: 2 (open via _read_config, time.sleep via Helper.backoff).
+"""
+
+import json
+import time
+
+
+def _read_config(path):
+    with open(path) as f:  # blocking body reached from async def below
+        return json.load(f)
+
+
+class Helper:
+    @staticmethod
+    def backoff():
+        time.sleep(1.0)  # blocking body reached from async def below
+
+
+async def watch_loop(path):
+    cfg = _read_config(path)  # finding: open() via _read_config
+    return cfg
+
+
+class Watcher:
+    async def poll(self):
+        Helper.backoff()  # finding: time.sleep via Helper.backoff

@@ -1,0 +1,45 @@
+"""GC001 clean fixture: the repo's correct idioms for blocking work near an
+event loop — executor thunks, asyncio primitives, bounded acquires.
+
+Expected findings: 0.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def sync_helper(path):
+    # blocking is FINE in sync code — only async reachability is the hazard
+    time.sleep(0.1)
+    with open(path) as f:
+        return json.load(f)
+
+
+async def handler_offloads(path):
+    # nested def used as an executor thunk: the files-service pattern
+    def _read():
+        with open(path) as f:
+            return f.read()
+
+    data = await asyncio.to_thread(_read)
+    await asyncio.sleep(0.01)
+    return data
+
+
+async def handler_to_thread_by_ref(path):
+    # passing the sync helper BY REFERENCE to a thread is the fix shape
+    return await asyncio.to_thread(sync_helper, path)
+
+
+async def handler_bounded_lock():
+    if _lock.acquire(timeout=0.5):  # bounded: cannot wedge the loop forever
+        _lock.release()
+
+
+async def handler_async_lock(alock: asyncio.Lock):
+    async with alock:
+        return 1

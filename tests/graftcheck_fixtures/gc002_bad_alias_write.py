@@ -1,0 +1,33 @@
+"""GC002 violation fixture: operand reuse after a pallas_call with live
+input_output_aliases — the PR 6 fused in-kernel KV write shape, where the
+pool handles passed in are dead once the aliased outputs exist.
+
+Expected findings: 1 (k_pages read after the aliased call).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, kp_ref, vp_ref):
+    o_ref[...] = q_ref[...]
+
+
+def fused_write_attention(q, k_pages, v_pages):
+    io_aliases = {1: 1, 2: 2}
+    out, kp_new, vp_new = pl.pallas_call(
+        functools.partial(_kernel),
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ),
+        input_output_aliases=io_aliases,
+    )(q, k_pages, v_pages)
+    # finding: the pool handle was aliased into kp_new — reading the OLD
+    # handle observes a buffer the kernel already overwrote
+    checksum = jnp.sum(k_pages)
+    return out, kp_new, vp_new, checksum

@@ -1,0 +1,49 @@
+"""GC002 violation fixture: the runner-shaped use-after-donate — pools
+passed at donated argnums of jitted callables and touched again before
+rebinding (the hazard class PR 6's review cycle caught by hand).
+
+Expected findings: 3 (direct local fn, attr-cached fn, *args expansion).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _step(params, k_pages, v_pages, ids):
+    return ids, k_pages, v_pages
+
+
+class BadRunner:
+    def __init__(self, params, k_pages, v_pages):
+        self.params = params
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+        self._fn = jax.jit(_step, donate_argnums=(1, 2))
+
+    def step_local(self, ids):
+        fn = jax.jit(_step, donate_argnums=(1, 2))
+        out, kp, vp = fn(self.params, self.k_pages, self.v_pages, ids)
+        return out + self.k_pages.sum()  # finding: k_pages donated, not rebound
+
+    def step_attr(self, ids):
+        out, kp, vp = self._fn(self.params, self.k_pages, self.v_pages, ids)
+        self.k_pages, self.v_pages = kp, vp
+        return out, vp
+
+    def step_attr_bad(self, ids):
+        out, kp, vp = self._fn(self.params, self.k_pages, self.v_pages, ids)
+        self.k_pages = kp
+        return out, self.v_pages  # finding: v_pages donated, never rebound
+
+    def step_star_args(self, ids):
+        args = (self.params, self.k_pages, self.v_pages, ids)
+        out, kp, vp = self._fn(*args)
+        self.k_pages, self.v_pages = kp, vp
+        return jnp.sum(args[1])  # latent: stale tuple slot — not tracked
+
+    def step_star_args_bad(self, ids):
+        args = (self.params, self.k_pages, self.v_pages, ids)
+        out, kp, vp = self._fn(*args)
+        total = self.v_pages.sum()  # finding: v_pages donated via *args
+        self.k_pages, self.v_pages = kp, vp
+        return out, total

@@ -1,0 +1,55 @@
+"""GC002 clean fixture: the repo's correct donation idiom — every call of a
+donating jitted callable immediately rebinds the donated names (runner.py's
+`self.k_pages, self.v_pages = fn(...)` shape).
+
+Expected findings: 0.
+"""
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def _step(params, k_pages, v_pages, ids):
+    return ids, k_pages, v_pages
+
+
+class GoodRunner:
+    def __init__(self, params, k_pages, v_pages):
+        self.params = params
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+        self._fn = jax.jit(_step, donate_argnums=(1, 2))
+        self._cache = {}
+
+    def step(self, ids):
+        out, self.k_pages, self.v_pages = self._fn(
+            self.params, self.k_pages, self.v_pages, ids
+        )
+        return out, self.k_pages.shape  # rebound first — safe
+
+    def _get_fn(self, sig):
+        if sig not in self._cache:
+            self._cache[sig] = jax.jit(_step, donate_argnums=(1, 2))
+        return self._cache[sig]
+
+    def step_cached(self, ids):
+        args = (self.params, self.k_pages, self.v_pages, ids)
+        out, self.k_pages, self.v_pages = self._get_fn(len(ids))(*args)
+        return out
+
+
+def _kernel(q_ref, o_ref, kp_ref, vp_ref):
+    o_ref[...] = q_ref[...]
+
+
+def fused_write_clean(q, k_pages, v_pages):
+    out, k_pages, v_pages = pl.pallas_call(
+        _kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ),
+        input_output_aliases={1: 1, 2: 2},
+    )(q, k_pages, v_pages)
+    return out, k_pages, v_pages  # rebound — the new handles are live

@@ -1,0 +1,41 @@
+"""GC003 violation fixture: Python control flow on traced values inside
+jitted / scanned functions — each branch concretizes a tracer (error) or
+bakes a data-dependent trace (a fresh XLA compile per distinct value, the
+vllm:compile_seconds_total failure mode).
+
+Expected findings: 3 (if on tracer, while on tracer, range on tracer).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _decode_step(params, tokens, kv_lens):
+    if kv_lens.sum() > 0:  # finding: `if` on a traced value
+        tokens = tokens + 1
+    return tokens
+
+
+_jitted = jax.jit(functools.partial(_decode_step, {"w": 1.0}))
+
+
+def _drain(carry, budget):
+    while budget > 0:  # finding: `while` on a traced value
+        carry = carry + 1
+        budget = budget - 1
+    return carry
+
+
+def scan_body(carry, x):
+    total = carry + x
+    for _ in range(total):  # finding: range() over a traced value
+        total = total * 1
+    return total, x
+
+
+def run(xs):
+    out, _ = lax.scan(scan_body, jnp.int32(0), xs)
+    return out, jax.jit(_drain)(out, xs.shape[0])

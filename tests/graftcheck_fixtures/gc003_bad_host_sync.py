@@ -1,0 +1,26 @@
+"""GC003 violation fixture: host conversions and logging on traced values —
+silent device syncs inside the program, or trace-time-only side effects that
+lie in production.
+
+Expected findings: 5 (float, .item, np.asarray, logger f-string, print).
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _sample(params, logits, temperature):
+    scale = float(temperature)  # finding: float() on a traced value
+    top = logits.max().item()  # finding: .item() on a traced value
+    host = np.asarray(logits)  # finding: np.asarray on a traced value
+    logger.info(f"sampling at t={scale} top={top}")  # finding: logging
+    print("logits ready")  # finding: print in traced code
+    return jnp.argmax(logits / jnp.maximum(scale, 1e-6)), host
+
+
+sample_fn = jax.jit(_sample)

@@ -1,0 +1,41 @@
+"""GC003 clean fixture: the repo's correct traced-code idioms — structural
+branching, static-attr reads, lax control flow, static args, and host work
+done OUTSIDE the jitted function.
+
+Expected findings: 0.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("greedy", "steps"))
+def _sample(logits, temperature, key, greedy=False, steps=1):
+    if greedy:  # static arg — legitimate Python branching
+        return jnp.argmax(logits, axis=-1)
+    if temperature is None:  # structural test — static at trace time
+        temperature = jnp.ones(logits.shape[0])
+    B, V = logits.shape  # .shape is concrete on tracers
+    if V > 1024:  # branching on a static shape is fine
+        logits = logits[:, :1024]
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # data-dependent selection via jnp.where, not Python `if`
+    out = jnp.where(temperature[:, None] <= 0, logits, scaled)
+    for _ in range(steps):  # static trip count — unrolled, no tracer leak
+        out = out * 1.0
+    return jax.random.categorical(key, out, axis=-1)
+
+
+def _body(carry, x):
+    # data-dependent control flow through lax, never Python
+    return lax.cond(x > 0, lambda c: c + x, lambda c: c, carry), x
+
+
+def run(xs, temperature, key):
+    total, _ = lax.scan(_body, jnp.int32(0), xs)
+    ids = _sample(xs.astype(jnp.float32), temperature, key, greedy=False)
+    # host conversion OUTSIDE the traced function: correct place to sync
+    return int(total), ids

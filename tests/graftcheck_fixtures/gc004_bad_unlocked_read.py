@@ -1,0 +1,34 @@
+"""GC004 violation fixture: unlocked READ of guarded state — the torn-read
+shape (engine._texts in _process_token, found and fixed by this rule): the
+reader races a concurrent pop/replace and acts on half-updated state.
+
+Expected findings: 2 (read in render, module-global read in peek).
+"""
+
+import threading
+
+_lock = threading.Lock()
+_registry: dict = {}  # guarded-by: _lock
+
+
+class BadReader:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._texts: dict = {}  # guarded-by: _lock
+
+    def append(self, key: str, delta: str) -> None:
+        with self._lock:
+            self._texts[key] = self._texts.get(key, "") + delta
+
+    def render(self, key: str) -> str:
+        # finding: races append/pop on other threads — torn view
+        return self._texts.get(key, "")
+
+
+def register(name, value) -> None:
+    with _lock:
+        _registry[name] = value
+
+
+def peek(name):
+    return _registry.get(name)  # finding: module-global read without _lock

@@ -1,0 +1,42 @@
+"""GC004 violation fixture: writes to guarded-by-annotated attributes
+outside their lock — the two-writer `dict[k] += 1` shape that drops
+increments (engine.requests_shed is single-writer BY doc for this reason).
+
+Expected findings: 2 (unlocked write in note, unlocked pop in forget).
+"""
+
+import threading
+
+
+class BadRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: dict = {}  # guarded-by: _lock
+        self.total = 0
+
+    def note(self, key: str) -> None:
+        # finding: two threads here lose increments (load/add/store race)
+        self._counts[key] = self._counts.get(key, 0) + 1
+        with self._lock:
+            self.total += 1  # total is not annotated — not checked
+
+    def forget(self, key: str) -> None:
+        self._counts.pop(key, None)  # finding: unlocked write
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._counts)  # locked — clean
+
+
+class BadRecoveryPath:
+    def __init__(self):
+        self._lock = threading.Lock()
+        try:
+            self._state: dict = {"mode": "warm"}  # guarded-by: _lock
+        except Exception:
+            self._state = {}
+
+    def flip(self, mode: str) -> None:
+        # finding: the annotation sits on a try-branch assignment and must
+        # still register — an unlocked write here is the same lost update
+        self._state["mode"] = mode

@@ -1,0 +1,12 @@
+"""GC005 fixture: a mini real-engine route table (api_server shape)."""
+
+
+def build_app(web, handlers):
+    app = web.Application()
+    r = app.router
+    r.add_get("/health", handlers.health)
+    r.add_get("/metrics", handlers.metrics)
+    r.add_post("/v1/completions", handlers.completions)
+    r.add_post("/abort", handlers.abort)
+    r.add_post("/tokenize", handlers.tokenize)
+    return app

@@ -1,0 +1,12 @@
+"""GC005 violation fixture: the fake engine drifted — /abort (which the
+router calls on the real engine) is missing, and /v1/completions too.
+
+Expected findings: 2 (fake missing /abort and /v1/completions)."""
+
+
+def make_app(web, handlers):
+    app = web.Application()
+    app.router.add_get("/health", handlers.health)
+    app.router.add_get("/metrics", handlers.metrics)
+    app.router.add_post("/tokenize", handlers.tokenize)
+    return app

@@ -1,0 +1,14 @@
+"""GC005 clean fixture: the fake engine serves every route the router calls
+on the real engine.
+
+Expected findings: 0."""
+
+
+def make_app(web, handlers):
+    app = web.Application()
+    app.router.add_get("/health", handlers.health)
+    app.router.add_get("/metrics", handlers.metrics)
+    app.router.add_post("/v1/completions", handlers.completions)
+    app.router.add_post("/abort", handlers.abort)
+    app.router.add_post("/tokenize", handlers.tokenize)
+    return app

@@ -1,0 +1,22 @@
+"""GC005 fixture: a mini router naming engine client paths (f-string tails,
+literals, and its own non-engine routes that must NOT count)."""
+
+
+async def scrape(session, url):
+    async with session.get(f"{url}/metrics") as resp:
+        return await resp.text()
+
+
+async def reclaim(session, url, request_id):
+    await session.post(f"{url}/abort", json={"request_id": request_id})
+
+
+async def probe(session, url, payload):
+    return await session.post(f"{url}/v1/completions", json=payload)
+
+
+def build_app(web, handlers):
+    app = web.Application()
+    app.router.add_get("/health", handlers.health)
+    app.router.add_post("/v1/files", handlers.upload)  # router-own route
+    return app

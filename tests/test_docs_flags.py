@@ -25,7 +25,8 @@ def _known_flags() -> set:
                 ("production_stack_tpu", "testing", "fake_engine.py"),
                 ("benchmarks", "multi_round_qa.py"),
                 ("scripts", "chaos_check.py"),
-                ("scripts", "trace_report.py")):
+                ("scripts", "trace_report.py"),
+                ("scripts", "graftcheck", "__main__.py")):
         src = REPO.joinpath(*rel).read_text()
         flags.update(re.findall(r'add_argument\(\s*"(--[a-z0-9-]+)"', src))
     return flags

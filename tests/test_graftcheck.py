@@ -1,0 +1,309 @@
+"""Tier-1 wiring for scripts/graftcheck: the four hazard checkers + the
+endpoint-parity guard must (a) pass over the real tree with zero
+unsuppressed, un-baselined findings, and (b) provably FIRE — every rule has
+known-violation fixtures (tests/graftcheck_fixtures/) whose expected
+findings are asserted one by one, so deleting any fixture violation (or a
+checker silently rotting into a no-op) fails here."""
+
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir, "scripts")
+)
+from graftcheck import core  # noqa: E402
+from graftcheck import (  # noqa: E402
+    gc001_eventloop,
+    gc002_donation,
+    gc003_tracer,
+    gc004_locks,
+    gc005_endpoints,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "graftcheck_fixtures"
+
+CHECKERS = {
+    "GC001": gc001_eventloop,
+    "GC002": gc002_donation,
+    "GC003": gc003_tracer,
+    "GC004": gc004_locks,
+}
+
+
+def _run_on_fixture(checker, *names):
+    """Raw findings + suppression-filtered violations for fixture files."""
+    index = core.RepoIndex(repo=FIXTURES, roots=names)
+    violations, stats = core.run_graftcheck(
+        repo=FIXTURES, baseline=[], checkers=[checker], index=index
+    )
+    return violations, stats
+
+
+def _details(findings, rule):
+    return sorted(f.detail for f in findings if f.rule == rule)
+
+
+# -- the tier-1 guard: the real tree stays clean ------------------------------
+
+def test_real_tree_has_no_unsuppressed_findings():
+    violations, stats = core.run_graftcheck()
+    assert not violations, (
+        "graftcheck failed on the tree (fix the hazard, or use a reasoned "
+        "'# graftcheck: disable=GCnnn — <reason>' / baseline.json entry — "
+        "see docs/static-analysis.md):\n"
+        + "\n".join(f.render() for f in violations)
+    )
+    # the guard must actually be LOOKING at the tree, not an empty index
+    assert stats["files"] > 60
+
+
+def test_known_suppressions_and_baseline_are_exercised():
+    """The shipped suppression (flightrecorder racy pre-check) and baseline
+    entry (tiers.py miss counter) must keep matching real findings — if a
+    refactor removes the hazard, run_graftcheck reports the stale silencer
+    and the previous test fails; this one documents the expected counts."""
+    _, stats = core.run_graftcheck()
+    assert stats["suppressed"] >= 1     # flightrecorder.dump_async pre-check
+    assert stats["baselined"] >= 1      # TieredKVStore.get miss counter
+    assert stats["raw_findings"] == stats["suppressed"] + stats["baselined"]
+
+
+# -- per-rule liveness: bad fixtures fire, clean fixtures stay quiet ----------
+
+def test_gc001_direct_blocking_fires():
+    v, _ = _run_on_fixture(gc001_eventloop, "gc001_bad_direct.py")
+    details = _details(v, "GC001")
+    assert "time.sleep" in details
+    assert any(d.startswith("requests.") for d in details)
+    assert "open" in details
+    assert "acquire" in details
+    assert len(details) == 4
+
+
+def test_gc001_transitive_blocking_fires():
+    v, _ = _run_on_fixture(gc001_eventloop, "gc001_bad_transitive.py")
+    details = _details(v, "GC001")
+    assert "open via _read_config" in details
+    assert "time.sleep via Helper.backoff" in details
+    assert len(details) == 2
+
+
+def test_gc001_clean_is_quiet():
+    v, _ = _run_on_fixture(gc001_eventloop, "gc001_clean.py")
+    assert not v, [f.render() for f in v]
+
+
+def test_gc002_use_after_donate_fires():
+    v, _ = _run_on_fixture(gc002_donation, "gc002_bad_use_after_donate.py")
+    details = _details(v, "GC002")
+    assert "use-after-donate:self.k_pages" in details   # step_local
+    assert "use-after-donate:self.v_pages" in details   # step_attr_bad + star
+    assert len(details) == 3
+
+
+def test_gc002_alias_write_fires():
+    v, _ = _run_on_fixture(gc002_donation, "gc002_bad_alias_write.py")
+    details = _details(v, "GC002")
+    assert details == ["use-after-donate:k_pages"]
+
+
+def test_gc002_clean_is_quiet():
+    v, _ = _run_on_fixture(gc002_donation, "gc002_clean.py")
+    assert not v, [f.render() for f in v]
+
+
+def test_gc003_branching_fires():
+    v, _ = _run_on_fixture(gc003_tracer, "gc003_bad_branch.py")
+    details = _details(v, "GC003")
+    assert "branch:if" in details
+    assert "branch:while" in details
+    assert "range-on-tracer" in details
+    assert len(details) == 3
+
+
+def test_gc003_host_sync_fires():
+    v, _ = _run_on_fixture(gc003_tracer, "gc003_bad_host_sync.py")
+    details = _details(v, "GC003")
+    assert "host-conversion:float" in details
+    assert "host-conversion:item" in details
+    assert "host-sync:np.asarray" in details
+    assert "logging:logger.info" in details
+    assert "logging:print" in details
+    assert "fstring-on-tracer" in details
+
+
+def test_gc003_clean_is_quiet():
+    v, _ = _run_on_fixture(gc003_tracer, "gc003_clean.py")
+    assert not v, [f.render() for f in v]
+
+
+def test_gc004_unlocked_write_fires():
+    v, _ = _run_on_fixture(gc004_locks, "gc004_bad_unlocked_write.py")
+    details = _details(v, "GC004")
+    # note + forget, plus the try-branch-annotated _state (annotations on
+    # loop/handler/recovery paths must register, not silently no-op)
+    assert details == [
+        "unlocked:_counts", "unlocked:_counts", "unlocked:_state",
+    ]
+    scopes = sorted(f.scope for f in v)
+    assert scopes == [
+        "BadRecoveryPath.flip", "BadRegistry.forget", "BadRegistry.note",
+    ]
+
+
+def test_gc004_unlocked_read_fires():
+    v, _ = _run_on_fixture(gc004_locks, "gc004_bad_unlocked_read.py")
+    assert _details(v, "GC004") == ["unlocked:_registry", "unlocked:_texts"]
+
+
+def test_gc004_clean_is_quiet_and_suppression_counts():
+    v, stats = _run_on_fixture(gc004_locks, "gc004_clean.py")
+    assert not v, [f.render() for f in v]
+    # the clean fixture carries ONE reasoned suppression that must match
+    assert stats["suppressed"] == 1
+
+
+def test_gc005_fake_drift_fires_and_clean_passes():
+    engine = core.PyFile(FIXTURES / "gc005_engine.py", FIXTURES)
+    router = core.PyFile(FIXTURES / "gc005_router.py", FIXTURES)
+    bad = core.PyFile(FIXTURES / "gc005_fake_bad.py", FIXTURES)
+    good = core.PyFile(FIXTURES / "gc005_fake_clean.py", FIXTURES)
+    findings = gc005_endpoints.check_parity(engine, bad, [router])
+    assert sorted(f.detail for f in findings) == [
+        "fake-missing:/abort", "fake-missing:/v1/completions",
+    ]
+    assert gc005_endpoints.check_parity(engine, good, [router]) == []
+
+
+def test_gc005_real_surfaces_extract():
+    """The real extraction layers must keep seeing their surfaces — an
+    api_server refactor that empties a table would otherwise turn GC005
+    into a vacuous pass (same shape as the metrics guard's extraction
+    test)."""
+    index = core.RepoIndex()
+    engine = index.get(gc005_endpoints.ENGINE_FILE)
+    fake = index.get(gc005_endpoints.FAKE_FILE)
+    routes = gc005_endpoints.extract_routes(engine)
+    fake_routes = gc005_endpoints.extract_routes(fake)
+    called = gc005_endpoints.extract_router_paths(
+        [f for f in index.files if f.path.startswith(gc005_endpoints.ROUTER_DIR)]
+    )
+    assert "/v1/chat/completions" in routes and "/abort" in routes
+    assert "/v1/embeddings" in fake_routes      # this PR's drift fix
+    assert "/metrics" in called and "/slo_records" in called
+    # the fake must currently cover every router-called engine route
+    missing = [p for p in called if p in routes and p not in fake_routes]
+    assert not missing, missing
+
+
+# -- suppression & baseline hygiene -------------------------------------------
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return p
+
+
+def test_suppression_without_reason_is_a_violation(tmp_path):
+    _write(tmp_path, "mod.py", (
+        "import time\n"
+        "async def h():\n"
+        "    time.sleep(1)  # graftcheck: disable=GC001\n"
+    ))
+    v, _ = core.run_graftcheck(
+        repo=tmp_path, roots=("mod.py",), baseline=[],
+        checkers=[gc001_eventloop],
+    )
+    assert [f.rule for f in v] == ["GC-SUPPRESS-REASON"]
+
+
+def test_reasoned_suppression_silences(tmp_path):
+    _write(tmp_path, "mod.py", (
+        "import time\n"
+        "async def h():\n"
+        "    time.sleep(1)  # graftcheck: disable=GC001 — fixture: test-only sleep\n"
+    ))
+    v, stats = core.run_graftcheck(
+        repo=tmp_path, roots=("mod.py",), baseline=[],
+        checkers=[gc001_eventloop],
+    )
+    assert not v
+    assert stats["suppressed"] == 1
+
+
+def test_unused_suppression_is_rot(tmp_path):
+    _write(tmp_path, "mod.py", (
+        "import asyncio\n"
+        "async def h():\n"
+        "    await asyncio.sleep(1)  # graftcheck: disable=GC001 — stale\n"
+    ))
+    v, _ = core.run_graftcheck(
+        repo=tmp_path, roots=("mod.py",), baseline=[],
+        checkers=[gc001_eventloop],
+    )
+    assert [f.rule for f in v] == ["GC-SUPPRESS-UNUSED"]
+
+
+def test_baseline_entry_silences_and_requires_reason(tmp_path):
+    _write(tmp_path, "mod.py", (
+        "import time\n"
+        "async def h():\n"
+        "    time.sleep(1)\n"
+    ))
+    key = "GC001:mod.py:h:time.sleep"
+    ok, _ = core.run_graftcheck(
+        repo=tmp_path, roots=("mod.py",),
+        baseline=[{"key": key, "reason": "fixture: proven benign"}],
+        checkers=[gc001_eventloop],
+    )
+    assert not ok
+    bad, _ = core.run_graftcheck(
+        repo=tmp_path, roots=("mod.py",),
+        baseline=[{"key": key, "reason": ""}],
+        checkers=[gc001_eventloop],
+    )
+    rules = sorted(f.rule for f in bad)
+    assert "GC-BASELINE" in rules      # reasonless entry reported
+    assert "GC001" in rules            # and the finding is NOT silenced
+
+
+def test_stale_baseline_entry_is_rot(tmp_path):
+    _write(tmp_path, "mod.py", "async def h():\n    return 1\n")
+    v, _ = core.run_graftcheck(
+        repo=tmp_path, roots=("mod.py",),
+        baseline=[{"key": "GC001:mod.py:h:time.sleep",
+                   "reason": "was fixed"}],
+        checkers=[gc001_eventloop],
+    )
+    assert [f.rule for f in v] == ["GC-BASELINE"]
+    assert "stale" in v[0].message
+
+
+def test_shipped_baseline_entries_all_carry_reasons():
+    entries = json.loads(
+        (REPO / "scripts" / "graftcheck" / "baseline.json").read_text()
+    )
+    for e in entries:
+        assert e.get("key"), e
+        assert (e.get("reason") or "").strip(), f"baseline entry {e} lacks a reason"
+
+
+def test_finding_keys_are_line_independent():
+    f1 = core.Finding("GC001", "a.py", 10, "X.h", "time.sleep", "m")
+    f2 = core.Finding("GC001", "a.py", 99, "X.h", "time.sleep", "m")
+    assert f1.key == f2.key
+    assert "10" not in f1.key
+
+
+def test_cli_passes_on_the_tree():
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, "-m", "scripts.graftcheck"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "GRAFTCHECK PASSED" in out.stdout
