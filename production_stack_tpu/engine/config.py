@@ -196,6 +196,27 @@ class EngineConfig:
     kv_remote_url: Optional[str] = None
     kv_serde: str = "naive"            # naive | int8 (kvoffload/serde.py)
     kv_controller_url: Optional[str] = None
+    # fleet-wide KV directory (production_stack_tpu/kvdirectory,
+    # docs/kv-directory.md): hosted by the cache server. When set, the engine
+    # PUBLISHES directory entries (prefix-cache inserts -> resident claims;
+    # confirmed proactive-spill / warm-start saves -> shared-tier claims;
+    # withdraw on evict) dirty-batched every kv_directory_flush_s, and PULLS
+    # fleet-warm prefixes: on request admission, chunks beyond the local
+    # prefix match that the directory reports restorable are prefetched from
+    # the shared tier into the local host tiers so the device-thread restore
+    # finds them locally. Entries are fenced by the warm-start generation
+    # (boot epoch without --warm-start), so a restarted engine's stale
+    # claims expire rather than poison lookups. Usually the same address as
+    # --kv-remote-url.
+    kv_directory_url: Optional[str] = None
+    # seconds between directory publish-batch flushes (the engine-stats
+    # cadence; lower = fresher router view, more directory traffic)
+    kv_directory_flush_s: float = 5.0
+    # consult the directory at admission and prefetch restorable prefix
+    # blobs into the local tiers (--no-kv-directory-pull = publish-only)
+    kv_directory_pull: bool = True
+    # cap on pages one admission may prefetch from the shared tier
+    kv_directory_pull_max_pages: int = 256
     kv_instance_id: Optional[str] = None
     advertise_host: Optional[str] = None  # URL other pods reach this engine at
     # disaggregated prefill role: none | producer | consumer
@@ -281,6 +302,23 @@ _FLAG_HELP = {
     "warm_start_max_pages": (
         "cap on pages a warm-start manifest covers (highest-reuse-score "
         "chain heads kept first)"
+    ),
+    "kv_directory_url": (
+        "fleet-wide KV directory address (the cache server; usually the "
+        "same as --kv-remote-url): publish this engine's prefix-cache "
+        "claims and pull fleet-warm prefixes from the shared tier "
+        "(docs/kv-directory.md)"
+    ),
+    "kv_directory_flush_s": (
+        "seconds between dirty-batched directory publish flushes"
+    ),
+    "kv_directory_pull": (
+        "prefetch directory-reported restorable prefix blobs into the "
+        "local tiers at request admission (--no-kv-directory-pull = "
+        "publish-only)"
+    ),
+    "kv_directory_pull_max_pages": (
+        "cap on pages one admission may prefetch from the shared tier"
     ),
     "flight_recorder": (
         "record scheduler/KV/shed/compile engine events into a bounded ring "

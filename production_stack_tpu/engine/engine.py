@@ -235,6 +235,65 @@ class LLMEngine:
                     model=cfg.name,
                 )
                 self.warm.restore()
+        # fleet-wide KV directory (ISSUE 9, docs/kv-directory.md): publisher
+        # advertises this engine's prefix-cache claims (dirty-batched,
+        # off-thread); puller prefetches fleet-warm prefixes at admission.
+        # Created AFTER warm restore so the generation fence tracks the
+        # warm-start generation (boot epoch without --warm-start: wall-clock
+        # seconds are monotonic across restarts, which is all fencing needs).
+        self._kvdir_pub = None
+        self._kvdir_pull = None
+        if cfg.kv_directory_url:
+            from production_stack_tpu.kvdirectory import (
+                DirectoryPublisher,
+                DirectoryPuller,
+            )
+
+            self._kvdir_pub = DirectoryPublisher(
+                cfg.kv_directory_url,
+                engine_url=self._advertised_url(cfg),
+                page_size=cfg.page_size,
+                generation=(
+                    self.warm.generation if self.warm is not None
+                    else int(time.time())
+                ),
+                flush_interval_s=cfg.kv_directory_flush_s,
+                # shared-tier claims need the write-through remote tier;
+                # without one this engine's blobs are private (publish-only
+                # resident claims still feed router-v2 resident ranking)
+                shared_enabled=(
+                    self._offload is not None
+                    and self._offload.store.remote is not None
+                ),
+            )
+            self.kv.directory = self._kvdir_pub
+            if self.kv.hash_to_page:
+                # warm restore ran before the publisher existed: re-advertise
+                # the restored working set under the NEW generation (this is
+                # also what makes a reborn engine republish after a restart)
+                self._kvdir_pub.publish_resident([
+                    (h, self.kv.pages[pid].depth, self.kv.pages[pid].hits)
+                    for h, pid in self.kv.hash_to_page.items()
+                ])
+            if (
+                cfg.kv_directory_pull
+                and self._offload is not None
+                and self._offload.store.remote is not None
+            ):
+                # same gate as shared_enabled: the shared tier IS the remote
+                # cache server — without one every prefetch would miss while
+                # still paying a directory round trip per admission
+                self._kvdir_pull = DirectoryPuller(
+                    cfg.kv_directory_url, self.kv, self._offload.store,
+                    cfg.page_size,
+                    max_pages=cfg.kv_directory_pull_max_pages,
+                )
+            elif cfg.kv_directory_pull:
+                logger.warning(
+                    "--kv-directory-pull needs --kv-remote-url (the shared "
+                    "tier blobs are pulled from the cache server); "
+                    "publish-only mode"
+                )
         # disaggregated prefill (SURVEY.md §2.3): producer pushes finished
         # prefill KV to the decode peer; consumer receives into its store
         self._kv_sender = None
@@ -510,24 +569,11 @@ class LLMEngine:
             or cfg.kv_offload_dir
             or cfg.kv_remote_url
             or cfg.kv_controller_url
+            or cfg.kv_directory_url
         ):
             return None
         from production_stack_tpu.kvoffload.connector import KVOffloadConnector
 
-        host = cfg.advertise_host or cfg.host
-        if cfg.kv_controller_url and host in ("0.0.0.0", "::", ""):
-            # the controller hands this URL to the router for kvaware routing;
-            # a wildcard bind address would never match a discovered endpoint
-            import socket
-
-            try:
-                host = socket.gethostbyname(socket.gethostname())
-            except OSError:
-                host = "127.0.0.1"
-            logger.warning(
-                "--advertise-host not set; registering with KV controller as "
-                "%s (set it to the pod IP for kvaware routing)", host,
-            )
         return KVOffloadConnector(
             self.runner,
             cpu_bytes=int(cfg.kv_offload_cpu_gb * 1e9),
@@ -537,8 +583,28 @@ class LLMEngine:
             serde=cfg.kv_serde,
             controller_url=cfg.kv_controller_url,
             instance_id=cfg.kv_instance_id or f"{cfg.name}-{cfg.port}",
-            engine_url=f"http://{host}:{cfg.port}",
+            engine_url=self._advertised_url(cfg),
         )
+
+    def _advertised_url(self, cfg: EngineConfig) -> str:
+        """URL other pods (router, KV controller/directory consumers) reach
+        this engine at. A wildcard bind address would never match a
+        discovered endpoint, so it resolves to the pod hostname's address."""
+        host = cfg.advertise_host or cfg.host
+        if host in ("0.0.0.0", "::", ""):
+            import socket
+
+            try:
+                host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                host = "127.0.0.1"
+            if cfg.kv_controller_url or cfg.kv_directory_url:
+                logger.warning(
+                    "--advertise-host not set; registering with the KV "
+                    "index as %s (set it to the pod IP for kvaware routing)",
+                    host,
+                )
+        return f"http://{host}:{cfg.port}"
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -551,6 +617,8 @@ class LLMEngine:
         self._inbox.put(None)
         if self._thread:
             self._thread.join(timeout=10)
+        if self._kvdir_pub is not None:
+            self._kvdir_pub.stop()
         if self._offload is not None:
             self._offload.stop()
         if self._kv_sender is not None:
@@ -675,6 +743,17 @@ class LLMEngine:
             )
         if self._sleeping:
             raise RuntimeError("engine is sleeping")
+        if self._kvdir_pull is not None and not lora_name:
+            # fleet-warm pull (docs/kv-directory.md): prefetch directory-
+            # reported restorable prefix blobs into the LOCAL host tiers
+            # before the sequence reaches the scheduler, so the device-thread
+            # restore reads locally instead of probing the remote per chunk.
+            # Best-effort with its own timeout/backoff; LoRA prompts are
+            # skipped (adapter-salted chains are never shared fleet-wide).
+            try:
+                await self._kvdir_pull.maybe_prefetch(prompt_token_ids)
+            except Exception:  # noqa: BLE001 - pull is a hint, never a gate
+                logger.exception("kv directory prefetch failed")
         lora_slot, cache_salt = 0, b""
         if lora_name:
             # atomic resolve+pin, LAST before enqueue: every later path runs
@@ -1659,6 +1738,15 @@ class LLMEngine:
             for s in list(self.scheduler.running) + list(self.scheduler.waiting):
                 self.scheduler._finish(s, "abort")
                 self._emit(s, "")
+            if self._kvdir_pub is not None and self.kv.hash_to_page:
+                # dropping the pools invalidates every resident claim this
+                # engine advertised; withdraw them or KV-aware v2 routers
+                # keep resident-routing prompts at a cold sleeper (the idle
+                # heartbeat would keep the stale claims alive forever).
+                # Shared-tier claims stay — the blobs outlive the pools.
+                self._kvdir_pub.withdraw(
+                    list(self.kv.hash_to_page.keys()), "resident"
+                )
             # replicated in multi-host: followers drop their pool shards too
             self.runner.drop_kv_pools()
             if level >= 2:
@@ -1688,6 +1776,9 @@ class LLMEngine:
                 max_io_pages=self._max_io_pages,
                 spill_watermark=self.cfg.kv_spill_watermark,
             )
+            self.kv.directory = self._kvdir_pub  # keep fleet publishes alive
+            if self._kvdir_pull is not None:
+                self._kvdir_pull.kv = self.kv
             self.scheduler.kv = self.kv
             self._sleeping = False
 
@@ -1794,6 +1885,27 @@ class LLMEngine:
                 out["kv_offload_link_bandwidth_bytes_per_sec"] = round(
                     self.kv_link_bandwidth_bytes_per_s
                 )
+        if self._kvdir_pub is not None:
+            # fleet-directory surface (docs/kv-directory.md): publish-side
+            p = self._kvdir_pub.stats()
+            out["kv_directory_publishes_total"] = p["kv_directory_publishes_total"]
+            out["kv_directory_withdrawals_total"] = (
+                p["kv_directory_withdrawals_total"]
+            )
+            out["kv_directory_flush_errors_total"] = (
+                p["kv_directory_flush_errors_total"]
+            )
+        if self._kvdir_pull is not None:
+            # ...and pull-side: lookups/hits drive the cross-engine pull
+            # hit-rate panel; pulled pages are blobs fetched into local tiers
+            q = self._kvdir_pull.stats()
+            out["kv_directory_lookups_total"] = q["kv_directory_lookups_total"]
+            out["kv_directory_lookup_hits_total"] = (
+                q["kv_directory_lookup_hits_total"]
+            )
+            out["kv_directory_pulled_pages_total"] = (
+                q["kv_directory_pulled_pages_total"]
+            )
         if self.warm is not None:
             out.update(self.warm.stats())
         return out

@@ -119,6 +119,11 @@ class KVPageManager:
         # KVOffloadConnector (kvoffload/connector.py): spill evicted pages to
         # host DRAM/disk/remote and restore them on later prefix matches
         self.offload = offload
+        # fleet-wide KV directory publisher (kvdirectory.DirectoryPublisher,
+        # wired by LLMEngine when --kv-directory-url is set): prefix-cache
+        # inserts publish resident claims, confirmed spills publish shared
+        # claims, evictions withdraw — all dirty-batched off-thread
+        self.directory = None
 
     # -- eviction policy ----------------------------------------------------
 
@@ -204,6 +209,11 @@ class KVPageManager:
         fr = get_flightrecorder()
         n_evicted = n_hot = 0
         evict_scores: list = []
+        # directory withdrawal accounting: evicted-with-restorable-blob
+        # hashes lose only their RESIDENT claim (the shared-tier claim stays
+        # truthful); evicted-without-blob hashes withdraw entirely
+        w_resident: list = []
+        w_all: list = []
         for _ in range(n):
             if self.free_list:
                 pid = self.free_list.pop()
@@ -221,8 +231,12 @@ class KVPageManager:
                     # already-offloaded pages (proactive spill / earlier
                     # restore) skip the spill batch — their blob is in the
                     # tier, so the slot frees with zero device I/O
-                    if self.offload is not None and not info.offloaded:
+                    if info.offloaded:
+                        w_resident.append(info.hash)
+                    elif self.offload is not None:
                         spill.append((pid, info.hash, info.depth))
+                    else:
+                        w_all.append(info.hash)
                     self.hash_to_page.pop(info.hash, None)
                     info.hash = None
                 info.hits = 0
@@ -239,18 +253,32 @@ class KVPageManager:
             # dropped + reported evicted so the global KV index stays
             # truthful.
             spill.sort(key=lambda t: t[2])
+            depths = {h: d for _, h, d in spill}
             spill = [(pid, h) for pid, h, _ in spill]
             cap = self.max_io_pages
             if cap and len(spill) > cap:
                 dropped = spill[cap:]
                 spill = spill[:cap]
                 self.offload.report_evict([h for _, h in dropped])
+                w_all.extend(h for _, h in dropped)
             import time as time_mod
 
             from production_stack_tpu import tracing
 
             t_wall, t0 = time_mod.time(), time_mod.perf_counter()
-            self.offload.save_pages(spill)
+            saved = self.offload.save_pages(spill)
+            # directory truthfulness mirrors the offloaded-flag contract:
+            # only CONFIRMED saves advertise a restorable shared claim; a
+            # mid-batch tier failure withdraws the rest outright
+            shared_pub: list = []
+            for _, h in spill:
+                if saved is None or h in saved:
+                    w_resident.append(h)
+                    shared_pub.append((h, depths.get(h, 0), 0.0))
+                else:
+                    w_all.append(h)
+            if self.directory is not None and shared_pub:
+                self.directory.publish_shared(shared_pub)
             # spill span under whichever request's admission forced the
             # eviction (scheduler publishes it); decode-growth evictions
             # carry no ambient context and record nothing
@@ -270,6 +298,11 @@ class KVPageManager:
                 usage=round(self.usage(), 4),
                 trace_id=ctx.trace_id if ctx is not None else None,
             )
+        if self.directory is not None:
+            if w_resident:
+                self.directory.withdraw(w_resident, "resident")
+            if w_all:
+                self.directory.withdraw(w_all, "all")
         return out
 
     def free(self, page_ids: Sequence[int]) -> None:
@@ -324,10 +357,17 @@ class KVPageManager:
         # it into the tier)
         saved = self.offload.save_pages(batch)
         n = 0
+        shared_pub = []
         for pid, h in batch:
             if saved is None or h in saved:  # None: legacy offload stubs
                 self.pages[pid].offloaded = True
                 n += 1
+                info = self.pages[pid]
+                shared_pub.append((h, info.depth, info.hits))
+        if self.directory is not None and shared_pub:
+            # proactively-spilled pages stay HBM-resident AND restorable:
+            # advertise the shared claim (the resident one already exists)
+            self.directory.publish_shared(shared_pub)
         if n < len(batch):
             # unconfirmed saves stay on the dirty list: the flag was computed
             # from the PLANNED batch, and leaving it False would park those
@@ -458,6 +498,7 @@ class KVPageManager:
         # shares past the truncation un-ref, unused restore slots free
         ri = 0
         broke = False
+        resident_pub = []
         for h, pid in plan:
             if broke:
                 if pid is not None:
@@ -476,10 +517,15 @@ class KVPageManager:
                 self.hash_to_page[h] = rp
                 shared.append(rp)
                 self.offload_hits += 1
+                resident_pub.append((h, info.depth, 1.0))
             else:
                 broke = True
         if ri < n_restore:
             self.free(restore_pids[ri:])  # unhashed -> back to the free list
+        if self.directory is not None and resident_pub:
+            # tier-restored chunks are back in THIS engine's HBM — the
+            # fleet directory should route matching prefixes here now
+            self.directory.publish_resident(resident_pub)
         return shared
 
     # -- warm start (kvoffload/warmstart.py) --------------------------------
@@ -556,6 +602,10 @@ class KVPageManager:
             restored += 1
         # hashed pages land in the evictable pool; failed ones free outright
         self.free(pids)
+        if self.directory is not None and restored:
+            self.directory.publish_resident([
+                (h, d, s) for (h, d, s), good in zip(todo, ok) if good
+            ])
         if restored:
             get_flightrecorder().record(
                 "kv", op="warm_restore", pages=restored, planned=len(todo)
@@ -570,6 +620,7 @@ class KVPageManager:
         hashes = prefix_hashes(tokens, self.page_size, salt)
         now = time.monotonic()
         new: list[bytes] = []
+        new_pub: list = []
         for depth, (h, pid) in enumerate(zip(hashes, page_ids)):
             info = self.pages[pid]
             if info.hash is None and h not in self.hash_to_page:
@@ -580,8 +631,12 @@ class KVPageManager:
                 info.offloaded = False
                 self.hash_to_page[h] = pid
                 new.append(h)
+                new_pub.append((h, depth, 0.0))
         if self.offload is not None and new:
             self.offload.report_admit(new)  # global KV index (kvaware routing)
+        if self.directory is not None and new_pub:
+            # prefix-cache insert -> fleet-directory resident claim
+            self.directory.publish_resident(new_pub)
 
     def hit_rate(self) -> float:
         return self.prefix_hits / self.prefix_queries if self.prefix_queries else 0.0

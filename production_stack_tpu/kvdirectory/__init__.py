@@ -1,0 +1,29 @@
+"""Fleet-wide KV directory: a content-addressed index of which engine (and
+which tier) holds which prefix chunks, hosted by the cache server.
+
+This is the LMCache "enterprise" pattern (PAPERS.md): N engines' DRAM plus the
+shared offload tiers become ONE cache. Engines publish directory entries as
+their prefix caches change; the router consults the directory to rank backends
+*resident > restorable > cold*; a cold engine pulls a fleet-warm prefix
+through the existing cache-server blob path before prefill.
+
+The directory is a HINT, never a source of truth: every pulled blob is
+CRC-verified by the tier store (kvoffload/serde.py v2 format) and a miss or
+corruption falls back to recompute exactly like the warm-restart path. Entries
+are fenced by the warm-start generation scheme, so a restarted engine's stale
+claims expire instead of poisoning lookups. See docs/kv-directory.md.
+"""
+
+from production_stack_tpu.kvdirectory.directory import KVDirectory
+from production_stack_tpu.kvdirectory.client import (
+    DirectoryClient,
+    DirectoryPublisher,
+    DirectoryPuller,
+)
+
+__all__ = [
+    "KVDirectory",
+    "DirectoryClient",
+    "DirectoryPublisher",
+    "DirectoryPuller",
+]

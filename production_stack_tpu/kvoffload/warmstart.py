@@ -373,6 +373,26 @@ class WarmStartManager:
             return 0
         self.last_manifest_ts = now
         self.spilled_pages_total += len(saved)
+        d = getattr(self.kv, "directory", None)
+        if d is not None:
+            if d.generation != self.generation:
+                # mid-life generation bump (dead-fencer takeover,
+                # _try_takeover): the first publish under the NEW generation
+                # fences EVERYTHING this engine already advertised — but the
+                # process is alive and its prefix cache intact, and the
+                # publisher is delta-only, so re-advertise the full live
+                # working set or resident ranking to this engine silently
+                # drops to zero until every page is individually re-touched
+                d.generation = self.generation
+                d.publish_resident([
+                    (h, self.kv.pages[pid].depth, self.kv.pages[pid].hits)
+                    for h, pid in self.kv.hash_to_page.items()
+                ])
+            # every manifest entry's blob is confirmed in the tier (and, with
+            # a remote tier, write-through shared): advertise them to the
+            # fleet directory under THIS generation so another engine can
+            # pull this working set
+            d.publish_shared([(h, dep, hits) for _, h, dep, hits in entries])
         logger.info(
             "warm-start: generation %d manifest written (%s): %d pages "
             "(%d blobs newly saved)", self.generation, reason, len(entries),

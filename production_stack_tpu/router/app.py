@@ -127,6 +127,7 @@ class RouterApp:
             args.routing_logic,
             session_key=args.session_key,
             kv_controller_url=args.kv_controller_url,
+            kv_directory_url=getattr(args, "kv_directory_url", None),
             tokenizer_path=args.tokenizer,
             prefill_model_labels=parse_comma_separated(args.prefill_model_labels),
             decode_model_labels=parse_comma_separated(args.decode_model_labels),
@@ -402,6 +403,13 @@ class RouterApp:
         # per-backend vllm_router:circuit_state (0=closed 1=half-open 2=open)
         # and vllm_router:circuit_open_events_total
         lines.extend(render_resilience_metrics())
+        # KV-aware v2 route-class mix (docs/kv-directory.md):
+        # vllm_router:kvaware_v2_{resident,restorable,cold}_routes_total
+        from production_stack_tpu.router.routing_logic import (
+            render_kvaware_metrics,
+        )
+
+        lines.extend(render_kvaware_metrics())
         # SLO accounting (router/slo.py): vllm_router:slo_attained_total /
         # vllm_router:slo_violated_total per (objective, model, server),
         # vllm_router:slo_request_outcomes_total, vllm_router:slo_records_total,

@@ -30,6 +30,7 @@ class DynamicRouterConfig:
     routing_logic: Optional[str] = None
     session_key: Optional[str] = None
     kv_controller_url: Optional[str] = None
+    kv_directory_url: Optional[str] = None
     prefill_model_labels: Optional[str] = None
     decode_model_labels: Optional[str] = None
 
@@ -98,6 +99,7 @@ class DynamicConfigWatcher:
                 cfg.routing_logic,
                 session_key=cfg.session_key,
                 kv_controller_url=cfg.kv_controller_url,
+                kv_directory_url=cfg.kv_directory_url,
                 prefill_model_labels=parse_comma_separated(cfg.prefill_model_labels),
                 decode_model_labels=parse_comma_separated(cfg.decode_model_labels),
             )

@@ -32,6 +32,12 @@ class EngineStats:
     # engine-side admission control state (api_server overload surface):
     # routing deprioritizes saturated backends between Retry-After windows
     engine_saturated: int = 0
+    # offload restore economics, scraped for KV-aware routing v2: the
+    # engine's linkprobe-derived per-operation restore cap (engine-measured
+    # restore-vs-recompute crossover; -1 = not exported, <=0 = unbounded)
+    # and the measured host<->device link bandwidth (0 = not exported)
+    kv_offload_max_io_pages: float = -1.0
+    kv_offload_link_bandwidth_bytes_per_sec: float = 0.0
 
     _FIELDS = {
         "vllm:num_requests_running": "num_running_requests",
@@ -41,6 +47,10 @@ class EngineStats:
         "vllm:gpu_prefix_cache_queries_total": "gpu_prefix_cache_queries_total",
         "vllm:gpu_cache_usage_perc": "gpu_cache_usage_perc",
         "vllm:engine_saturated": "engine_saturated",
+        "vllm:kv_offload_max_io_pages": "kv_offload_max_io_pages",
+        "vllm:kv_offload_link_bandwidth_bytes_per_sec": (
+            "kv_offload_link_bandwidth_bytes_per_sec"
+        ),
     }
 
     @staticmethod

@@ -61,6 +61,10 @@ def parse_args(argv=None):
                             "disaggregated_prefill"])
     p.add_argument("--session-key", type=str, default=None)
     p.add_argument("--kv-controller-url", type=str, default=None)
+    p.add_argument("--kv-directory-url", type=str, default=None,
+                   help="fleet-wide KV directory address (the cache server, "
+                        "docs/kv-directory.md): kvaware routing v2 ranks "
+                        "backends resident > restorable > cold against it")
     p.add_argument("--tokenizer", type=str, default=None)
     p.add_argument("--prefill-model-labels", type=str, default=None)
     p.add_argument("--decode-model-labels", type=str, default=None)
@@ -182,8 +186,13 @@ def validate_args(args) -> None:
         raise ValueError("--saturation-queue-ref must be >= 1")
     if args.routing_logic == "session" and not args.session_key:
         raise ValueError("session routing requires --session-key")
-    if args.routing_logic == "kvaware" and not args.kv_controller_url:
-        raise ValueError("kvaware routing requires --kv-controller-url")
+    if args.routing_logic == "kvaware" and not (
+        args.kv_controller_url or args.kv_directory_url
+    ):
+        raise ValueError(
+            "kvaware routing requires --kv-controller-url or "
+            "--kv-directory-url"
+        )
     if args.routing_logic == "disaggregated_prefill" and not (
         args.prefill_model_labels and args.decode_model_labels
     ):

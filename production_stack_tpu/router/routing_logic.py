@@ -179,6 +179,49 @@ class PrefixAwareRouter(RoutingInterface):
 
     def __init__(self):
         self.trie = HashTrie()
+        # endpoints ever inserted into the trie, for the discovery-dropout
+        # sweep (ISSUE 9 bugfix): the trie retained entries for backends
+        # removed from service discovery, so a departed backend kept winning
+        # locality scores forever — mirrors engine_stats' _dropped_stale
+        # bookkeeping for config-removed urls
+        self._trie_urls: set[str] = set()
+
+    @classmethod
+    def make_fallback(cls) -> "PrefixAwareRouter":
+        """A NON-singleton instance for use as another router's fallback:
+        ``cls()`` goes through SingletonMeta and would hand back (and
+        register) THE shared prefixaware router — the fallback must be
+        private state. Keep this the single place the fields are initialized
+        so the __new__ bypass cannot drift from __init__."""
+        r = cls.__new__(cls)
+        r.trie = HashTrie()
+        r._trie_urls = set()
+        return r
+
+    async def sweep_departed(self, current_urls: set) -> None:
+        """Drop trie claims of endpoints no longer in service discovery. A
+        swept backend that returns re-learns its locality from scratch —
+        correct for both a config removal and a restart (its cache is cold
+        either way)."""
+        gone = self._trie_urls - current_urls
+        for url in gone:
+            await self.trie.remove_endpoint(url)
+            logger.info(
+                "prefix trie: swept departed backend %s (%d still tracked)",
+                url, len(self._trie_urls) - 1,
+            )
+        self._trie_urls -= gone
+
+    async def _sweep_with_discovery(self) -> None:
+        from production_stack_tpu.router.service_discovery import (
+            get_service_discovery,
+        )
+
+        try:
+            sd = get_service_discovery()
+        except Exception:  # noqa: BLE001 - unit tests route without discovery
+            return
+        await self.sweep_departed({ep.url for ep in sd.get_endpoint_info()})
 
     @staticmethod
     def _prompt_of(request_json: Optional[dict]) -> Optional[str]:
@@ -195,6 +238,7 @@ class PrefixAwareRouter(RoutingInterface):
 
     async def route_request(self, endpoints, engine_stats, request_stats, request,
                             request_json=None) -> str:
+        await self._sweep_with_discovery()
         available = {ep.url for ep in endpoints}
         prompt = self._prompt_of(request_json)
         if prompt is None:
@@ -203,24 +247,57 @@ class PrefixAwareRouter(RoutingInterface):
         candidate_eps = [ep for ep in endpoints if ep.url in candidates]
         url = _qps_routing(candidate_eps or endpoints, request_stats)
         await self.trie.insert(prompt, url)
+        self._trie_urls.add(url)
         return url
 
 
 class KvawareRouter(RoutingInterface):
-    """Query the global KV-index controller for the instance holding the
-    longest cached token prefix (parity :212-329; LMCache controller protocol
-    replaced by kvoffload/controller.py)."""
+    """KV-aware routing.
 
-    def __init__(self, controller_url: Optional[str] = None, tokenizer_path: Optional[str] = None):
-        if not controller_url:
-            raise ValueError("kvaware routing requires --kv-controller-url")
+    v1 (parity :212-329): query the KV-index controller for the instance
+    holding the longest cached token prefix (LMCache controller protocol
+    replaced by kvoffload/controller.py).
+
+    v2 (ISSUE 9, docs/kv-directory.md): consult the fleet-wide KV directory
+    hosted by the cache server and rank backends
+    **resident > restorable > cold** —
+
+    - *resident*: a backend already holds the longest prefix in its HBM
+      prefix cache (the directory's generation-fenced resident claims);
+    - *restorable*: the prefix's blobs sit in the shared cache-server tier,
+      so ANY backend can pull them before prefill. Weighted by what the
+      target would actually restore: each engine exports its
+      linkprobe-derived per-operation restore cap
+      (vllm:kv_offload_max_io_pages — the engine-measured
+      restore-vs-recompute crossover, engine/linkprobe.py), scraped into
+      EngineStats; restorable tokens beyond cap x page_size would recompute
+      anyway and score zero. Ties break to the lowest-QPS backend;
+    - *cold*: nothing known — fall through to the prefix-trie fallback.
+
+    Both modes learn the outcome into the fallback trie, so a directory or
+    controller outage degrades to prefixaware, not roundrobin."""
+
+    def __init__(
+        self,
+        controller_url: Optional[str] = None,
+        tokenizer_path: Optional[str] = None,
+        directory_url: Optional[str] = None,
+    ):
+        if not controller_url and not directory_url:
+            raise ValueError(
+                "kvaware routing requires --kv-controller-url or "
+                "--kv-directory-url"
+            )
         self.controller_url = controller_url
+        self.directory_url = directory_url
         from production_stack_tpu.engine.tokenizer import load_tokenizer
 
         self.tokenizer = load_tokenizer(tokenizer_path)
         self._client = None
-        self.fallback = PrefixAwareRouter.__new__(PrefixAwareRouter)
-        self.fallback.trie = HashTrie()
+        self._dir_client = None
+        # vllm_router:kvaware_v2_{resident,restorable,cold}_routes_total
+        self.route_class_counts = {"resident": 0, "restorable": 0, "cold": 0}
+        self.fallback = PrefixAwareRouter.make_fallback()
 
     async def _lookup(self, tokens: list[int]) -> Optional[str]:
         from production_stack_tpu.kvoffload.controller import ControllerClient
@@ -234,14 +311,97 @@ class KvawareRouter(RoutingInterface):
             self._client = None
             return None
 
+    async def _dir_lookup(self, tokens: list[int]) -> Optional[dict]:
+        from production_stack_tpu.kvdirectory import DirectoryClient
+
+        try:
+            if self._dir_client is None:
+                self._dir_client = DirectoryClient(self.directory_url)
+            return await self._dir_client.lookup(tokens)
+        except Exception as e:
+            logger.warning("kv directory lookup failed: %s", e)
+            self._dir_client = None
+            return None
+
+    @staticmethod
+    def _restorable_tokens(restorable: dict, es, page_size: Optional[int]) -> int:
+        """Tokens a backend would actually restore from the shared tier: the
+        per-page-size restorable depth, clamped by the backend's exported
+        restore cap. ``page_size`` is the backend's registered page size
+        from the directory — chunk identity is page-size-dependent, so a
+        backend is only credited the chain hashed at ITS page size (unknown
+        backends fall back to the best chain, optimistically). Cap semantics
+        follow the engine's export (engine/linkprobe.py): 0 = fast link,
+        restore unbounded; N > 0 = slow link, N pages is the
+        restore-vs-recompute crossover; the metric ABSENT from a scraped
+        backend (-1 here) means it has NO offload tiers at all — it cannot
+        pull anything, score 0. A backend with no stats yet (never scraped)
+        is scored optimistically unbounded: the directory is a hint and a
+        wrong pick only costs a recompute."""
+        if es is None:
+            cap = 0.0  # unscraped: optimistic
+        else:
+            cap = getattr(es, "kv_offload_max_io_pages", 0.0)
+            if cap is None or cap < 0:
+                return 0  # scraped, metric absent: no offload tiers
+        if page_size is not None:
+            restorable = {
+                k: v for k, v in restorable.items() if int(k) == page_size
+            }
+        best = 0
+        for ps_str, toks in restorable.items():
+            ps = int(ps_str)
+            eff = int(toks) if cap <= 0 else min(int(toks), int(cap) * ps)
+            best = max(best, eff)
+        return best
+
+    def _rank_v2(self, res: dict, endpoints, engine_stats, request_stats):
+        """resident > restorable > cold; returns (class, url|None)."""
+        urls = {ep.url for ep in endpoints}
+        best_url, best_tokens = None, 0
+        for url, info in (res.get("engines") or {}).items():
+            if url in urls and int(info.get("resident_tokens", 0)) > best_tokens:
+                best_url, best_tokens = url, int(info["resident_tokens"])
+        if best_url is not None:
+            return "resident", best_url
+        restorable = res.get("restorable") or {}
+        if restorable:
+            page_sizes = res.get("page_sizes") or {}
+            scored = [
+                (ep, self._restorable_tokens(
+                    restorable, (engine_stats or {}).get(ep.url),
+                    page_sizes.get(ep.url),
+                ))
+                for ep in endpoints
+            ]
+            top = max((s for _, s in scored), default=0)
+            if top > 0:
+                tied = [ep for ep, s in scored if s == top]
+                return "restorable", _qps_routing(tied, request_stats)
+        return "cold", None
+
     async def route_request(self, endpoints, engine_stats, request_stats, request,
                             request_json=None) -> str:
         prompt = PrefixAwareRouter._prompt_of(request_json)
         if prompt is not None:
             tokens = self.tokenizer.encode(prompt)
-            url = await self._lookup(tokens)
-            if url and any(ep.url == url for ep in endpoints):
-                return url
+            if self.directory_url:
+                res = await self._dir_lookup(tokens)
+                if res is not None:
+                    cls, url = self._rank_v2(
+                        res, endpoints, engine_stats, request_stats
+                    )
+                    self.route_class_counts[cls] += 1
+                    if url is not None:
+                        # teach the fallback trie the outcome so a later
+                        # directory outage keeps this locality
+                        await self.fallback.trie.insert(prompt, url)
+                        self.fallback._trie_urls.add(url)
+                        return url
+            if self.controller_url:
+                url = await self._lookup(tokens)
+                if url and any(ep.url == url for ep in endpoints):
+                    return url
         return await self.fallback.route_request(
             endpoints, engine_stats, request_stats, request, request_json
         )
@@ -285,11 +445,32 @@ class DisaggregatedPrefillRouter(RoutingInterface):
 _router: Optional[RoutingInterface] = None
 
 
+def render_kvaware_metrics() -> list[str]:
+    """Prometheus lines for the KV-aware-v2 route-class counters (rendered
+    by router/app.py /metrics; zero-valued when kvaware v2 is not active so
+    dashboard queries always resolve)."""
+    counts = (
+        _router.route_class_counts
+        if isinstance(_router, KvawareRouter)
+        else {}
+    )
+    lines = []
+    for name, key in (
+        ("vllm_router:kvaware_v2_resident_routes_total", "resident"),
+        ("vllm_router:kvaware_v2_restorable_routes_total", "restorable"),
+        ("vllm_router:kvaware_v2_cold_routes_total", "cold"),
+    ):
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {counts.get(key, 0)}")
+    return lines
+
+
 def initialize_routing_logic(
     routing_logic: str,
     *,
     session_key: Optional[str] = None,
     kv_controller_url: Optional[str] = None,
+    kv_directory_url: Optional[str] = None,
     tokenizer_path: Optional[str] = None,
     prefill_model_labels: Optional[list[str]] = None,
     decode_model_labels: Optional[list[str]] = None,
@@ -307,7 +488,9 @@ def initialize_routing_logic(
     elif routing_logic == "prefixaware":
         _router = PrefixAwareRouter()
     elif routing_logic == "kvaware":
-        _router = KvawareRouter(kv_controller_url, tokenizer_path)
+        _router = KvawareRouter(
+            kv_controller_url, tokenizer_path, directory_url=kv_directory_url
+        )
     elif routing_logic == "disaggregated_prefill":
         _router = DisaggregatedPrefillRouter(
             prefill_model_labels or [], decode_model_labels or []

@@ -38,6 +38,17 @@ scripts/chaos_check.py):
                          router's objective to drive its violation counters
 - ``--compile-stall-ms X``  the first generation stalls X ms and records a
                          flight-recorder ``compile`` event (cold-XLA model)
+- ``--kv-directory-url``  fleet-wide KV directory emulation (ISSUE 9): the
+                         fake registers with the cache server's directory
+                         and, on every COMPLETED generation, publishes the
+                         prompt's chunk hashes as resident claims. Hashes
+                         are the real chain (engine/kv_manager.prefix_hashes
+                         over ByteTokenizer tokens, page 16) — deterministic
+                         per prompt and identical to what the router's
+                         kvaware-v2 lookup computes, so router e2e/chaos
+                         tests exercise resident ranking with zero TPUs.
+                         Generation = boot-time ms (monotonic across
+                         restarts), so a reborn fake fences its old claims.
 - ``--flight-dump-dir D``  arm flight-recorder anomaly dumps (SIGTERM
                          drain, shed bursts) into D; the synthetic
                          sched/kv/shed event feed matches the real engine's
@@ -137,6 +148,92 @@ def _push_slo_record(model: str, req_id: str, outcome: str, *,
     })
 
 
+class _FakeDirectoryPublisher:
+    """Minimal asyncio publisher for --kv-directory-url: one persistent frame
+    connection, register-then-publish, reconnect-on-error. Publishes the
+    REAL chunk-hash chain (ByteTokenizer tokens, page 16) so the directory's
+    token lookups — fed by the router's own ByteTokenizer — match exactly."""
+
+    PAGE = 16
+
+    def __init__(self, directory_url: str, engine_url: str):
+        from production_stack_tpu.kvoffload.protocol import parse_hostport
+
+        self.host, self.port = parse_hostport(directory_url, default_port=8200)
+        self.engine_url = engine_url
+        # boot epoch in ms: strictly higher on every rebirth, so the
+        # directory fences the previous incarnation's claims (ISSUE 9)
+        self.generation = int(time.time() * 1000)
+        self._reader = self._writer = None
+        self._lock = asyncio.Lock()
+        self.published = 0
+
+    async def _request(self, header: dict) -> dict:
+        from production_stack_tpu.kvoffload.protocol import (
+            read_frame,
+            write_frame,
+        )
+
+        async with self._lock:
+            try:
+                if self._writer is None:
+                    self._reader, self._writer = await asyncio.wait_for(
+                        asyncio.open_connection(self.host, self.port), 5.0
+                    )
+                    await write_frame(self._writer, {
+                        "op": "dir_register", "url": self.engine_url,
+                        "page_size": self.PAGE,
+                        "generation": self.generation,
+                    })
+                    await asyncio.wait_for(read_frame(self._reader), 5.0)
+                await write_frame(self._writer, header)
+                hdr, _ = await asyncio.wait_for(read_frame(self._reader), 5.0)
+                return hdr
+            except Exception:
+                if self._writer is not None:
+                    try:
+                        self._writer.close()
+                    except Exception:
+                        pass
+                self._reader = self._writer = None
+                raise
+
+    async def register(self) -> None:
+        try:
+            await self._request({"op": "ping"})  # opens + registers
+        except Exception as e:  # noqa: BLE001 - directory may not be up yet
+            print(f"fake-engine: directory register failed: {e}", flush=True)
+
+    async def publish_prompt(self, prompt: str) -> None:
+        """Deterministic resident-claim publish on stream completion."""
+        from production_stack_tpu.engine.kv_manager import prefix_hashes
+        from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+        tokens = ByteTokenizer().encode(prompt)
+        hashes = prefix_hashes(tokens, self.PAGE)
+        if not hashes:
+            return
+        try:
+            await self._request({
+                "op": "dir_publish", "url": self.engine_url,
+                "generation": self.generation, "tier": "hbm",
+                "page_size": self.PAGE,
+                "entries": [[h.hex(), d, 1.0] for d, h in enumerate(hashes)],
+            })
+            self.published += len(hashes)
+        except Exception as e:  # noqa: BLE001 - the directory is a hint
+            print(f"fake-engine: directory publish failed: {e}", flush=True)
+
+
+def _prompt_text(body: dict, chat: bool) -> str:
+    """Same prompt extraction as the router's PrefixAwareRouter._prompt_of,
+    so the fake's published hashes align with the router's lookups."""
+    if "prompt" in body:
+        p = body["prompt"]
+        return p if isinstance(p, str) else (p[0] if p else "")
+    return "".join(str(m.get("content", "")) for m in body.get("messages", []) or [])
+
+
 def make_app(model: str, speed: float, ttft: float, model_label: str | None = None,
              faults: dict | None = None):
     faults = faults or {}
@@ -160,6 +257,23 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     if flight_dump_dir:
         configure_flightrecorder(dump_dir=flight_dump_dir)
     start_time = time.time()
+    # fleet-wide KV directory emulation (ISSUE 9): register + deterministic
+    # publish on stream completion, so router-v2 e2e runs without a TPU
+    dirpub = None
+    dir_tasks: set = set()
+    if faults.get("kv_directory_url"):
+        dirpub = _FakeDirectoryPublisher(
+            faults["kv_directory_url"],
+            faults.get("self_url") or "http://127.0.0.1:0",
+        )
+
+    def _publish_bg(prompt: str) -> None:
+        # the loop holds only WEAK refs to tasks: without a strong ref a
+        # publish parked on the publisher lock can be GC'd mid-flight and
+        # the claims silently never land (flaky chaos assertions)
+        t = asyncio.ensure_future(dirpub.publish_prompt(prompt))
+        dir_tasks.add(t)
+        t.add_done_callback(dir_tasks.discard)
 
     def _hard_crash():
         """kill -9 semantics: no drain, no flushed buffers, no cleanup —
@@ -297,6 +411,7 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         body = await request.json()
         max_tokens = int(body.get("max_tokens", 16))
         stream = bool(body.get("stream", False))
+        prompt_text = _prompt_text(body, chat)
         req_id = request.headers.get("X-Request-Id", uuid.uuid4().hex)
         uid = request.headers.get("x-user-id")
         if uid:
@@ -424,6 +539,10 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                 await asyncio.sleep(max_tokens / speed)
                 _decode_done(t_first)
                 STATE["completed"] += 1
+                if dirpub is not None:
+                    # deterministic publish on completion (ISSUE 9): this
+                    # prompt's chunk chain is now "resident" on this fake
+                    _publish_bg(prompt_text)
                 text = "Hello " * max_tokens
                 choice = (
                     {"index": 0, "message": {"role": "assistant", "content": text},
@@ -475,6 +594,8 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                 await asyncio.sleep(1.0 / speed)
             _decode_done(t_first)
             STATE["completed"] += 1
+            if dirpub is not None:
+                _publish_bg(prompt_text)
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
@@ -633,6 +754,11 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         return web.json_response({"status": "ok"})
 
     app = web.Application()
+    if dirpub is not None:
+        async def _dir_register(app):
+            await dirpub.register()  # eager, so a reborn fake re-fences fast
+
+        app.on_startup.append(_dir_register)
     app.router.add_get("/health", health)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/metrics", metrics)
@@ -724,6 +850,11 @@ def main():
     p.add_argument("--flight-dump-dir", type=str, default=None,
                    help="arm flight-recorder anomaly dumps (SIGTERM drain, "
                         "shed bursts) into this directory")
+    p.add_argument("--kv-directory-url", type=str, default=None,
+                   help="fleet-wide KV directory (cache server) to register "
+                        "with and publish deterministic per-prompt chunk "
+                        "hashes to on stream completion (router-v2 e2e "
+                        "without TPUs)")
     args = p.parse_args()
     app = make_app(
         args.model, args.speed, args.ttft, args.model_label,
@@ -741,6 +872,8 @@ def main():
             "slo_itl_ms": args.slo_itl_ms,
             "compile_stall_ms": args.compile_stall_ms,
             "flight_dump_dir": args.flight_dump_dir,
+            "kv_directory_url": args.kv_directory_url,
+            "self_url": f"http://127.0.0.1:{args.port}",
         },
     )
     asyncio.run(_serve_until_sigterm(app, args.port))

@@ -29,13 +29,23 @@ across the whole rotation and that routed traffic RETURNS to each reborn
 backend within the breaker half-open window (the reborn process's
 ``fake:served_total`` climbs from 0).
 
+A fourth scenario, ``run_directory_restart()``
+(``--scenario directory-restart``), exercises the fleet-wide KV directory
+(ISSUE 9, docs/kv-directory.md): three fake engines publishing deterministic
+chunk hashes behind a KV-aware-v2 router and a directory-hosting cache
+server; one engine is SIGTERM'd mid-load and reborn. Asserts zero client
+non-429 errors, resident-class routing actually happened, and the reborn
+engine re-registered under a higher generation (its stale claims expired)
+and republished.
+
 Importable as ``run_chaos()`` / ``run_overload()`` /
-``run_rolling_restart()`` (tests/test_chaos.py wires them into tier-1) or
-runnable standalone:
+``run_rolling_restart()`` / ``run_directory_restart()`` (tests/test_chaos.py
+wires them into tier-1) or runnable standalone:
 
     python scripts/chaos_check.py --num-requests 200
     python scripts/chaos_check.py --scenario overload
     python scripts/chaos_check.py --scenario rolling-restart
+    python scripts/chaos_check.py --scenario directory-restart
 """
 
 from __future__ import annotations
@@ -500,10 +510,212 @@ def run_rolling_restart(
             stop_proc(router)
 
 
+def run_directory_restart(
+    engines: int = 3,
+    workers: int = 4,
+    prefixes: int = 4,
+    settle_s: float = 2.5,
+    republish_window: float = 10.0,
+    directory_engine_timeout: float = 3.0,
+    max_tokens: int = 4,
+) -> dict:
+    """Fleet-wide KV directory restart scenario (ISSUE 9).
+
+    A cache server hosting the directory, three fake engines publishing
+    deterministic per-prompt chunk hashes (``--kv-directory-url``), and a
+    router in KV-aware v2 mode. Sustained load over a handful of long
+    shared session prefixes concentrates each prefix on its publishing
+    engine (resident routing); then one engine is SIGTERM'd mid-load and
+    reborn on the same address. Asserted by the caller:
+
+    - zero client non-429 errors across the whole rotation (the dead
+      backend's resident claims must not poison routing — failover +
+      directory TTL/generation fencing cover the gap),
+    - the router actually routed by directory class (resident routes > 0),
+    - the reborn engine re-registered under a HIGHER generation and
+      republished (its stale claims were expired, not trusted).
+    """
+    import time
+
+    import signal as signal_mod
+
+    from production_stack_tpu.kvoffload.protocol import BlockingClient
+
+    cache_port = free_port()
+    cache = start_proc([
+        "-m", "production_stack_tpu.kvoffload.cache_server",
+        "--port", str(cache_port), "--host", "127.0.0.1",
+        "--directory",
+        "--directory-engine-timeout", str(directory_engine_timeout),
+    ])
+    dir_url = f"127.0.0.1:{cache_port}"
+
+    def dir_dump() -> dict:
+        client = BlockingClient("127.0.0.1", cache_port, timeout=5)
+        try:
+            hdr, _ = client.request({"op": "dir_dump"})
+            return hdr
+        finally:
+            client.close()
+
+    ports = [free_port() for _ in range(engines)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+
+    def start_fake(port: int) -> "object":
+        return start_proc([
+            "-m", "production_stack_tpu.testing.fake_engine",
+            "--port", str(port), "--model", "fake/model", "--speed", "300",
+            "--kv-directory-url", dir_url,
+        ])
+
+    fakes = [start_fake(p) for p in ports]
+    router = None
+    stop_load = threading.Event()
+    statuses: collections.Counter = collections.Counter()
+    errors: list = []
+    lock = threading.Lock()
+    try:
+        router_port = free_port()
+        router = start_proc([
+            "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["fake/model"] * len(urls)),
+            "--routing-logic", "kvaware",
+            "--kv-directory-url", dir_url,
+            "--engine-stats-interval", "1",
+            "--retry-max-attempts", "3",
+            "--retry-backoff-base", "0.01",
+            "--breaker-failure-threshold", "2",
+            "--breaker-cooldown", "1.0",
+            # deliberately NO aggressive active health checks: the dead
+            # backend is handled by retry/failover + its breaker + the
+            # directory's TTL/fencing — and on a loaded CI host sub-second
+            # health probes can time out against HEALTHY backends and pull
+            # the whole fleet from rotation (client-visible 503s that have
+            # nothing to do with the scenario under test)
+        ])
+        base = f"http://127.0.0.1:{router_port}"
+        for proc, url in zip(fakes, urls):
+            wait_healthy(f"{url}/health", proc, timeout=30)
+        wait_healthy(f"{base}/health", router, timeout=30)
+        # drain router stdout: sustained load logs one line per request and a
+        # full 64 KB pipe wedges the event loop (PR 5 lesson)
+        threading.Thread(
+            target=lambda: router.stdout.read() if router.stdout else None,
+            daemon=True,
+        ).start()
+
+        # long shared session prefixes (several 16-char chunks each) so the
+        # fakes' published chains give the router real resident signal
+        prompts = [
+            f"session-{i:02d}-" + (chr(ord("a") + i) * 150) for i in range(prefixes)
+        ]
+
+        def load_worker(wid: int):
+            sess = requests.Session()
+            i = 0
+            while not stop_load.is_set():
+                i += 1
+                prompt = prompts[(wid + i) % len(prompts)] + f"::{wid}-{i}"
+                try:
+                    r = sess.post(
+                        f"{base}/v1/completions",
+                        json={"model": "fake/model", "prompt": prompt,
+                              "max_tokens": max_tokens},
+                        timeout=30,
+                    )
+                    with lock:
+                        statuses[r.status_code] += 1
+                        if r.status_code not in (200, 429):
+                            errors.append((r.status_code, r.text[:200]))
+                except requests.RequestException as e:
+                    with lock:
+                        errors.append(("exception", repr(e)))
+                time.sleep(0.03)
+
+        threads = [
+            threading.Thread(target=load_worker, args=(w,))
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(settle_s)  # publishes + resident routing reach steady state
+
+        victim = urls[0]
+        pre = dir_dump()
+        pre_gen = (pre.get("engines", {}).get(victim) or {}).get("generation", 0)
+        # SIGTERM the publishing engine mid-load; rebirth on the same address
+        fakes[0].send_signal(signal_mod.SIGTERM)
+        rc = fakes[0].wait(timeout=20)
+        fakes[0] = start_fake(ports[0])
+        wait_healthy(f"{urls[0]}/health", fakes[0], timeout=30)
+        # the reborn process must re-register under a HIGHER generation and
+        # republish entries as it serves (its pre-restart claims expire).
+        # While it was down the surviving engines took over the existing
+        # session prefixes (their resident claims now win), so feed the
+        # rotation NEW cold sessions — QPS routing sends those to the
+        # least-loaded backend, which is exactly how a reborn engine earns
+        # traffic (and directory entries) back in production
+        t0 = time.time()
+        reborn_gen, republished = 0, 0
+        k = 0
+        while time.time() - t0 < republish_window:
+            k += 1
+            prompts.append(f"post-restart-{k:02d}-" + ("z" * 150))
+            d = dir_dump().get("engines", {}).get(victim) or {}
+            reborn_gen = d.get("generation", 0)
+            republished = d.get("resident_chunks", 0)
+            if reborn_gen > pre_gen and republished > 0:
+                break
+            time.sleep(0.25)
+        time.sleep(0.5)
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        metrics = requests.get(f"{base}/metrics", timeout=10).text
+
+        def _counter(name: str) -> float:
+            m = re.search(rf"^{re.escape(name)} ([0-9.]+)$", metrics, re.M)
+            return float(m.group(1)) if m else 0.0
+
+        final = dir_dump()
+        return {
+            "statuses": dict(statuses),
+            "non_429_errors": len(errors),
+            "errors": errors[:10],
+            "victim": victim,
+            "victim_exit_rc": rc,
+            "pre_generation": pre_gen,
+            "reborn_generation": reborn_gen,
+            "republished_chunks": republished,
+            "expired_entries_total": final.get(
+                "kv_directory_expired_entries_total", 0
+            ),
+            "stale_hits_total": final.get("kv_directory_stale_hits_total", 0),
+            "resident_routes": _counter(
+                "vllm_router:kvaware_v2_resident_routes_total"
+            ),
+            "restorable_routes": _counter(
+                "vllm_router:kvaware_v2_restorable_routes_total"
+            ),
+            "cold_routes": _counter("vllm_router:kvaware_v2_cold_routes_total"),
+        }
+    finally:
+        stop_load.set()
+        for p_ in fakes:
+            stop_proc(p_)
+        if router is not None:
+            stop_proc(router)
+        stop_proc(cache)
+
+
 def main() -> int:
     p = argparse.ArgumentParser("chaos-check")
     p.add_argument("--scenario",
-                   choices=["chaos", "overload", "rolling-restart"],
+                   choices=["chaos", "overload", "rolling-restart",
+                            "directory-restart"],
                    default="chaos")
     p.add_argument("--num-requests", type=int, default=None)
     p.add_argument("--retry-budget", type=int, default=3)
@@ -511,6 +723,35 @@ def main() -> int:
     p.add_argument("--breaker-threshold", type=int, default=3)
     args = p.parse_args()
     from production_stack_tpu.router.resilience import OPEN
+
+    if args.scenario == "directory-restart":
+        s = run_directory_restart()
+        print(json.dumps(s, indent=2))
+        failures = []
+        if s["non_429_errors"]:
+            failures.append(
+                f"{s['non_429_errors']} non-429 client errors/hangs: "
+                f"{s['errors']}"
+            )
+        if s["resident_routes"] <= 0:
+            failures.append("router never routed a resident directory hit")
+        if s["reborn_generation"] <= s["pre_generation"]:
+            failures.append(
+                f"reborn engine did not advance its directory generation "
+                f"({s['pre_generation']} -> {s['reborn_generation']})"
+            )
+        if s["republished_chunks"] <= 0:
+            failures.append("reborn engine never republished directory entries")
+        if s["expired_entries_total"] <= 0:
+            failures.append(
+                "the restart expired no directory entries (stale claims "
+                "were kept)"
+            )
+        if failures:
+            print("DIRECTORY-RESTART CHECK FAILED: " + "; ".join(failures))
+            return 1
+        print("DIRECTORY-RESTART CHECK PASSED")
+        return 0
 
     if args.scenario == "rolling-restart":
         s = run_rolling_restart()

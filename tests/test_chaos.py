@@ -142,6 +142,28 @@ def test_rolling_restart_under_load_zero_errors_and_traffic_returns():
         assert d["crosslinked_trace_ids"] > 0, d
 
 
+def test_directory_restart_expires_stale_claims_with_zero_routing_errors():
+    """Acceptance (fleet-wide KV directory, ISSUE 9): a KV-aware-v2 router
+    over three directory-publishing fake engines and a directory-hosting
+    cache server; one engine SIGTERM'd mid-load and reborn on the same
+    address. Zero client non-429 errors across the rotation, the router
+    actually routed by directory class (resident hits), the restart expired
+    the dead incarnation's claims (generation fencing / TTL), and the reborn
+    engine re-registered under a higher generation and republished."""
+    s = chaos_check.run_directory_restart()
+    assert s["non_429_errors"] == 0, s["errors"]
+    assert s["statuses"].get(200, 0) > 0, s["statuses"]
+    assert s["victim_exit_rc"] == 0, s
+    # the run exercised directory ranking, not just the fallback trie
+    assert s["resident_routes"] > 0, s
+    # stale-claim hygiene: the dead incarnation's entries expired...
+    assert s["expired_entries_total"] > 0, s
+    # ...and the reborn process fenced them with a strictly higher
+    # generation, then earned entries back
+    assert s["reborn_generation"] > s["pre_generation"], s
+    assert s["republished_chunks"] > 0, s
+
+
 def test_inter_chunk_stall_aborts_engine_and_sends_sse_error():
     """Acceptance: a stream stalled past the inter-chunk timeout is aborted
     on the engine (scheduler slot freed, verified via /metrics running-count)

@@ -23,9 +23,11 @@ def _known_flags() -> set:
     # are documented in docs/failure-handling.md)
     for rel in (("production_stack_tpu", "router", "parser.py"),
                 ("production_stack_tpu", "testing", "fake_engine.py"),
+                ("production_stack_tpu", "kvoffload", "cache_server.py"),
                 ("benchmarks", "multi_round_qa.py"),
                 ("scripts", "chaos_check.py"),
                 ("scripts", "trace_report.py"),
+                ("scripts", "kv_directory_report.py"),
                 ("scripts", "graftcheck", "__main__.py")):
         src = REPO.joinpath(*rel).read_text()
         flags.update(re.findall(r'add_argument\(\s*"(--[a-z0-9-]+)"', src))
