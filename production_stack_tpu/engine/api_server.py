@@ -252,11 +252,23 @@ class EngineServer:
         # probes / router health checks pull the pod from rotation, new
         # generation requests are refused, and in-flight ones finish
         self.draining = False
-        # request-id -> (engine sequence ids, registered-at), for
-        # router-initiated aborts (POST /abort): a router that deadline-aborts
+        # request-id -> (engine sequence ids, registered-at, streaming,
+        # presentation meta), for router-initiated aborts (POST /abort) and
+        # live migration (POST /migrate_out): a router that deadline-aborts
         # a hung stream must be able to free this engine's scheduler slot and
-        # KV pages without relying on the TCP connection being noticed
-        self._live_requests: "dict[str, tuple[list[str], float]]" = {}
+        # KV pages without relying on the TCP connection being noticed, and
+        # the fleet controller must be able to name a victim stream by its
+        # wire id. The meta dict carries what a migration TARGET needs to
+        # keep emitting client-shaped chunks (oid/chat/created/model).
+        self._live_requests: "dict[str, tuple]" = {}
+        # live migration (docs/migration.md; all event-loop-owned):
+        # req_id -> {"target", "request_id"} set by a committed migrate_out,
+        # consumed by the streaming loop to emit the handoff control event
+        self._migrated_out: "dict[str, dict]" = {}
+        # req_id -> parked migrated-in continuation ({"q", "task", "snap",
+        # "t"}) awaiting the router's POST /migrate_attach
+        self._parked: "dict[str, dict]" = {}
+        self._mig_session = None  # lazy aiohttp client for /migrate_in ships
 
     # -- handlers -----------------------------------------------------------
 
@@ -286,6 +298,393 @@ class EngineServer:
             self.engine.abort(sid)
         logger.info("abort requested for %s (live=%s)", req_id, entry is not None)
         return web.json_response({"request_id": req_id, "aborted": entry is not None})
+
+    # -- live sequence migration (docs/migration.md) -------------------------
+
+    async def _mig_client(self):
+        """Lazy shared client session for shipping snapshots to targets."""
+        import aiohttp
+
+        if self._mig_session is None or self._mig_session.closed:
+            self._mig_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30, sock_connect=5)
+            )
+        return self._mig_session
+
+    async def _close_mig_client(self, app=None) -> None:
+        if self._mig_session is not None and not self._mig_session.closed:
+            await self._mig_session.close()
+        self._mig_session = None
+
+    async def migratable(self, request: web.Request) -> web.Response:
+        """Controller victim listing: live single-choice streaming requests
+        with their progress and migratability verdict. Read-only snapshot of
+        scheduler state — racing the device thread can only mis-list a
+        request for one tick; the authoritative re-check runs at freeze."""
+        mig = getattr(self.engine, "migration", None)
+        out: list = []
+        if mig is not None:
+            from production_stack_tpu.migration import unmigratable_reason
+
+            running = {
+                s.seq_id: s for s in list(self.engine.scheduler.running)
+            }
+            for rid, entry in list(self._live_requests.items()):
+                sub_ids, _ts, streaming, _meta = entry
+                if not streaming or len(sub_ids) != 1:
+                    continue
+                seq = running.get(sub_ids[0])
+                if seq is None or seq.finished:
+                    continue
+                reason = unmigratable_reason(seq)
+                out.append({
+                    "request_id": rid,
+                    "output_tokens": len(seq.output_ids),
+                    "prompt_tokens": len(seq.prompt_ids),
+                    "age_s": round(time.monotonic() - seq.arrival_time, 3),
+                    "migratable": reason is None,
+                    "reason": reason,
+                })
+        return web.json_response({"requests": out})
+
+    async def migrate_out(self, request: web.Request) -> web.Response:
+        """Freeze a running stream, ship its snapshot to the target engine's
+        /migrate_in, then commit (the stream ends with the handoff control
+        event the router splices on) or roll back (the sequence resumes
+        decoding locally — nothing was client-visible)."""
+        mig = getattr(self.engine, "migration", None)
+        if mig is None:
+            return web.json_response(
+                {"migrated": False, "error": "migration disabled"}, status=501
+            )
+        try:
+            body = await request.json()
+            rid = body["request_id"]
+            target = str(body["target_url"]).rstrip("/")
+        except (KeyError, TypeError, ValueError):
+            return web.json_response(
+                {"migrated": False,
+                 "error": "request_id and target_url required"},
+                status=400,
+            )
+        entry = self._live_requests.get(rid)
+        if entry is None:
+            return web.json_response(
+                {"migrated": False, "error": f"request {rid!r} is not live"},
+                status=409,
+            )
+        sub_ids, _ts, streaming, meta = entry
+        if not streaming or len(sub_ids) != 1:
+            return web.json_response(
+                {"migrated": False,
+                 "error": "only single-choice streaming requests migrate"},
+                status=409,
+            )
+        from production_stack_tpu.migration import (
+            MigrationError,
+            snapshot_to_wire,
+        )
+
+        loop = asyncio.get_running_loop()
+        snap_meta = {**meta, "request_id": rid}
+        try:
+            # device-thread work off the event loop (GC001 discipline)
+            snap = await loop.run_in_executor(
+                None, mig.freeze_and_snapshot, sub_ids[0], snap_meta
+            )
+        except MigrationError as e:
+            return web.json_response(
+                {"migrated": False, "error": str(e)}, status=409
+            )
+        ok, detail = False, ""
+        try:
+            session = await self._mig_client()
+            async with session.post(
+                f"{target}/migrate_in", data=snapshot_to_wire(snap),
+                headers={"Content-Type": "application/octet-stream"},
+            ) as resp:
+                detail = (await resp.text())[:200]
+                ok = resp.status == 200
+        except Exception as e:  # noqa: BLE001 - any ship failure rolls back
+            detail = repr(e)
+        if not ok:
+            # fallback: the sequence re-enters the running set and keeps
+            # streaming locally — the client never noticed the attempt
+            await loop.run_in_executor(None, mig.rollback, sub_ids[0])
+            logger.warning(
+                "migrate_out %s -> %s refused: %s", rid, target, detail
+            )
+            return web.json_response(
+                {"migrated": False, "error": detail or "target refused"},
+                status=502,
+            )
+        # control-event metadata BEFORE commit: the commit's terminal emit
+        # races the streaming loop's pop of this entry. Janitor: when the
+        # client disconnected between freeze and commit, the streaming
+        # handler already tore down (its pop ran before this set) and the
+        # terminal emit finds no consumer — nothing would ever pop the
+        # entry, and a reused wire id would see a stale handoff target
+        self._migrated_out[rid] = {"target": target, "request_id": rid}
+        loop.call_later(60.0, self._migrated_out.pop, rid, None)
+        await loop.run_in_executor(
+            None, mig.commit, sub_ids[0], len(snap.page_hashes)
+        )
+        logger.info(
+            "migrated %s -> %s (%d pages restorable)",
+            rid, target, len(snap.page_hashes),
+        )
+        return web.json_response({
+            "migrated": True, "target": target,
+            "pages_moved": len(snap.page_hashes),
+        })
+
+    async def migrate_in(self, request: web.Request) -> web.Response:
+        """Accept a sealed snapshot and park the continuation: KV blobs
+        prefetch into the local tiers, the sequence re-admits through the
+        ordinary prefix-cache path (shipped pages share, the tail recomputes
+        deterministically), and outputs buffer until /migrate_attach."""
+        mig = getattr(self.engine, "migration", None)
+        if mig is None:
+            return web.json_response(
+                {"accepted": False, "error": "migration disabled"}, status=501
+            )
+        if self.draining:
+            return web.json_response(
+                {"accepted": False, "error": "draining"}, status=503
+            )
+        if self.engine.is_sleeping:
+            return web.json_response(
+                {"accepted": False, "error": "sleeping"}, status=503
+            )
+        saturated = getattr(self.engine, "saturated", None)
+        if saturated is not None and saturated():
+            # a saturated target must refuse extra work — 429 tells the
+            # controller to pick a cooler target (breaker-neutral, like any
+            # shed)
+            return _shed_response(
+                getattr(self.engine, "shed_retry_after", lambda: 1.0)(),
+                "engine saturated; pick a cooler migration target",
+            )
+        from production_stack_tpu.kvoffload.serde import KVIntegrityError
+        from production_stack_tpu.migration import (
+            continuation_params,
+            snapshot_from_wire,
+        )
+
+        data = await request.read()
+        try:
+            snap = snapshot_from_wire(data)
+            params = continuation_params(snap)
+        except (KVIntegrityError, ValueError, KeyError, TypeError) as e:
+            return web.json_response(
+                {"accepted": False, "error": f"bad snapshot: {e}"}, status=400
+            )
+        if snap.model != self.cfg.name:
+            return web.json_response(
+                {"accepted": False,
+                 "error": f"model mismatch: {snap.model!r} != {self.cfg.name!r}"},
+                status=409,
+            )
+        rid = snap.request_id
+        if rid in self._parked or rid in self._live_requests:
+            return web.json_response(
+                {"accepted": False, "error": f"{rid!r} already live here"},
+                status=409,
+            )
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        task = loop.create_task(self._pump_migrated(snap, params, q))
+        self._parked[rid] = {
+            "q": q, "task": task, "snap": snap, "t": time.monotonic(),
+        }
+        # chained migration: the continuation is itself a live, migratable
+        # stream (an engine holding migrated-in work must still evacuate).
+        # prior_completion accumulates tokens emitted on EVERY previous hop:
+        # a re-freeze snapshots only THIS engine's output_ids, so without
+        # the running total a 2+-hop stream's final usage would drop the
+        # first hop's tokens
+        self._live_requests[rid] = (
+            [rid], time.monotonic(), True,
+            {**snap.meta,
+             "prior_completion": snap.output_len
+             + int(snap.meta.get("prior_completion") or 0)},
+        )
+        # a router that died mid-handoff must not leak a decoding sequence:
+        # unattached continuations abort after the timeout
+        loop.call_later(
+            max(1.0, getattr(self.cfg, "migrate_attach_timeout_s", 30.0)),
+            self._expire_parked, rid,
+        )
+        mig.note_migrate_in()
+        return web.json_response({
+            "accepted": True, "request_id": rid,
+            "restorable_pages": len(snap.page_hashes),
+        })
+
+    async def _pump_migrated(self, snap, params, q: asyncio.Queue) -> None:
+        """Parked continuation driver: prefetch the snapshot's KV blobs into
+        the local tiers (executor — tier reads block), then resume decoding
+        and buffer outputs for the attach stream. shed_exempt: a migrated
+        stream is mid-flight — shedding it would drop a committed stream."""
+        loop = asyncio.get_running_loop()
+        mig = self.engine.migration
+        try:
+            if snap.page_hashes and snap.page_size == self.cfg.page_size:
+                await loop.run_in_executor(
+                    None, mig.prefetch_pages, snap.page_hashes
+                )
+            async for out in self.engine.generate(
+                snap.request_id, prompt_token_ids=snap.tokens, params=params,
+                shed_exempt=True,
+            ):
+                await q.put(out)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 - surfaced on the attach stream
+            await q.put(e)
+        finally:
+            self._live_requests.pop(snap.request_id, None)
+            await q.put(None)
+
+    def _expire_parked(self, rid: str) -> None:
+        parked = self._parked.pop(rid, None)
+        if parked is None:
+            return  # attached (or already expired)
+        parked["task"].cancel()
+        self.engine.abort(rid)
+        mig = getattr(self.engine, "migration", None)
+        if mig is not None:
+            mig.failures += 1
+        logger.warning(
+            "migrated-in continuation %s expired unattached; aborted", rid
+        )
+
+    async def migrate_attach(self, request: web.Request) -> web.StreamResponse:
+        """Stream a parked continuation in the client wire shape. The final
+        usage block reports WHOLE-request totals (pre- + post-migration), so
+        the spliced stream is indistinguishable from an unmigrated one."""
+        if getattr(self.engine, "migration", None) is None:
+            return web.json_response(
+                {"error": {"message": "migration disabled"}}, status=501
+            )
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 - allow query-only attaches
+            body = {}
+        rid = body.get("request_id") or request.query.get("request_id")
+        if not rid:
+            return web.json_response(
+                {"error": {"message": "request_id required"}}, status=400
+            )
+        # tiny grace for reordering: the source commits (ending its stream)
+        # only after our /migrate_in returned, so the parked entry normally
+        # exists before any attach arrives
+        deadline = time.monotonic() + 10.0
+        parked = self._parked.pop(rid, None)
+        while parked is None and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+            parked = self._parked.pop(rid, None)
+        if parked is None:
+            return web.json_response(
+                {"error": {"message": f"no parked continuation for {rid!r}"}},
+                status=404,
+            )
+        snap, q = parked["snap"], parked["q"]
+        meta = snap.meta
+        chat = bool(meta.get("chat"))
+        oid = meta.get("oid") or (("chatcmpl-" if chat else "cmpl-") + rid)
+        created = int(meta.get("created") or time.time())
+        model = meta.get("model") or snap.model
+        kind = "chat.completion" if chat else "text_completion"
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Request-Id": rid,
+            },
+        )
+        await resp.prepare(request)
+
+        async def send(obj: dict):
+            await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
+
+        new_tokens = 0
+        try:
+            while True:
+                out = await q.get()
+                if out is None:
+                    break
+                if isinstance(out, Exception):
+                    await send({"error": {
+                        "message": f"migrated continuation failed: {out}",
+                        "type": "upstream_error", "code": 502,
+                    }})
+                    await resp.write_eof()
+                    return resp
+                if (
+                    out.finished
+                    and out.finish_reason == "migrated"
+                    and (mi := self._migrated_out.pop(rid, None)) is not None
+                ):
+                    # chained migration: this continuation moved AGAIN —
+                    # hand the splice the next hop and end this leg
+                    await send({"pstpu_migration": mi})
+                    await resp.write_eof()
+                    return resp
+                new_tokens = out.completion_tokens
+                if out.finished and out.finish_reason in (
+                    "abort", "error", "shed"
+                ):
+                    await send({"error": {
+                        "message": (
+                            "migrated continuation ended with "
+                            f"{out.finish_reason!r}"
+                        ),
+                        "type": "upstream_error", "code": 502,
+                    }})
+                    await resp.write_eof()
+                    return resp
+                if chat:
+                    delta = (
+                        {"content": out.text_delta} if out.text_delta else {}
+                    )
+                    choice = {"index": 0, "delta": delta,
+                              "finish_reason": out.finish_reason}
+                    obj = "chat.completion.chunk"
+                else:
+                    choice = {"index": 0, "text": out.text_delta,
+                              "logprobs": None,
+                              "finish_reason": out.finish_reason}
+                    obj = "text_completion"
+                await send({
+                    "id": oid, "object": obj, "created": created,
+                    "model": model, "choices": [choice],
+                })
+            prompt_tokens = int(meta.get("prompt_tokens") or snap.prompt_len)
+            # whole-request total: every previous hop's tokens + the tokens
+            # already emitted when THIS hop froze + this continuation's
+            completion = (
+                int(meta.get("prior_completion") or 0)
+                + snap.output_len + new_tokens
+            )
+            await send({
+                "id": oid, "object": f"{kind}.chunk" if chat else kind,
+                "created": created, "model": model, "choices": [],
+                "usage": {
+                    "prompt_tokens": prompt_tokens,
+                    "completion_tokens": completion,
+                    "total_tokens": prompt_tokens + completion,
+                },
+            })
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            # the splicing router (or client) went away: reclaim the seq
+            parked["task"].cancel()
+            self.engine.abort(rid)
+            raise
+        await resp.write_eof()
+        return resp
 
     async def drain(self, timeout: float = 30.0) -> None:
         """Stop accepting generation work and wait for the engine to go
@@ -439,6 +838,14 @@ class EngineServer:
             render_phase_histograms,
         )
 
+        # live-migration surface (docs/migration.md): counters + the
+        # freeze-to-commit duration histogram
+        mig = getattr(self.engine, "migration", None)
+        if mig is not None:
+            ms = mig.stats()
+            for k in sorted(ms):
+                emit(k, "counter", ms[k])
+            lines.extend(mig.duration_hist.render(f'model_name="{m}"'))
         lines.extend(render_phase_histograms(f'model_name="{m}"'))
         # span-loss + flight-recorder health (trace debugging is only
         # trustworthy when its own drops are measurable)
@@ -768,7 +1175,13 @@ class EngineServer:
             oldest = next(iter(self._live_requests))
             if time.monotonic() - self._live_requests[oldest][1] > 3600:
                 self._live_requests.pop(oldest)
-        self._live_requests[req_id] = (sub_ids, time.monotonic())
+        self._live_requests[req_id] = (
+            sub_ids, time.monotonic(), stream,
+            # presentation meta a migration target needs to keep emitting
+            # client-shaped chunks (and honest whole-request usage totals)
+            {"oid": oid, "chat": chat, "created": created, "model": model,
+             "prompt_tokens": len(prompt_ids)},
+        )
 
         def _gen(sid):
             kwargs = dict(
@@ -976,12 +1389,27 @@ class EngineServer:
 
             parsers = [StreamingToolParser(tool_style) for _ in range(n)]
             tool_idx = [0] * n
+        migrated_away = False
         try:
             lp_offsets = [0] * n
             t_first_out = None
             hop_done = False
             async for i, out in _chain_first(first_item, merged):
                 lasts[i] = out
+                if (
+                    out.finished
+                    and out.finish_reason == "migrated"
+                    and (mi := self._migrated_out.pop(req_id, None)) is not None
+                ):
+                    # live migration handoff (docs/migration.md): the
+                    # continuation now decodes on the target engine. Emit
+                    # the control event the router's splice watches for and
+                    # end this leg WITHOUT [DONE] — the router (or an
+                    # engine-direct client) attaches to the target's
+                    # /migrate_attach for the rest of the stream.
+                    await send({"pstpu_migration": mi})
+                    migrated_away = True
+                    break
                 if i == 0 and t_first_out is None:
                     t_first_out = time.perf_counter()
                 if not role_sent[i]:
@@ -1071,7 +1499,7 @@ class EngineServer:
                         (time.perf_counter() - t_first_out) * 1000,
                     ))
                     _ttft_hist.observe(t_first_out - t_accept)
-            if lasts[0] is not None:
+            if lasts[0] is not None and not migrated_away:
                 usage = _usage(lasts[0])
                 if n > 1:
                     usage["completion_tokens"] = sum(
@@ -1085,13 +1513,16 @@ class EngineServer:
                         "usage": usage,
                     }
                 )
-            await resp.write(b"data: [DONE]\n\n")
+            if not migrated_away:
+                await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):
             self._live_requests.pop(req_id, None)
+            self._migrated_out.pop(req_id, None)
             for sid in sub_ids:
                 self.engine.abort(sid)
             raise
         self._live_requests.pop(req_id, None)
+        self._migrated_out.pop(req_id, None)
         _latency_hist.observe(time.perf_counter() - t_accept)
         _collector.record(
             "engine.request", trace_ctx, t_accept_wall,
@@ -1336,7 +1767,11 @@ class EngineServer:
     # -- app ---------------------------------------------------------------
 
     def build_app(self) -> web.Application:
-        app = web.Application()
+        # client_max_size: aiohttp's 1 MiB default would reject /migrate_in
+        # snapshots for long-context sequences (a 128k-token stream's token
+        # list alone is ~1 MB) — exactly the long streams migration exists
+        # to protect. 64 MiB bounds a ~1M-token snapshot.
+        app = web.Application(client_max_size=64 << 20)
         r = app.router
         r.add_get("/health", self.health)
         r.add_get("/ping", self.health)
@@ -1359,6 +1794,13 @@ class EngineServer:
             r.add_get("/v1/debug/flightrecorder", self.flightrecorder)
             r.add_post("/metrics/reset", self.metrics_reset)
         r.add_post("/abort", self.abort)
+        # live sequence migration (docs/migration.md): registered even when
+        # --no-migration (handlers answer 501) so the wire surface — and the
+        # GC005 fake-engine parity contract — stays stable
+        r.add_get("/migratable", self.migratable)
+        r.add_post("/migrate_out", self.migrate_out)
+        r.add_post("/migrate_in", self.migrate_in)
+        r.add_post("/migrate_attach", self.migrate_attach)
         r.add_post("/tokenize", self.tokenize)
         r.add_post("/detokenize", self.detokenize)
         r.add_post("/v1/chat/completions", self.chat_completions)
@@ -1372,6 +1814,7 @@ class EngineServer:
         r.add_get("/is_sleeping", self.is_sleeping)
         r.add_post("/v1/load_lora_adapter", self.load_lora_adapter)
         r.add_post("/v1/unload_lora_adapter", self.unload_lora_adapter)
+        app.on_cleanup.append(self._close_mig_client)
         return app
 
 

@@ -219,6 +219,25 @@ class EngineConfig:
     kv_directory_pull_max_pages: int = 256
     kv_instance_id: Optional[str] = None
     advertise_host: Optional[str] = None  # URL other pods reach this engine at
+    # live sequence migration (production_stack_tpu/migration,
+    # docs/migration.md): serve POST /migrate_out (freeze a running stream,
+    # ship its KV chain through the offload tiers + its sampling/decode
+    # state to a target engine), POST /migrate_in (park the continuation),
+    # POST /migrate_attach (stream it), GET /migratable (controller victim
+    # listing). --no-migration disables the subsystem; without an offload
+    # tier migrations still work but ship zero pages (full recompute).
+    migration: bool = True
+    # seconds a parked /migrate_in continuation waits for its
+    # /migrate_attach before it is aborted (a router that died mid-handoff
+    # must not leak a decoding sequence forever)
+    migrate_attach_timeout_s: float = 30.0
+    # scale-up warm-up (ISSUE 10 satellite, ROADMAP item 2 remainder): pull
+    # the top-N fleet-warm chunks (cache server dir_top_prefixes) into the
+    # LOCAL offload tiers during engine construction — BEFORE /ready — so a
+    # freshly scaled-up engine serves its first requests with warm prefix
+    # hits instead of a cold cache. Needs --kv-directory-url and an offload
+    # tier; 0 disables. Counted as vllm:kv_directory_prefetched_pages_total.
+    warm_prefetch_on_boot: int = 0
     # disaggregated prefill role: none | producer | consumer
     kv_role: str = "none"
     kv_transfer_port: int = 55555
@@ -319,6 +338,21 @@ _FLAG_HELP = {
     ),
     "kv_directory_pull_max_pages": (
         "cap on pages one admission may prefetch from the shared tier"
+    ),
+    "migration": (
+        "serve the live-sequence-migration endpoints (/migrate_out, "
+        "/migrate_in, /migrate_attach, /migratable) so running streams can "
+        "move between engines without dropping (docs/migration.md); "
+        "--no-migration disables"
+    ),
+    "migrate_attach_timeout_s": (
+        "seconds a parked migrated-in continuation waits for the router's "
+        "/migrate_attach before it is aborted"
+    ),
+    "warm_prefetch_on_boot": (
+        "pull this many top fleet-warm chunks (cache server "
+        "dir_top_prefixes) into the local offload tiers before /ready, so "
+        "a scaled-up engine starts warm; needs --kv-directory-url (0 = off)"
     ),
     "flight_recorder": (
         "record scheduler/KV/shed/compile engine events into a bounded ring "

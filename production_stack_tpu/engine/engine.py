@@ -294,6 +294,19 @@ class LLMEngine:
                     "tier blobs are pulled from the cache server); "
                     "publish-only mode"
                 )
+        # scale-up warm-up (docs/migration.md): pull the fleet's top warm
+        # chunks into the LOCAL tiers before the API server exists (still on
+        # the construction thread, like warm restore — blocking here is what
+        # makes "warm before /ready" true). Blobs land tier-side only; the
+        # first matching request's admission restores them into HBM through
+        # the ordinary _extend_from_offload path and scores a prefix hit.
+        self.kv_directory_prefetched_pages = 0
+        if (
+            cfg.warm_prefetch_on_boot > 0
+            and cfg.kv_directory_url
+            and self._offload is not None
+        ):
+            self.kv_directory_prefetched_pages = self._boot_prefetch(cfg)
         # disaggregated prefill (SURVEY.md §2.3): producer pushes finished
         # prefill KV to the decode peer; consumer receives into its store
         self._kv_sender = None
@@ -358,6 +371,16 @@ class LLMEngine:
         # (_runahead_prefills), which is what licenses the scheduler's
         # one-extra-burst chaining floor past the admission-wait budget
         self.scheduler.runahead_available = True
+        # live sequence migration (production_stack_tpu/migration): frozen
+        # sequences are OUT of the running set but keep their pages while
+        # the target decides; device-thread-owned by construction (freeze/
+        # commit/rollback/abort all run as device commands), so no lock
+        self._frozen: dict[str, Sequence] = {}
+        self.migration = None
+        if cfg.migration:
+            from production_stack_tpu.migration import MigrationManager
+
+            self.migration = MigrationManager(self)
         self._inbox: queue_mod.Queue = queue_mod.Queue()
         # prefill dispatches whose results were never fetched (skip-fetch
         # optimization); a deferred device error taints these sequences
@@ -586,6 +609,46 @@ class LLMEngine:
             engine_url=self._advertised_url(cfg),
         )
 
+    def _boot_prefetch(self, cfg: EngineConfig) -> int:
+        """Directory-driven scale-up prefetch: ask the cache server for the
+        fleet's top warm chunks (``dir_top_prefixes``, heads-first) and pull
+        their blobs into the LOCAL host tiers. Runs on the construction
+        thread BEFORE the server reports ready. Never raises — a cold boot
+        is a degradation, not a failure."""
+        try:
+            from production_stack_tpu.kvoffload.protocol import (
+                BlockingClient,
+                parse_hostport,
+            )
+
+            host, port = parse_hostport(cfg.kv_directory_url, default_port=8200)
+            client = BlockingClient(host, port, timeout=10)
+            try:
+                hdr, _ = client.request({
+                    "op": "dir_top_prefixes",
+                    "limit": cfg.warm_prefetch_on_boot,
+                    "page_size": cfg.page_size,
+                })
+            finally:
+                client.close()
+            keys = hdr.get("hashes") or []
+            store = self._offload.store
+            n = 0
+            for key in keys:
+                try:
+                    if store.contains_local(key) or store.get(key) is not None:
+                        n += 1
+                except Exception:  # noqa: BLE001 - one bad blob: keep pulling
+                    logger.exception("boot prefetch failed for %s", key)
+            logger.info(
+                "warm prefetch on boot: pulled %d/%d fleet-warm chunks into "
+                "local tiers", n, len(keys),
+            )
+            return n
+        except Exception as e:  # noqa: BLE001 - directory down = cold boot
+            logger.warning("warm prefetch on boot failed: %s", e)
+            return 0
+
     def _advertised_url(self, cfg: EngineConfig) -> str:
         """URL other pods (router, KV controller/directory consumers) reach
         this engine at. A wildcard bind address would never match a
@@ -813,6 +876,13 @@ class LLMEngine:
                 if defer_aborts:
                     deferred.append(item)
                     continue
+                # a FROZEN sequence (mid-migration) is outside the
+                # scheduler's queues; an abort (client disconnect during the
+                # handoff window) must still free it or it leaks forever
+                frozen = self._frozen.pop(item[1], None)
+                if frozen is not None and not frozen.finished:
+                    self.scheduler._finish(frozen, "abort")
+                    self._emit(frozen, "")
                 for s in self.scheduler.waiting + self.scheduler.running:
                     if s.seq_id == item[1] and not s.finished:
                         self.scheduler._finish(s, "abort")
@@ -1894,6 +1964,12 @@ class LLMEngine:
             )
             out["kv_directory_flush_errors_total"] = (
                 p["kv_directory_flush_errors_total"]
+            )
+        if self.cfg.warm_prefetch_on_boot > 0:
+            # scale-up warm-up surface (docs/migration.md): chunks pulled
+            # into the local tiers before /ready
+            out["kv_directory_prefetched_pages_total"] = (
+                self.kv_directory_prefetched_pages
             )
         if self._kvdir_pull is not None:
             # ...and pull-side: lookups/hits drive the cross-engine pull

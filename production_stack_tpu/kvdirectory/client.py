@@ -65,6 +65,11 @@ class DirectoryClient:
     async def lookup_hashes(self, hashes: list[str]) -> dict:
         return await self._request({"op": "dir_lookup_hashes", "hashes": hashes})
 
+    async def top_prefixes(self, limit: int, page_size: int = 0) -> dict:
+        return await self._request({
+            "op": "dir_top_prefixes", "limit": limit, "page_size": page_size,
+        })
+
     async def stats(self) -> dict:
         return await self._request({"op": "dir_stats"})
 

@@ -352,6 +352,36 @@ class KVDirectory:
             "total_tokens": len(tokens),
         }
 
+    def top_prefixes(self, limit: int, page_size: int = 0) -> list:
+        """The fleet's warmest RESTORABLE chunks, heads-first (scale-up
+        prefetch, docs/migration.md): shared-claimed, blob-backed chunk
+        hashes ranked by chain depth ASC then reuse score DESC — a chain can
+        only restore from its head, so under a budget the heads are what a
+        new engine must pull first. ``page_size`` filters to chunks a
+        consumer at that page size can actually use (chunk identity is
+        page-size-dependent); 0 keeps all."""
+        self.expire_dead_engines()
+        scored: list = []
+        for h, holders in list(self.chunks.items()):
+            best = None
+            for url, e in list(holders.items()):
+                if not e.shared or not self._entry_live(url, e):
+                    continue
+                rec = self.engines.get(url)
+                if page_size and (rec is None or rec.page_size != page_size):
+                    continue
+                key = (e.depth, -e.score)
+                if best is None or key < best:
+                    best = key
+            if best is None:
+                continue
+            if self.blob_check is not None and not self.blob_check(h):
+                self.blob_evicted(h)  # vanished under the claim
+                continue
+            scored.append((best[0], best[1], h))
+        scored.sort()
+        return [h for _, _, h in scored[: max(0, int(limit))]]
+
     # -- persistence -----------------------------------------------------------
 
     def snapshot(self) -> dict:

@@ -227,6 +227,11 @@ class CacheServer:
         elif op == "dir_lookup_hashes":
             res = d.lookup_hashes(hdr.get("hashes", []))
             await write_frame(writer, {"ok": True, **res})
+        elif op == "dir_top_prefixes":
+            hashes = d.top_prefixes(
+                int(hdr.get("limit", 0)), int(hdr.get("page_size", 0))
+            )
+            await write_frame(writer, {"ok": True, "hashes": hashes})
         elif op == "dir_stats":
             await write_frame(writer, {"ok": True, **d.stats()})
         elif op == "dir_dump":

@@ -1,0 +1,41 @@
+"""Live sequence migration + saturation-driven fleet control (ISSUE 10,
+docs/migration.md).
+
+Two halves:
+
+- ``state``/``manager`` — the engine side of live sequence migration: a
+  *running* sequence's KV page chain (shipped through the existing offload
+  tiers, CRC-verified exactly like warm-start blobs), its sampling/decode
+  state (token history = position + emitted count, params, seed), and its
+  presentation metadata are snapshotted into a sealed wire document, shipped
+  to a cooler engine, and resumed mid-stream with bit-identical greedy
+  continuation. The router splices the continued SSE stream
+  (router/request_service.py) so the client sees one uninterrupted response.
+- ``controller`` — the saturation-driven fleet controller: a
+  prometheus-adapter-style loop over the stack's own telemetry
+  (``vllm_router:fleet_saturation``, per-backend saturation/queue depth)
+  deciding **rebalance** (migrate the hottest long streams off the most
+  saturated engine), **drain** (evacuate every sequence before SIGTERM —
+  zero-loss scale-down), and **warm-up** (directory-driven prefetch into a
+  scaled-up engine). ``scripts/fleet_controller.py`` is the CLI.
+"""
+
+from production_stack_tpu.migration.controller import (  # noqa: F401
+    Action,
+    BackendView,
+    ControllerPolicy,
+    FleetController,
+    FleetDecider,
+)
+from production_stack_tpu.migration.manager import (  # noqa: F401
+    MigrationError,
+    MigrationManager,
+)
+from production_stack_tpu.migration.state import (  # noqa: F401
+    SNAPSHOT_FORMAT,
+    SequenceSnapshot,
+    continuation_params,
+    snapshot_from_wire,
+    snapshot_to_wire,
+    unmigratable_reason,
+)

@@ -213,6 +213,13 @@ class EngineStatsScraper(metaclass=SingletonMeta):
         for url in list(self.epochs):
             if url not in current:
                 del self.epochs[url]
+                # migration session pins must not keep steering sessions at
+                # a backend removed from the config (resilience.py)
+                from production_stack_tpu.router.resilience import (
+                    get_session_pins,
+                )
+
+                get_session_pins().forget_backend(url)
                 # deliberately NOT resetting the SLO cursor here: a backend
                 # can drop out of discovery without restarting (health-check
                 # flap under overload — exactly when SLO data matters), and

@@ -237,6 +237,166 @@ def spawn_abort(backend_url: str, request_id: str) -> "asyncio.Task":
     return task
 
 
+# live-migration stream handoff (docs/migration.md): a migrating source
+# engine ends its SSE leg with ONE control event instead of [DONE]; the
+# proxy suppresses the event, attaches to the target's /migrate_attach, and
+# splices the continuation into the client's still-open response — the
+# client sees one uninterrupted stream.
+MIGRATION_MARKER = b'data: {"pstpu_migration"'
+
+
+async def _read_migration_event(chunk: bytes, chunks):
+    """Split ``chunk`` at the migration control event.
+
+    Returns ``(forward_bytes, event_dict | None)``. The event is the
+    stream's final event, but TCP may fragment it across reads — keep
+    pulling until its ``\\n\\n`` terminator. A torn or unparseable event is
+    treated as absent and forwarded verbatim (the client then sees the raw
+    event, which is still better than eating its bytes)."""
+    idx = chunk.rfind(MIGRATION_MARKER)
+    if idx < 0:
+        return chunk, None
+    prefix, rest = chunk[:idx], chunk[idx:]
+    while b"\n\n" not in rest:
+        try:
+            rest += await asyncio.wait_for(chunks.__anext__(), 5.0)
+        except (StopAsyncIteration, asyncio.TimeoutError, aiohttp.ClientError,
+                ConnectionResetError):
+            return prefix + rest, None
+    payload = rest[len(b"data: "): rest.find(b"\n\n")]
+    try:
+        event = json.loads(payload)["pstpu_migration"]
+    except (ValueError, KeyError, TypeError):
+        return prefix + rest, None
+    if not isinstance(event, dict):
+        return prefix + rest, None
+    return prefix, event
+
+
+def _marker_tail_overlap(chunk: bytes) -> int:
+    """Length of the longest suffix of ``chunk`` that is a proper prefix of
+    the migration marker. TCP may split the source's final write ANYWHERE —
+    including inside the marker itself — and a marker split across two reads
+    would otherwise leak the raw control event to the client and skip the
+    splice. The proxy withholds such a tail (<= 23 bytes) until the next
+    read resolves it."""
+    for k in range(min(len(MIGRATION_MARKER) - 1, len(chunk)), 0, -1):
+        if chunk.endswith(MIGRATION_MARKER[:k]):
+            return k
+    return 0
+
+
+def _maybe_pin_session(request, target: str) -> None:
+    """SessionRouter re-pin: the hash ring is deterministic, so without an
+    explicit pin the session's NEXT request would route straight back to the
+    backend the controller just migrated it off."""
+    from production_stack_tpu.router.resilience import get_session_pins
+
+    try:
+        router = get_routing_logic()
+    except AssertionError:  # embedded/unit use without initialized routing
+        return
+    if not isinstance(router, SessionRouter):
+        return
+    headers = getattr(request, "headers", None)
+    sid = headers.get(router.session_key) if headers is not None else None
+    if sid:
+        get_session_pins().pin(str(sid), target)
+
+
+async def _splice_migrated_stream(
+    resp: web.StreamResponse,
+    event: dict,
+    *,
+    request: web.Request,
+    session: aiohttp.ClientSession,
+    stall_timeout: Optional[float],
+    breakers,
+    captured: Optional[list] = None,
+) -> bool:
+    """Attach to the migration target and splice the continuation into the
+    client's open response. Loops: a continuation may itself migrate again
+    (chained handoff — e.g. its new home drains too), ending its leg with
+    another control event. Returns True when [DONE] reached the client;
+    on failure the client gets the terminal SSE error event (PR 2 contract)
+    — tokens already streamed, so failover is no longer possible."""
+    from production_stack_tpu.router.resilience import (
+        count_migration_splice_failure,
+        count_session_repin,
+    )
+
+    hops = 0
+    while event is not None:
+        if hops >= 8:  # chained-handoff loop bound
+            # the cap firing means a pathological migration loop — the
+            # stream must end with the explicit error contract, never a
+            # silent truncation recorded as success
+            count_migration_splice_failure()
+            logger.error(
+                "migration splice exceeded %d chained hops for %s; aborting",
+                hops, event.get("request_id"),
+            )
+            await resp.write(_sse_error_event(
+                f"migration handoff chain exceeded {hops} hops", 502
+            ))
+            return False
+        hops += 1
+        target = str(event.get("target") or "").rstrip("/")
+        mig_id = event.get("request_id")
+        next_event = None
+        try:
+            if not target or not mig_id:
+                raise ValueError(f"malformed migration event: {event}")
+            async with session.post(
+                f"{target}/migrate_attach", json={"request_id": mig_id},
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10),
+            ) as tr:
+                if tr.status != 200:
+                    detail = (await tr.read())[:200]
+                    raise ValueError(
+                        f"attach returned {tr.status}: "
+                        f"{detail.decode(errors='replace')}"
+                    )
+                # the splice IS the session re-pin: count it and pin the
+                # session key (when a SessionRouter is active) to the target
+                count_session_repin()
+                _maybe_pin_session(request, target)
+                chunks = tr.content.iter_any()
+                while True:
+                    try:
+                        if stall_timeout:
+                            chunk = await asyncio.wait_for(
+                                chunks.__anext__(), stall_timeout
+                            )
+                        else:
+                            chunk = await chunks.__anext__()
+                    except StopAsyncIteration:
+                        break
+                    chunk, next_event = await _read_migration_event(
+                        chunk, chunks
+                    )
+                    if chunk:
+                        if captured is not None:
+                            captured.append(chunk)
+                        await resp.write(chunk)
+                    if next_event is not None:
+                        break
+        except (aiohttp.ClientError, ConnectionResetError,
+                asyncio.TimeoutError, OSError, ValueError) as e:
+            if target:
+                breakers.record_failure(target)
+            count_migration_splice_failure()
+            logger.error(
+                "migration splice to %s failed for %s: %s", target, mig_id, e
+            )
+            await resp.write(_sse_error_event(
+                f"migration handoff to {target or '?'} failed: {e}", 502
+            ))
+            return False
+        event = next_event
+    return True
+
+
 def _sse_error_event(message: str, code: int = 502) -> bytes:
     """Terminal SSE error event (docs/failure-handling.md contract): a
     mid-stream backend death must surface as an explicit `error` payload, not
@@ -541,26 +701,67 @@ async def _proxy_attempt(
         captured: list[bytes] = []
         first = True
         chunk = first_chunk
+        mig_carry = b""  # withheld possible-marker-prefix tail (SSE only)
         while chunk is not None:
-            if first:
-                monitor.on_request_response(backend_url, request_id)
-                first = False
-                t_first = time.perf_counter()
-                # hop columns are attempt-relative (stage costs stay honest:
-                # retry/backoff time of earlier attempts must not pollute the
-                # recv_to_route quantiles); the TTFT histogram still gets the
-                # full client-experienced window including failed attempts
-                hop_sample = record_hop_sample(
-                    (t_route - (ts_recv or t_route)) * 1000 if attempt == 1 else 0.0,
-                    (t_conn - t_route) * 1000,
-                    (t_first - t_conn) * 1000,
-                    ttft_s=t_first - (ts_recv or t_route),
+            mig_event = None
+            if is_sse:
+                if mig_carry:
+                    chunk = mig_carry + chunk
+                    mig_carry = b""
+                if MIGRATION_MARKER in chunk:
+                    # live-migration handoff: split out the control event
+                    # (it must never reach the client) before forwarding
+                    chunk, mig_event = await _read_migration_event(
+                        chunk, chunks
+                    )
+                elif chunk:
+                    # a chunk tail that could be the START of a split
+                    # marker is withheld until the next read resolves it
+                    k = _marker_tail_overlap(chunk)
+                    if k:
+                        mig_carry = chunk[-k:]
+                        chunk = chunk[:-k]
+            if chunk or mig_event is None:
+                if first:
+                    monitor.on_request_response(backend_url, request_id)
+                    first = False
+                    t_first = time.perf_counter()
+                    # hop columns are attempt-relative (stage costs stay honest:
+                    # retry/backoff time of earlier attempts must not pollute the
+                    # recv_to_route quantiles); the TTFT histogram still gets the
+                    # full client-experienced window including failed attempts
+                    hop_sample = record_hop_sample(
+                        (t_route - (ts_recv or t_route)) * 1000 if attempt == 1 else 0.0,
+                        (t_conn - t_route) * 1000,
+                        (t_first - t_conn) * 1000,
+                        ttft_s=t_first - (ts_recv or t_route),
+                    )
+                else:
+                    monitor.on_token(backend_url, request_id)
+            if chunk:
+                if capture_body is not None:
+                    captured.append(chunk)
+                await resp.write(chunk)
+            if mig_event is not None:
+                # the source leg ended cleanly by handing the stream over:
+                # splice the continuation from the target into the client's
+                # open response (docs/migration.md)
+                spliced_ok = await _splice_migrated_stream(
+                    resp, mig_event, request=request, session=session,
+                    stall_timeout=stall_timeout, breakers=breakers,
+                    captured=captured if capture_body is not None else None,
                 )
-            else:
-                monitor.on_token(backend_url, request_id)
-            if capture_body is not None:
-                captured.append(chunk)
-            await resp.write(chunk)
+                proxy_attrs["migrated_to"] = mig_event.get("target")
+                outcome = "migrated" if spliced_ok else "migration_splice_failed"
+                breakers.record_success(backend_url)
+                latency_hist.observe(time.perf_counter() - (ts_recv or t_route))
+                if spliced_ok and capture_body is not None:
+                    await capture_body(backend_resp.status, b"".join(captured))
+                try:
+                    await resp.write_eof()
+                except Exception:  # noqa: BLE001 - client may be gone
+                    pass
+                return resp
             try:
                 # per-chunk wait_for costs a Task per chunk, but only when
                 # the stall deadline is enabled. ClientTimeout(sock_read=…)
@@ -614,6 +815,12 @@ async def _proxy_attempt(
                 except Exception:  # noqa: BLE001 - client may be gone too
                     pass
                 return resp
+        if mig_carry:
+            # clean EOF with a withheld tail: it was ordinary content that
+            # merely LOOKED like a marker prefix — deliver it
+            if capture_body is not None:
+                captured.append(mig_carry)
+            await resp.write(mig_carry)
         await resp.write_eof()
         latency_hist.observe(time.perf_counter() - (ts_recv or t_route))
         if hop_sample is None:

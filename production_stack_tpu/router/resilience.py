@@ -213,6 +213,51 @@ class BreakerRegistry:
         return dict(self._breakers)
 
 
+class SessionPinRegistry:
+    """Session re-pins after a live migration (docs/migration.md).
+
+    A SessionRouter maps a session key to its backend through a consistent
+    hash ring — deterministic, so a stream migrated off its hashed home
+    would bounce straight back on the session's next request and thrash.
+    A pin is an explicit override: "this session now lives on <url>",
+    written by the router's stream-splice path when it hands a migrated
+    stream over, consulted by SessionRouter before the ring, expired by TTL
+    (a session that goes quiet long enough re-homes via the ring, which is
+    also how pins converge back after a scale event)."""
+
+    TTL_S = 1800.0
+
+    def __init__(self):
+        self._pins: dict[str, tuple[str, float]] = {}  # sid -> (url, expiry)
+
+    def pin(self, session_id: str, url: str, ttl: Optional[float] = None) -> None:
+        self._pins[session_id] = (
+            url, time.monotonic() + (ttl if ttl is not None else self.TTL_S)
+        )
+
+    def lookup(self, session_id: str, now: Optional[float] = None) -> Optional[str]:
+        ent = self._pins.get(session_id)
+        if ent is None:
+            return None
+        url, expiry = ent
+        if (now or time.monotonic()) >= expiry:
+            del self._pins[session_id]
+            return None
+        return url
+
+    def forget_backend(self, url: str) -> None:
+        """Backend gone (pod deleted / drained away): its pins must not keep
+        steering sessions at a corpse."""
+        for sid in [s for s, (u, _) in self._pins.items() if u == url]:
+            del self._pins[sid]
+
+    def clear(self) -> None:
+        self._pins.clear()
+
+    def __len__(self) -> int:
+        return len(self._pins)
+
+
 class SaturationRegistry:
     """Per-backend load-shed state (overload survival).
 
@@ -273,6 +318,12 @@ retries_total = 0
 failovers_total = 0
 sheds_total = 0  # backend 429s observed (shed-aware failover, not failures)
 deadline_aborts_total: dict[str, int] = {"ttft": 0, "inter_chunk": 0, "request": 0}
+# live-migration stream handoffs the proxy spliced (each is a session re-pin
+# of the in-flight stream; SessionRouter pins are registered alongside)
+session_repins_total = 0
+# handoffs that failed after the source committed (the client got the SSE
+# error-event contract instead of a silent truncation)
+migration_splice_failures_total = 0
 
 
 def count_retry() -> None:
@@ -294,13 +345,26 @@ def count_deadline_abort(kind: str) -> None:
     deadline_aborts_total[kind] = deadline_aborts_total.get(kind, 0) + 1
 
 
+def count_session_repin() -> None:
+    global session_repins_total
+    session_repins_total += 1
+
+
+def count_migration_splice_failure() -> None:
+    global migration_splice_failures_total
+    migration_splice_failures_total += 1
+
+
 def reset_counters() -> None:
     """Test/bench support (mirrors reset_hop_samples): live Prometheus
     counters never reset outside a process restart."""
     global retries_total, failovers_total, sheds_total
+    global session_repins_total, migration_splice_failures_total
     retries_total = 0
     failovers_total = 0
     sheds_total = 0
+    session_repins_total = 0
+    migration_splice_failures_total = 0
     for k in list(deadline_aborts_total):
         deadline_aborts_total[k] = 0
 
@@ -314,6 +378,11 @@ def render_resilience_metrics() -> list[str]:
         f"vllm_router:failovers_total {failovers_total}",
         "# TYPE vllm_router:sheds_total counter",
         f"vllm_router:sheds_total {sheds_total}",
+        "# TYPE vllm_router:session_repins_total counter",
+        f"vllm_router:session_repins_total {session_repins_total}",
+        "# TYPE vllm_router:migration_splice_failures_total counter",
+        f"vllm_router:migration_splice_failures_total "
+        f"{migration_splice_failures_total}",
         "# TYPE vllm_router:deadline_aborts_total counter",
     ]
     for kind, n in sorted(deadline_aborts_total.items()):
@@ -351,6 +420,7 @@ def render_resilience_metrics() -> list[str]:
 _policy: Optional[RetryPolicy] = None
 _registry: Optional[BreakerRegistry] = None
 _saturation: Optional[SaturationRegistry] = None
+_session_pins: Optional[SessionPinRegistry] = None
 
 
 def get_saturation_registry() -> SaturationRegistry:
@@ -358,6 +428,13 @@ def get_saturation_registry() -> SaturationRegistry:
     if _saturation is None:
         _saturation = SaturationRegistry()
     return _saturation
+
+
+def get_session_pins() -> SessionPinRegistry:
+    global _session_pins
+    if _session_pins is None:
+        _session_pins = SessionPinRegistry()
+    return _session_pins
 
 
 def initialize_resilience(
@@ -373,6 +450,7 @@ def initialize_resilience(
 ) -> None:
     global _policy, _registry
     get_saturation_registry().clear()  # reconfigure: no stale shed windows
+    get_session_pins().clear()  # ...and no stale migration pins
     _policy = RetryPolicy(
         max_attempts=retry_max_attempts,
         backoff_base=retry_backoff_base,

@@ -170,6 +170,15 @@ class SessionRouter(RoutingInterface):
         self._sync_ring(endpoints)
         if not session_id:
             return _qps_routing(endpoints, request_stats)
+        # migration re-pin (docs/migration.md): a session whose stream was
+        # live-migrated is pinned to its new backend — the hash ring is
+        # deterministic and would bounce it straight back, undoing the
+        # controller's rebalance on the very next request
+        from production_stack_tpu.router.resilience import get_session_pins
+
+        pinned = get_session_pins().lookup(str(session_id))
+        if pinned is not None and any(ep.url == pinned for ep in endpoints):
+            return pinned
         return self.ring.get_node(str(session_id))
 
 

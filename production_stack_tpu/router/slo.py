@@ -15,7 +15,9 @@ exports, on the router's ``/metrics``:
   * ``objective="availability"``— the request finished ok at all (sheds,
     aborts, and errors violate; they have no honest latency to judge)
 - ``vllm_router:slo_request_outcomes_total{outcome,server}`` — terminal
-  outcome counts (ok / shed / abort / error).
+  outcome counts (ok / shed / abort / error / migrated; a "migrated" record
+  is the SOURCE side of a live migration and abstains from every latency
+  and availability objective — the target attributes the real terminal).
 - ``vllm_router:slo_records_total{server}`` — records ingested (a flat line
   while traffic flows means the backend's /slo_records scrape is broken).
 - ``vllm_router:fleet_saturation`` — a single [0, 1] gauge: the mean
@@ -44,7 +46,7 @@ from production_stack_tpu.utils.logging import init_logger
 logger = init_logger(__name__)
 
 OBJECTIVES = ("ttft", "itl", "availability")
-OUTCOMES = ("ok", "shed", "abort", "error")
+OUTCOMES = ("ok", "shed", "abort", "error", "migrated")
 
 
 class SLOMonitor(metaclass=SingletonMeta):
@@ -108,6 +110,13 @@ class SLOMonitor(metaclass=SingletonMeta):
             outcome = "error"
         self._records_total[url] = self._records_total.get(url, 0) + 1
         self._outcomes[(url, outcome)] = self._outcomes.get((url, outcome), 0) + 1
+        if outcome == "migrated":
+            # the stream continues on another engine, which attributes the
+            # REAL terminal record when it finishes — the source's handoff
+            # record is diagnostic only. Counting it as an availability
+            # violation would charge every rebalance as an outage; counting
+            # it attained would double-count the request.
+            return
         self._bump(url, model, "availability", outcome == "ok")
         if outcome != "ok":
             # a shed/abort/error has no honest latency to judge: it violates

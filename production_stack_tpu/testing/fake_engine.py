@@ -54,13 +54,38 @@ scripts/chaos_check.py):
                          sched/kv/shed event feed matches the real engine's
 - ``POST /abort``        cancels an in-flight request by X-Request-Id, like
                          the real engine's abort endpoint
+- ``--migration``        live sequence migration (ISSUE 10, docs/migration.md)
+                         in the REAL wire shapes: ``POST /migrate_out``
+                         freezes a streaming request at a deterministic chunk
+                         boundary, ships a sealed ``SequenceSnapshot``
+                         (production_stack_tpu/migration/state.py — the same
+                         document a real engine ships) to the target's
+                         ``POST /migrate_in``, and on acceptance ends the
+                         source stream with the ``pstpu_migration`` control
+                         event the router splices on; the target parks the
+                         continuation and serves it via
+                         ``POST /migrate_attach`` (same chunk/usage/[DONE]
+                         shapes as the real engine), so router splice e2e and
+                         the scale-cycle chaos scenario run without TPUs.
+                         ``GET /migratable`` lists live streams for the fleet
+                         controller. GC005 endpoint parity holds: the real
+                         engine serves the same four routes.
+- ``--warm-prefetch-on-boot N``  scale-up warm-up modelling: at startup pull
+                         the directory's top-N fleet-warm chunk hashes
+                         (``dir_top_prefixes``) and count a warm prefix hit
+                         for every later request whose prompt chain starts in
+                         that set.
 
 Observability used by chaos assertions: ``fake:running_peak`` (bounded-queue
 proof), ``fake:served_total`` (generation requests accepted by THIS process —
 resets on restart, which is how a chaos run detects traffic returning to a
 reborn backend), ``fake:completed_total`` (generations that ran to the end —
-fleet-wide sum proves an idempotent replay executed exactly once), and
-``fake:abort_requests_total`` (router-initiated reclaims received).
+fleet-wide sum proves an idempotent replay executed exactly once),
+``fake:abort_requests_total`` (router-initiated reclaims received),
+``fake:migrations_out_total`` / ``fake:migrations_in_total`` (live streams
+moved out of / resumed on this process), ``fake:warm_prefetch_chunks``
+(fleet-warm chunks pulled at boot), and ``fake:warm_prefix_hits_total``
+(requests whose prompt chain hit the prefetched set).
 
 SIGTERM drains like the real engine (api_server graceful drain): /health
 flips to 503, new generation requests are refused, in-flight streams finish.
@@ -112,6 +137,17 @@ STATE = {
     # shed timestamps feeding the flight recorder's shed-burst anomaly dump
     "shed_times": collections.deque(maxlen=64),
     "compile_stalled": False,  # --compile-stall-ms fires once, on request 1
+    # live migration (--migration; all event-loop-owned)
+    "migrations_out": 0,    # streams frozen + shipped off this process
+    "migrations_in": 0,     # snapshots accepted + parked here
+    "migrating": {},        # req_id -> freeze/ship coordination entry
+    "parked": {},           # req_id -> {"snap", "remaining", "t"}
+    "streams": set(),       # req_ids currently streaming (migratable set)
+    "progress": {},         # req_id -> output tokens emitted so far
+    "meta": {},             # req_id -> presentation meta (snapshot source)
+    # scale-up warm-up modelling (--warm-prefetch-on-boot)
+    "prefetched": set(),    # dir_top_prefixes hashes pulled at boot
+    "warm_prefix_hits": 0,  # requests whose prompt chain hit that set
 }
 
 
@@ -168,7 +204,7 @@ class _FakeDirectoryPublisher:
         self._lock = asyncio.Lock()
         self.published = 0
 
-    async def _request(self, header: dict) -> dict:
+    async def _request(self, header: dict, payload: bytes = b"") -> dict:
         from production_stack_tpu.kvoffload.protocol import (
             read_frame,
             write_frame,
@@ -186,7 +222,7 @@ class _FakeDirectoryPublisher:
                         "generation": self.generation,
                     })
                     await asyncio.wait_for(read_frame(self._reader), 5.0)
-                await write_frame(self._writer, header)
+                await write_frame(self._writer, header, payload)
                 hdr, _ = await asyncio.wait_for(read_frame(self._reader), 5.0)
                 return hdr
             except Exception:
@@ -205,24 +241,49 @@ class _FakeDirectoryPublisher:
             print(f"fake-engine: directory register failed: {e}", flush=True)
 
     async def publish_prompt(self, prompt: str) -> None:
-        """Deterministic resident-claim publish on stream completion."""
+        """Deterministic claim publish on stream completion: resident (HBM)
+        claims plus SHARED claims backed by tiny sealed blobs put into the
+        co-hosted cache server — the directory verifies shared claims
+        against the actual blob map at lookup time (blob_check), so shared
+        visibility (restorable ranking, dir_top_prefixes warm-up) is only
+        testable when the blobs really exist."""
         from production_stack_tpu.engine.kv_manager import prefix_hashes
         from production_stack_tpu.engine.tokenizer import ByteTokenizer
+        from production_stack_tpu.kvoffload.serde import seal_bytes
 
         tokens = ByteTokenizer().encode(prompt)
         hashes = prefix_hashes(tokens, self.PAGE)
         if not hashes:
             return
+        entries = [[h.hex(), d, 1.0] for d, h in enumerate(hashes)]
         try:
             await self._request({
                 "op": "dir_publish", "url": self.engine_url,
                 "generation": self.generation, "tier": "hbm",
-                "page_size": self.PAGE,
-                "entries": [[h.hex(), d, 1.0] for d, h in enumerate(hashes)],
+                "page_size": self.PAGE, "entries": entries,
+            })
+            for h, _d, _s in entries:
+                await self._request(
+                    {"op": "put", "key": h},
+                    seal_bytes(b"fake-kv", kind="page"),
+                )
+            await self._request({
+                "op": "dir_publish", "url": self.engine_url,
+                "generation": self.generation, "tier": "shared",
+                "page_size": self.PAGE, "entries": entries,
             })
             self.published += len(hashes)
         except Exception as e:  # noqa: BLE001 - the directory is a hint
             print(f"fake-engine: directory publish failed: {e}", flush=True)
+
+    async def top_prefixes(self, limit: int) -> list:
+        """Scale-up warm-up: the fleet's warmest chunk hashes, heads-first
+        (the same ``dir_top_prefixes`` op a real engine's
+        --warm-prefetch-on-boot pulls)."""
+        hdr = await self._request({
+            "op": "dir_top_prefixes", "limit": limit, "page_size": self.PAGE,
+        })
+        return hdr.get("hashes") or []
 
 
 def _prompt_text(body: dict, chat: bool) -> str:
@@ -274,6 +335,290 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         t = asyncio.ensure_future(dirpub.publish_prompt(prompt))
         dir_tasks.add(t)
         t.add_done_callback(dir_tasks.discard)
+
+    # -- live migration (--migration; real wire shapes, docs/migration.md) --
+    migration_enabled = bool(faults.get("migration", True))
+    warm_prefetch_n = int(faults.get("warm_prefetch_on_boot") or 0)
+    self_url = faults.get("self_url") or "http://127.0.0.1:0"
+    mig_session: list = [None]  # lazy shared aiohttp client for ships
+
+    async def _mig_client():
+        import aiohttp
+
+        if mig_session[0] is None or mig_session[0].closed:
+            mig_session[0] = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=15, sock_connect=5)
+            )
+        return mig_session[0]
+
+    def _prompt_warm_hit(prompt: str) -> None:
+        """--warm-prefetch-on-boot accounting: a prompt whose chain HEAD is
+        in the prefetched set would have served a warm prefix hit."""
+        if not STATE["prefetched"]:
+            return
+        from production_stack_tpu.engine.kv_manager import prefix_hashes
+        from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+        hashes = prefix_hashes(ByteTokenizer().encode(prompt), 16)
+        if hashes and hashes[0].hex() in STATE["prefetched"]:
+            STATE["warm_prefix_hits"] += 1
+
+    async def _maybe_migrate_out(resp, req_id: str, total_out: int) -> bool:
+        """Streaming-loop migration hook (chunk-boundary deterministic):
+        when /migrate_out froze this stream, report progress, wait for the
+        ship decision, and on commit end the leg with the REAL control
+        event (no [DONE] — the router's splice takes over). Returns True
+        when the stream ended here."""
+        mig = STATE["migrating"].get(req_id)
+        if mig is None or mig.get("frozen"):
+            return False
+        mig["sent"] = total_out
+        mig["frozen"] = True
+        mig["ready"].set()
+        await mig["done"].wait()
+        STATE["migrating"].pop(req_id, None)
+        if not mig.get("commit"):
+            return False  # rolled back: keep streaming locally
+        await resp.write(
+            f"data: {json.dumps({'pstpu_migration': {'target': mig['target'], 'request_id': req_id}})}\n\n".encode()
+        )
+        STATE["migrations_out"] += 1
+        _push_slo_record(model, req_id, "migrated")
+        return True
+
+    async def migratable(request):
+        """Fleet-controller victim listing, same shape as the real engine."""
+        out = [
+            {
+                "request_id": rid,
+                "output_tokens": int(STATE["progress"].get(rid, 0)),
+                "prompt_tokens": 10,
+                "age_s": 0.0,
+                "migratable": migration_enabled
+                and rid not in STATE["migrating"],
+                "reason": None if migration_enabled else "migration disabled",
+            }
+            for rid in list(STATE["streams"])
+        ]
+        return web.json_response({"requests": out})
+
+    async def migrate_out(request):
+        """Freeze -> ship (sealed real-shape snapshot) -> commit/rollback,
+        mirroring the real engine's /migrate_out semantics."""
+        if not migration_enabled:
+            return web.json_response(
+                {"migrated": False, "error": "migration disabled"}, status=501
+            )
+        try:
+            body = await request.json()
+            rid = body["request_id"]
+            target = str(body["target_url"]).rstrip("/")
+        except (KeyError, TypeError, ValueError):
+            return web.json_response(
+                {"migrated": False,
+                 "error": "request_id and target_url required"}, status=400,
+            )
+        if rid not in STATE["streams"] or rid not in STATE["inflight"]:
+            return web.json_response(
+                {"migrated": False, "error": f"{rid!r} is not a live stream"},
+                status=409,
+            )
+        if rid in STATE["migrating"]:
+            return web.json_response(
+                {"migrated": False, "error": "migration already in progress"},
+                status=409,
+            )
+        entry = {
+            "ready": asyncio.Event(), "done": asyncio.Event(),
+            "commit": False, "target": target, "sent": 0, "frozen": False,
+        }
+        STATE["migrating"][rid] = entry
+        try:
+            await asyncio.wait_for(entry["ready"].wait(), 5.0)
+        except asyncio.TimeoutError:
+            STATE["migrating"].pop(rid, None)
+            entry["done"].set()
+            return web.json_response(
+                {"migrated": False,
+                 "error": "stream never reached a migration point"},
+                status=409,
+            )
+        from production_stack_tpu.migration import (
+            SequenceSnapshot,
+            snapshot_to_wire,
+        )
+
+        meta = dict(STATE["meta"].get(rid) or {})
+        max_tokens = int(meta.get("max_tokens", entry["sent"] + 1))
+        snap = SequenceSnapshot(
+            request_id=rid, model=model, page_size=16,
+            # synthetic but structurally real: 10 prompt ids + one id per
+            # emitted token (the receiving fake only needs the lengths)
+            tokens=list(range(10)) + [72] * entry["sent"],
+            prompt_len=10, output_len=entry["sent"],
+            params={
+                "max_tokens": max_tokens, "temperature": 0.0, "top_k": 0,
+                "top_p": 1.0, "stop": [], "ignore_eos": True,
+                "min_tokens": 0, "seed": None, "presence_penalty": 0.0,
+                "frequency_penalty": 0.0, "repetition_penalty": 1.0,
+            },
+            page_hashes=[], meta=meta,
+        )
+        ok, detail = False, ""
+        try:
+            sess = await _mig_client()
+            async with sess.post(
+                f"{target}/migrate_in", data=snapshot_to_wire(snap),
+                headers={"Content-Type": "application/octet-stream"},
+            ) as r2:
+                detail = (await r2.text())[:200]
+                ok = r2.status == 200
+        except Exception as e:  # noqa: BLE001 - ship failure rolls back
+            detail = repr(e)
+        entry["commit"] = ok
+        entry["done"].set()
+        if not ok:
+            return web.json_response(
+                {"migrated": False, "error": detail or "target refused"},
+                status=502,
+            )
+        return web.json_response(
+            {"migrated": True, "target": target, "pages_moved": 0}
+        )
+
+    async def migrate_in(request):
+        """Accept a sealed snapshot (REAL parse + validation path) and park
+        the synthetic continuation for /migrate_attach."""
+        if not migration_enabled:
+            return web.json_response(
+                {"accepted": False, "error": "migration disabled"}, status=501
+            )
+        if STATE["draining"]:
+            return web.json_response(
+                {"accepted": False, "error": "draining"}, status=503
+            )
+        from production_stack_tpu.kvoffload.serde import KVIntegrityError
+        from production_stack_tpu.migration import (
+            continuation_params,
+            snapshot_from_wire,
+        )
+
+        data = await request.read()
+        try:
+            snap = snapshot_from_wire(data)
+            params = continuation_params(snap)
+        except (KVIntegrityError, ValueError, KeyError, TypeError) as e:
+            return web.json_response(
+                {"accepted": False, "error": f"bad snapshot: {e}"}, status=400
+            )
+        if snap.model != model:
+            return web.json_response(
+                {"accepted": False,
+                 "error": f"model mismatch: {snap.model!r} != {model!r}"},
+                status=409,
+            )
+        rid = snap.request_id
+        if rid in STATE["parked"] or rid in STATE["streams"]:
+            return web.json_response(
+                {"accepted": False, "error": f"{rid!r} already live here"},
+                status=409,
+            )
+        STATE["parked"][rid] = {
+            "snap": snap, "remaining": params.max_tokens,
+            "t": time.monotonic(),
+        }
+        STATE["migrations_in"] += 1
+
+        def _expire():
+            if STATE["parked"].pop(rid, None) is not None:
+                print(f"fake-engine: parked {rid} expired unattached",
+                      flush=True)
+
+        asyncio.get_running_loop().call_later(30.0, _expire)
+        return web.json_response({
+            "accepted": True, "request_id": rid,
+            "restorable_pages": len(snap.page_hashes),
+        })
+
+    async def migrate_attach(request):
+        """Stream a parked continuation in the real chunk/usage/[DONE] wire
+        shapes; supports chained migration (the continuation can itself be
+        migrated out again mid-attach)."""
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001
+            body = {}
+        rid = body.get("request_id") or request.query.get("request_id")
+        deadline = time.monotonic() + 10.0
+        parked = STATE["parked"].pop(rid, None)
+        while parked is None and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+            parked = STATE["parked"].pop(rid, None)
+        if parked is None:
+            return web.json_response(
+                {"error": {"message": f"no parked continuation for {rid!r}"}},
+                status=404,
+            )
+        snap = parked["snap"]
+        meta = snap.meta
+        chat = bool(meta.get("chat"))
+        oid = meta.get("oid") or (("chatcmpl-" if chat else "cmpl-") + rid)
+        created = int(meta.get("created") or time.time())
+        kind = "chat.completion" if chat else "text_completion"
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream", "X-Request-Id": rid}
+        )
+        await resp.prepare(request)
+        # the continuation is a live, re-migratable stream on THIS process
+        STATE["running"] += 1
+        STATE["running_peak"] = max(STATE["running_peak"], STATE["running"])
+        STATE["inflight"][rid] = asyncio.current_task()
+        STATE["streams"].add(rid)
+        STATE["meta"][rid] = {
+            **meta, "max_tokens": int(snap.params.get("max_tokens", 1)),
+        }
+        emitted = 0
+        try:
+            for _j in range(parked["remaining"]):
+                if await _maybe_migrate_out(
+                    resp, rid, snap.output_len + emitted
+                ):
+                    await resp.write_eof()
+                    return resp
+                STATE["progress"][rid] = snap.output_len + emitted
+                delta = {"content": "Hello "} if chat else None
+                choice = (
+                    {"index": 0, "delta": delta, "finish_reason": None}
+                    if chat
+                    else {"index": 0, "text": "Hello ", "finish_reason": None}
+                )
+                await resp.write(
+                    f"data: {json.dumps({'id': oid, 'object': 'chat.completion.chunk' if chat else 'text_completion', 'created': created, 'model': model, 'choices': [choice]})}\n\n".encode()
+                )
+                emitted += 1
+                await asyncio.sleep(1.0 / speed)
+            prompt_tokens = int(meta.get("prompt_tokens") or snap.prompt_len)
+            completion = snap.output_len + emitted
+            await resp.write(
+                f"data: {json.dumps({'id': oid, 'object': f'{kind}.chunk' if chat else kind, 'created': created, 'model': model, 'choices': [], 'usage': {'prompt_tokens': prompt_tokens, 'completion_tokens': completion, 'total_tokens': prompt_tokens + completion}})}\n\n".encode()
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            STATE["completed"] += 1
+            _push_slo_record(
+                model, rid, "ok", output_tokens=completion,
+            )
+            await resp.write_eof()
+            return resp
+        except asyncio.CancelledError:
+            _push_slo_record(model, rid, "abort")
+            raise
+        finally:
+            STATE["running"] -= 1
+            STATE["inflight"].pop(rid, None)
+            STATE["streams"].discard(rid)
+            STATE["progress"].pop(rid, None)
+            STATE["meta"].pop(rid, None)
+            STATE["migrating"].pop(rid, None)
 
     def _hard_crash():
         """kill -9 semantics: no drain, no flushed buffers, no cleanup —
@@ -348,6 +693,12 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             f'fake:served_total{{model_name="{model}"}} {STATE["served"]}\n'
             f'fake:completed_total{{model_name="{model}"}} {STATE["completed"]}\n'
             f'fake:abort_requests_total{{model_name="{model}"}} {STATE["aborts"]}\n'
+            # live-migration + scale-up warm-up surface (chaos scale-cycle
+            # assertions; real engines export vllm:migrations_*_total)
+            f'fake:migrations_out_total{{model_name="{model}"}} {STATE["migrations_out"]}\n'
+            f'fake:migrations_in_total{{model_name="{model}"}} {STATE["migrations_in"]}\n'
+            f'fake:warm_prefetch_chunks{{model_name="{model}"}} {len(STATE["prefetched"])}\n'
+            f'fake:warm_prefix_hits_total{{model_name="{model}"}} {STATE["warm_prefix_hits"]}\n'
         )
         if restore_pages:
             # warm-restart modelling (--restart-restore-pages): the same
@@ -474,6 +825,12 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         STATE["inflight"][req_id] = asyncio.current_task()
         created = int(time.time())
         oid = ("chatcmpl-" if chat else "cmpl-") + req_id
+        # presentation meta a migration snapshot carries (real-shape parity)
+        STATE["meta"][req_id] = {
+            "oid": oid, "chat": chat, "created": created, "model": model,
+            "prompt_tokens": 10, "max_tokens": max_tokens,
+        }
+        _prompt_warm_hit(prompt_text)
 
         def _phase(name, start, dur, **attrs):
             collector.record(
@@ -565,7 +922,14 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                 headers={"Content-Type": "text/event-stream", "X-Request-Id": req_id}
             )
             await resp.prepare(request)
+            STATE["streams"].add(req_id)  # migratable from the first chunk on
             for i in range(max_tokens):
+                # live migration: a frozen stream hands off at this chunk
+                # boundary (control event written, no [DONE]) or resumes
+                if await _maybe_migrate_out(resp, req_id, i):
+                    await resp.write_eof()
+                    return resp
+                STATE["progress"][req_id] = i
                 # mid-stream hard crash: one chunk leaves first when the
                 # stream has more than one, then the whole process vanishes
                 # without a FIN or a drain; a single-token stream crashes on
@@ -607,6 +971,10 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         finally:
             STATE["running"] -= 1
             STATE["inflight"].pop(req_id, None)
+            STATE["streams"].discard(req_id)
+            STATE["progress"].pop(req_id, None)
+            STATE["meta"].pop(req_id, None)
+            STATE["migrating"].pop(req_id, None)
             collector.record(
                 "engine.request", trace_ctx, t_accept,
                 time.time() - t_accept, request_id=req_id, model=model,
@@ -753,12 +1121,32 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         get_flightrecorder().reset()
         return web.json_response({"status": "ok"})
 
-    app = web.Application()
+    # same client_max_size as the real engine: /migrate_in snapshots for
+    # long-context streams exceed aiohttp's 1 MiB default
+    app = web.Application(client_max_size=64 << 20)
     if dirpub is not None:
         async def _dir_register(app):
             await dirpub.register()  # eager, so a reborn fake re-fences fast
+            if warm_prefetch_n > 0:
+                # scale-up warm-up modelling: pull the fleet's top warm
+                # chunks at boot (the real engine does this BEFORE /ready)
+                try:
+                    hashes = await dirpub.top_prefixes(warm_prefetch_n)
+                    STATE["prefetched"] = set(hashes)
+                    print(
+                        f"fake-engine: warm-prefetched {len(hashes)} "
+                        "fleet-warm chunks", flush=True,
+                    )
+                except Exception as e:  # noqa: BLE001 - cold boot, not fatal
+                    print(f"fake-engine: warm prefetch failed: {e}", flush=True)
 
         app.on_startup.append(_dir_register)
+
+    async def _close_mig_session(app):
+        if mig_session[0] is not None and not mig_session[0].closed:
+            await mig_session[0].close()
+
+    app.on_cleanup.append(_close_mig_session)
     app.router.add_get("/health", health)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/metrics", metrics)
@@ -768,6 +1156,10 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_post("/abort", abort)
+    app.router.add_get("/migratable", migratable)
+    app.router.add_post("/migrate_out", migrate_out)
+    app.router.add_post("/migrate_in", migrate_in)
+    app.router.add_post("/migrate_attach", migrate_attach)
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
     app.router.add_get("/is_sleeping", is_sleeping)
@@ -855,6 +1247,16 @@ def main():
                         "with and publish deterministic per-prompt chunk "
                         "hashes to on stream completion (router-v2 e2e "
                         "without TPUs)")
+    p.add_argument("--migration", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="serve the live-sequence-migration endpoints "
+                        "(/migrate_out /migrate_in /migrate_attach "
+                        "/migratable) in the real wire shapes "
+                        "(docs/migration.md); --no-migration disables")
+    p.add_argument("--warm-prefetch-on-boot", type=int, default=0,
+                   help="pull this many top fleet-warm chunk hashes "
+                        "(dir_top_prefixes) at startup and count warm "
+                        "prefix hits against them; needs --kv-directory-url")
     args = p.parse_args()
     app = make_app(
         args.model, args.speed, args.ttft, args.model_label,
@@ -873,6 +1275,8 @@ def main():
             "compile_stall_ms": args.compile_stall_ms,
             "flight_dump_dir": args.flight_dump_dir,
             "kv_directory_url": args.kv_directory_url,
+            "migration": args.migration,
+            "warm_prefetch_on_boot": args.warm_prefetch_on_boot,
             "self_url": f"http://127.0.0.1:{args.port}",
         },
     )

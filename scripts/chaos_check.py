@@ -711,11 +711,378 @@ def run_directory_restart(
         stop_proc(cache)
 
 
+def run_scale_cycle(
+    base_engines: int = 2,
+    peak_engines: int = 4,
+    workers: int = 4,
+    max_tokens: int = 30,
+    speed: float = 25.0,
+    phase_s: float = 3.0,
+    return_window: float = 12.0,
+    warm_prefetch: int = 8,
+    drain_deadline: float = 20.0,
+    ttft_p99_bound_s: float = 8.0,
+) -> dict:
+    """Scale-cycle scenario (ISSUE 10): 2 -> 4 -> 2 engines under sustained
+    streaming load, driven by the fleet controller (docs/migration.md).
+
+    A directory-hosting cache server, ``peak_engines`` router-known
+    addresses (standby-pod model: the router health-checks all four and
+    only routes to live ones), and ``base_engines`` fake engines with
+    ``--migration`` publishing to the directory. Under continuous streaming
+    load:
+
+    - the fleet controller runs its rebalance loop throughout;
+    - scale-UP starts the remaining engines with
+      ``--warm-prefetch-on-boot`` (they pull the fleet's top warm chunks
+      before serving — the directory-driven warm-up);
+    - scale-DOWN evacuates each victim with live migration
+      (``FleetController.evacuate``) DURING its SIGTERM drain, so every
+      in-flight stream moves to a survivor and the process exits clean.
+
+    Caller-asserted: zero non-429 client errors, zero dropped streams
+    (every started SSE stream reaches [DONE] with the full token count —
+    spliced streams included), bounded TTFT p99, every drained engine
+    evacuated everything before exit, and the scaled-up engines pulled
+    fleet-warm chunks and served warm prefix hits."""
+    import asyncio
+    import signal as signal_mod
+    import time
+
+    from production_stack_tpu.migration.controller import (
+        ControllerPolicy,
+        FleetController,
+    )
+
+    cache_port = free_port()
+    cache = start_proc([
+        "-m", "production_stack_tpu.kvoffload.cache_server",
+        "--port", str(cache_port), "--host", "127.0.0.1", "--directory",
+        "--directory-engine-timeout", "5",
+    ])
+    dir_url = f"127.0.0.1:{cache_port}"
+    ports = [free_port() for _ in range(peak_engines)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+
+    def start_fake(port: int, extra: list) -> "object":
+        proc = start_proc([
+            "-m", "production_stack_tpu.testing.fake_engine",
+            "--port", str(port), "--model", "fake/model",
+            "--speed", str(speed), "--kv-directory-url", dir_url,
+            "--migration",
+        ] + extra)
+        # drain stdout: sustained load + a full 64 KB pipe wedges the
+        # process's event loop (PR 5 lesson)
+        threading.Thread(
+            target=lambda: proc.stdout.read() if proc.stdout else None,
+            daemon=True,
+        ).start()
+        return proc
+
+    fakes: dict = {}
+    for p_, u in zip(ports[:base_engines], urls[:base_engines]):
+        fakes[u] = start_fake(p_, [])
+    router = None
+    stop_load = threading.Event()
+    lock = threading.Lock()
+    statuses: collections.Counter = collections.Counter()
+    errors: list = []
+    dropped_streams: list = []
+    ttfts: list = []
+
+    # shared controller (rebalance loop runs in its own thread/event loop;
+    # evacuations reuse the same decider so decision counts accumulate)
+    policy = ControllerPolicy(
+        rebalance_high_delta=0.25, rebalance_low_delta=0.1,
+        cooldown_s=1.0, max_concurrent_migrations=2, rebalance_k=1,
+        saturation_queue_ref=4,
+    )
+    ctrl_box: dict = {}
+    ctrl_stop = threading.Event()
+
+    def controller_thread():
+        async def runner():
+            ctrl = FleetController(
+                engine_urls=urls, router_url=None, policy=policy,
+                tick_interval_s=0.5,
+            )
+            ctrl_box["ctrl"] = ctrl
+            try:
+                while not ctrl_stop.is_set():
+                    try:
+                        await ctrl.tick()
+                    except Exception:  # noqa: BLE001 - keep looping
+                        pass
+                    await asyncio.sleep(0.5)
+            finally:
+                await ctrl.close()
+
+        asyncio.run(runner())
+
+    def scrape(url: str) -> dict:
+        try:
+            text = requests.get(f"{url}/metrics", timeout=5).text
+        except requests.RequestException:
+            return {}
+        out = {}
+        for m in re.finditer(
+            r"^((?:vllm|vllm_router|fake):[a-z0-9_]+)(?:\{[^}]*\})? "
+            r"([0-9.eE+-]+)$", text, re.M,
+        ):
+            out[m.group(1)] = out.get(m.group(1), 0.0) + float(m.group(2))
+        return out
+
+    try:
+        router_port = free_port()
+        router = start_proc([
+            "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            # standby-pod model: the router knows every address; health
+            # checks pull dead ones from rotation and admit them on boot
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["fake/model"] * len(urls)),
+            "--engine-stats-interval", "1",
+            "--retry-max-attempts", "4",
+            "--retry-backoff-base", "0.01",
+            "--breaker-failure-threshold", "3",
+            "--breaker-cooldown", "1.0",
+            "--static-backend-health-checks",
+            "--health-check-interval", "0.3",
+        ])
+        base = f"http://127.0.0.1:{router_port}"
+        for u in list(fakes):
+            wait_healthy(f"{u}/health", fakes[u], timeout=30)
+        wait_healthy(f"{base}/health", router, timeout=30)
+        threading.Thread(
+            target=lambda: router.stdout.read() if router.stdout else None,
+            daemon=True,
+        ).start()
+        # the router health-checks ALL peak addresses (two are intentionally
+        # dark standbys): wait until the live backends passed their first
+        # probe, or the first load requests race an empty healthy set
+        t0 = time.time()
+        while time.time() - t0 < 20:
+            try:
+                r = requests.post(
+                    f"{base}/v1/completions",
+                    json={"model": "fake/model", "prompt": "probe",
+                          "max_tokens": 1},
+                    timeout=10,
+                )
+                if r.status_code == 200:
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.2)
+        # shared session prefixes: publishes give the directory warm chains
+        # the scaled-up engines prefetch
+        prompts = [
+            f"session-{i:02d}-" + (chr(ord("a") + i) * 120) for i in range(4)
+        ]
+
+        def load_worker(wid: int):
+            sess = requests.Session()
+            i = 0
+            while not stop_load.is_set():
+                i += 1
+                prompt = prompts[(wid + i) % len(prompts)] + f"::{wid}-{i}"
+                t0 = time.monotonic()
+                try:
+                    r = sess.post(
+                        f"{base}/v1/completions",
+                        json={"model": "fake/model", "prompt": prompt,
+                              "max_tokens": max_tokens, "stream": True},
+                        stream=True, timeout=60,
+                    )
+                    with lock:
+                        statuses[r.status_code] += 1
+                    if r.status_code == 200:
+                        first = None
+                        content = 0
+                        saw_done = saw_error = False
+                        for line in r.iter_lines():
+                            if not line.startswith(b"data: "):
+                                continue
+                            if first is None:
+                                first = time.monotonic() - t0
+                            if b"[DONE]" in line:
+                                saw_done = True
+                            elif b'"error"' in line and b'"choices"' not in line:
+                                saw_error = True
+                            elif b'"text"' in line:
+                                content += 1
+                        with lock:
+                            if first is not None:
+                                ttfts.append(first)
+                            if saw_error:
+                                errors.append(("sse_error", prompt[:40]))
+                            elif not saw_done or content != max_tokens:
+                                dropped_streams.append(
+                                    (prompt[:40], content, saw_done)
+                                )
+                    elif r.status_code != 429:
+                        with lock:
+                            errors.append((r.status_code, r.text[:200]))
+                except requests.RequestException as e:
+                    with lock:
+                        errors.append(("exception", repr(e)))
+                time.sleep(0.05)
+
+        threads = [
+            threading.Thread(target=load_worker, args=(w,))
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        ctrl_thread = threading.Thread(target=controller_thread, daemon=True)
+        ctrl_thread.start()
+        time.sleep(phase_s)  # phase 1: 2 engines under load
+
+        # -- scale UP: 2 -> 4, new engines warm-prefetch before serving ----
+        scale_up = []
+        for p_, u in zip(
+            ports[base_engines:peak_engines], urls[base_engines:peak_engines]
+        ):
+            fakes[u] = start_fake(
+                p_, ["--warm-prefetch-on-boot", str(warm_prefetch)]
+            )
+        for u in urls[base_engines:peak_engines]:
+            wait_healthy(f"{u}/health", fakes[u], timeout=30)
+        # traffic must reach each scaled-up engine, and its first servings
+        # must hit the prefetched fleet-warm set
+        for u in urls[base_engines:peak_engines]:
+            t0 = time.time()
+            served = 0.0
+            while time.time() - t0 < return_window:
+                m = scrape(u)
+                served = m.get("fake:served_total", 0)
+                if served > 0 and m.get("fake:warm_prefix_hits_total", 0) > 0:
+                    break
+                time.sleep(0.2)
+            m = scrape(u)
+            scale_up.append({
+                "url": u,
+                "served": m.get("fake:served_total", 0),
+                "warm_prefetch_chunks": m.get("fake:warm_prefetch_chunks", 0),
+                "warm_prefix_hits": m.get("fake:warm_prefix_hits_total", 0),
+                "took_s": round(time.time() - t0, 2),
+            })
+        time.sleep(phase_s)  # phase 2: 4 engines steady state
+
+        # -- scale DOWN: 4 -> 2, evacuate each victim during its drain -----
+        drains = []
+        for u in urls[base_engines:peak_engines]:
+            victim_metrics: dict = {}
+            stop_scrape = threading.Event()
+
+            def victim_scraper(vu=u, box=victim_metrics, ev=stop_scrape):
+                while not ev.is_set():
+                    m = scrape(vu)
+                    if m:
+                        box.update(m)
+                    time.sleep(0.15)
+
+            scr = threading.Thread(target=victim_scraper, daemon=True)
+            scr.start()
+            # SIGTERM first (drain: health 503 pulls it from routing, new
+            # requests refused, in-flight streams keep running), THEN
+            # evacuate the in-flight streams onto the survivors
+            fakes[u].send_signal(signal_mod.SIGTERM)
+            survivors = [x for x in urls if x != u and x in fakes]
+            report = asyncio.run(
+                _evacuate_once(
+                    survivors + [u], u, policy, drain_deadline
+                )
+            )
+            rc = fakes[u].wait(timeout=30)
+            stop_scrape.set()
+            scr.join(timeout=5)
+            fakes.pop(u)
+            report.update({
+                "exit_rc": rc,
+                "victim_migrations_out": victim_metrics.get(
+                    "fake:migrations_out_total", 0
+                ),
+                "victim_last_running": victim_metrics.get(
+                    "vllm:num_requests_running", -1
+                ),
+            })
+            drains.append(report)
+            time.sleep(0.5)
+
+        time.sleep(1.0)
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=60)
+        ctrl_stop.set()
+        ctrl_thread.join(timeout=10)
+
+        router_m = scrape(base)
+        fleet = {u: scrape(u) for u in fakes}
+        # out-count = confirmed migrate_out ships: the evacuation reports'
+        # moved counts (a victim's own counter can be unreadable in the
+        # instant between its last stream leaving and the process exiting)
+        # plus the surviving fleet's rebalance-driven outs
+        migrations_out = sum(
+            m.get("fake:migrations_out_total", 0) for m in fleet.values()
+        ) + sum(d["moved"] for d in drains)
+        migrations_in = sum(
+            m.get("fake:migrations_in_total", 0) for m in fleet.values()
+        )
+        s_t = sorted(ttfts)
+        ttft_p99 = (
+            s_t[min(len(s_t) - 1, int(len(s_t) * 0.99))] if s_t else None
+        )
+        ctrl = ctrl_box.get("ctrl")
+        return {
+            "statuses": dict(statuses),
+            "non_429_errors": len(errors),
+            "errors": errors[:10],
+            "dropped_streams": len(dropped_streams),
+            "dropped_examples": dropped_streams[:5],
+            "ttft_p99_s": ttft_p99,
+            "ttft_p99_bound_s": ttft_p99_bound_s,
+            "scale_up": scale_up,
+            "drains": drains,
+            "migrations_out_total": migrations_out,
+            "migrations_in_total": migrations_in,
+            "session_repins_total": router_m.get(
+                "vllm_router:session_repins_total", 0
+            ),
+            "splice_failures_total": router_m.get(
+                "vllm_router:migration_splice_failures_total", 0
+            ),
+            "controller_decisions": (
+                dict(ctrl.decider.decisions_total) if ctrl else {}
+            ),
+        }
+    finally:
+        stop_load.set()
+        ctrl_stop.set()
+        for p_ in fakes.values():
+            stop_proc(p_)
+        if router is not None:
+            stop_proc(router)
+        stop_proc(cache)
+
+
+async def _evacuate_once(engine_urls, victim, policy, deadline_s):
+    """One-shot evacuation helper (its own event loop; the controller is a
+    pure HTTP client so a fresh instance is fine)."""
+    from production_stack_tpu.migration.controller import FleetController
+
+    ctrl = FleetController(engine_urls=engine_urls, policy=policy)
+    try:
+        return await ctrl.evacuate(victim, deadline_s=deadline_s)
+    finally:
+        await ctrl.close()
+
+
 def main() -> int:
     p = argparse.ArgumentParser("chaos-check")
     p.add_argument("--scenario",
                    choices=["chaos", "overload", "rolling-restart",
-                            "directory-restart"],
+                            "directory-restart", "scale-cycle"],
                    default="chaos")
     p.add_argument("--num-requests", type=int, default=None)
     p.add_argument("--retry-budget", type=int, default=3)
@@ -723,6 +1090,59 @@ def main() -> int:
     p.add_argument("--breaker-threshold", type=int, default=3)
     args = p.parse_args()
     from production_stack_tpu.router.resilience import OPEN
+
+    if args.scenario == "scale-cycle":
+        s = run_scale_cycle()
+        print(json.dumps(s, indent=2))
+        failures = []
+        if s["non_429_errors"]:
+            failures.append(
+                f"{s['non_429_errors']} non-429 client errors: {s['errors']}"
+            )
+        if s["dropped_streams"]:
+            failures.append(
+                f"{s['dropped_streams']} dropped mid-flight streams: "
+                f"{s['dropped_examples']}"
+            )
+        if s["ttft_p99_s"] is None or s["ttft_p99_s"] > s["ttft_p99_bound_s"]:
+            failures.append(
+                f"TTFT p99 {s['ttft_p99_s']} above bound "
+                f"{s['ttft_p99_bound_s']}s"
+            )
+        if s["migrations_out_total"] < 1:
+            failures.append("no live migration happened during the cycle")
+        if s["migrations_in_total"] < sum(d["moved"] for d in s["drains"]):
+            failures.append(
+                f"migrations in {s['migrations_in_total']} < evacuated "
+                f"{sum(d['moved'] for d in s['drains'])}"
+            )
+        if s["session_repins_total"] < 1:
+            failures.append("router never spliced a migrated stream")
+        if s["splice_failures_total"]:
+            failures.append(
+                f"{s['splice_failures_total']} migration splices failed"
+            )
+        for d in s["drains"]:
+            if d["exit_rc"] != 0:
+                failures.append(f"victim {d['victim']} exited rc={d['exit_rc']}")
+            if d["residual_running"] or d["residual_migratable"]:
+                failures.append(
+                    f"victim {d['victim']} exited with work left: {d}"
+                )
+        for up in s["scale_up"]:
+            if up["warm_prefetch_chunks"] <= 0 or up["warm_prefix_hits"] <= 0:
+                failures.append(
+                    f"scaled-up {up['url']} never warmed: {up}"
+                )
+            if up["served"] <= 0:
+                failures.append(
+                    f"scaled-up {up['url']} never took traffic: {up}"
+                )
+        if failures:
+            print("SCALE-CYCLE CHECK FAILED: " + "; ".join(failures))
+            return 1
+        print("SCALE-CYCLE CHECK PASSED")
+        return 0
 
     if args.scenario == "directory-restart":
         s = run_directory_restart()

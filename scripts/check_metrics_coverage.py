@@ -134,6 +134,16 @@ DASHBOARD_ALLOWLIST = {
     "fake:served_total",
     "fake:completed_total",
     "fake:abort_requests_total",
+    "fake:migrations_out_total",
+    "fake:migrations_in_total",
+    "fake:warm_prefetch_chunks",
+    "fake:warm_prefix_hits_total",
+    # fleet-controller diagnostics: the dashboard charts decisions-by-kind
+    # and the saturation signal; started/failed/inflight are the drill-down
+    # behind a decisions anomaly, charted on demand
+    "vllm:fleet_controller_migrations_started_total",
+    "vllm:fleet_controller_migrations_failed_total",
+    "vllm:fleet_controller_migrations_inflight",
 }
 
 

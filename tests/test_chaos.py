@@ -164,6 +164,40 @@ def test_directory_restart_expires_stale_claims_with_zero_routing_errors():
     assert s["republished_chunks"] > 0, s
 
 
+def test_scale_cycle_zero_loss_with_migration_and_warm_prefetch():
+    """Acceptance (live migration + fleet control, ISSUE 10): 2 -> 4 -> 2
+    engines under sustained streaming load. Zero non-429 client errors,
+    zero dropped mid-flight streams (every started SSE stream reaches
+    [DONE] with its full token count — live-migrated, router-spliced
+    streams included), bounded TTFT p99, every drained engine evacuates all
+    in-flight sequences before a clean exit, and each scaled-up engine
+    pulls fleet-warm chunks via directory prefetch and serves warm prefix
+    hits from its first requests."""
+    s = chaos_check.run_scale_cycle()
+    assert s["non_429_errors"] == 0, s["errors"]
+    assert s["statuses"].get(200, 0) > 0, s["statuses"]
+    assert s["dropped_streams"] == 0, s["dropped_examples"]
+    assert s["ttft_p99_s"] is not None
+    assert s["ttft_p99_s"] <= s["ttft_p99_bound_s"], s["ttft_p99_s"]
+    # zero-loss scale-down: both victims evacuated everything and exited 0
+    assert len(s["drains"]) == 2
+    for d in s["drains"]:
+        assert d["exit_rc"] == 0, d
+        assert d["residual_running"] == 0 and d["residual_migratable"] == 0, d
+    # live migration actually carried streams across the cycle, and the
+    # router spliced every handoff without a failure
+    assert s["migrations_out_total"] >= 1, s
+    assert s["migrations_in_total"] >= sum(d["moved"] for d in s["drains"]), s
+    assert s["session_repins_total"] >= 1, s
+    assert s["splice_failures_total"] == 0, s
+    # directory-driven scale-up warm-up: prefetch + first-request warm hits
+    assert len(s["scale_up"]) == 2
+    for up in s["scale_up"]:
+        assert up["served"] > 0, up
+        assert up["warm_prefetch_chunks"] > 0, up
+        assert up["warm_prefix_hits"] > 0, up
+
+
 def test_inter_chunk_stall_aborts_engine_and_sends_sse_error():
     """Acceptance: a stream stalled past the inter-chunk timeout is aborted
     on the engine (scheduler slot freed, verified via /metrics running-count)

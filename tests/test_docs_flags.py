@@ -28,6 +28,7 @@ def _known_flags() -> set:
                 ("scripts", "chaos_check.py"),
                 ("scripts", "trace_report.py"),
                 ("scripts", "kv_directory_report.py"),
+                ("scripts", "fleet_controller.py"),
                 ("scripts", "graftcheck", "__main__.py")):
         src = REPO.joinpath(*rel).read_text()
         flags.update(re.findall(r'add_argument\(\s*"(--[a-z0-9-]+)"', src))
