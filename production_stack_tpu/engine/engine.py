@@ -375,7 +375,7 @@ class LLMEngine:
         # sequences are OUT of the running set but keep their pages while
         # the target decides; device-thread-owned by construction (freeze/
         # commit/rollback/abort all run as device commands), so no lock
-        self._frozen: dict[str, Sequence] = {}
+        self._frozen: dict[str, Sequence] = {}  # owned-by: device-thread
         self.migration = None
         if cfg.migration:
             from production_stack_tpu.migration import MigrationManager

@@ -95,7 +95,9 @@ class KVPageManager:
         # usage fraction past which proactive spill engages (0 or >=1 disable)
         self.spill_watermark = spill_watermark
         self.pages = [PageInfo() for _ in range(num_pages)]
-        self.free_list: list[int] = list(range(num_pages - 1, -1, -1))
+        self.free_list: list[int] = list(  # owned-by: device-thread
+            range(num_pages - 1, -1, -1)
+        )
         self.hash_to_page: dict[bytes, int] = {}
         # pages with ref_count==0 but still holding reusable KV. Victim
         # selection goes through a lazy min-heap keyed by reuse score; the

@@ -74,8 +74,8 @@ class KVDirectory:
         # co-hosted cache server passes `key in store`: restorable answers
         # then reflect the blobs that actually exist, not stale claims
         self.blob_check = blob_check
-        self.engines: dict[str, EngineRecord] = {}
-        self.chunks: dict[str, dict[str, DirEntry]] = {}
+        self.engines: dict[str, EngineRecord] = {}  # owned-by: event-loop
+        self.chunks: dict[str, dict[str, DirEntry]] = {}  # owned-by: event-loop
         # exported as vllm:kv_directory_* on the cache server metrics surface
         self.publishes_total = 0
         self.withdrawals_total = 0

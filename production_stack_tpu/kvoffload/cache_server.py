@@ -44,7 +44,7 @@ logger = init_logger(__name__)
 class CacheServer:
     def __init__(self, max_bytes: int = 4 << 30, directory=None):
         self.max_bytes = max_bytes
-        self._data: OrderedDict[str, bytes] = OrderedDict()
+        self._data: OrderedDict[str, bytes] = OrderedDict()  # owned-by: event-loop
         self.used_bytes = 0
         self.gets = 0
         self.hits = 0

@@ -189,6 +189,7 @@ class KVIndexController:
                 elif op == "lookup":
                     res = self.lookup(hdr["tokens"], hdr.get("page_size"))
                     await write_frame(writer, {"ok": True, **res})
+                # graftcheck: disable=GC009 — reference-parity op (the upstream controller's QueryInstMsg); kept wire-compatible for external clients, no first-party caller by design
                 elif op == "query_inst":
                     # reference parity: QueryInstMsg(ip) -> instance url
                     st = self.instances.get(hdr["instance_id"])

@@ -64,7 +64,8 @@ class MigrationManager:
             "vllm:migration_duration_seconds", LATENCY_BUCKETS,
             "Source-side migration duration (freeze to commit)",
         )
-        self._freeze_started: dict[str, float] = {}  # seq_id -> monotonic
+        # seq_id -> monotonic freeze time
+        self._freeze_started: dict[str, float] = {}  # owned-by: device-thread
 
     # -- source side ---------------------------------------------------------
 

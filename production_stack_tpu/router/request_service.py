@@ -220,7 +220,7 @@ async def abort_on_engine(backend_url: str, request_id: str) -> None:
 
 # strong refs for fire-and-forget abort tasks (a bare create_task could be
 # garbage-collected mid-flight); drained on close_client_session
-_abort_tasks: set = set()
+_abort_tasks: set = set()  # owned-by: event-loop
 
 
 def spawn_abort(backend_url: str, request_id: str) -> "asyncio.Task":

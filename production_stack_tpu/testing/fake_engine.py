@@ -119,7 +119,9 @@ from production_stack_tpu.tracing import (
     render_phase_histograms,
 )
 
-STATE = {
+# the fake is a pure-asyncio process: every handler, fault timer, and
+# publisher task mutates this on the loop (GC007 guards the convention)
+STATE = {  # owned-by: event-loop
     "running": 0,
     "running_peak": 0,      # high-watermark of concurrent in-flight requests
     "total": 0,

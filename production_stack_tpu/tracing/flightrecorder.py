@@ -69,8 +69,8 @@ class FlightRecorder:
         self.capacity = max(16, int(capacity))
         self.enabled = bool(enabled)
         self.dump_dir = dump_dir
-        self._slots: list = [None] * self.capacity
-        self._cursor = itertools.count()
+        self._slots: list = [None] * self.capacity  # owned-by: any
+        self._cursor = itertools.count()  # owned-by: any
         self._last_dump: dict[str, float] = {}  # guarded-by: _dump_lock
         self._dump_lock = threading.Lock()
         self.dumps_total = 0

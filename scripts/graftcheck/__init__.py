@@ -1,7 +1,7 @@
 """graftcheck — repo-native static analysis for the hazard classes this
 stack has actually shipped bugs in (docs/static-analysis.md).
 
-Four AST checkers plus an endpoint-contract guard, sharing one parsed view
+Nine AST checkers plus an endpoint-contract guard, sharing one parsed view
 of the tree (core.RepoIndex — same single-scan shape as
 scripts/check_metrics_coverage.py):
 
@@ -27,6 +27,25 @@ scripts/check_metrics_coverage.py):
   exist on BOTH the real engine (api_server.py) and the fake engine
   (testing/fake_engine.py) — fake/real drift otherwise only surfaces as
   flaky e2e failures.
+- GC006 asyncio task lifetime: every ``create_task``/``ensure_future``
+  result must be retained (attribute, collection, awaited, or passed on) —
+  the loop's weak refs let GC silently kill fire-and-forget tasks (the PR 9
+  directory-persistence and fake-publish bugs).
+- GC007 thread-ownership discipline: state annotated ``# owned-by:
+  event-loop|device-thread|any`` may only be touched from its owning
+  context; contexts are inferred from ``async def``, ``threading.Thread``
+  targets, and executor/``to_thread``/``_run_on_device_thread`` submissions
+  (mechanizes PR 10's hand-verified ``_frozen`` reasoning).
+- GC008 off-context iteration/serialization: a loop-owned container handed
+  into (or iterated/``json.dumps``-ed inside) worker-submitted code dies
+  with 'dict changed size' under load — the PR 9 snapshot crash.
+- GC009 wire-contract parity v2: cache-server frame ops vs client senders
+  (both directions), the migration SSE control event's type + payload keys
+  between engine/fake producers and the router splice, and
+  snapshot/presentation-meta key sets — extracted from both sides, diffed.
+- GC010 metric discipline: counter/gauge TYPE consistency and naming
+  (``*_total``), no decremented counters, no inc-only gauges, metric
+  objects constructed once, label keysets literal and consistent.
 
 Suppression: ``# graftcheck: disable=GCnnn — <reason>`` on the finding's
 line (or a standalone comment on the line above). The reason is mandatory,

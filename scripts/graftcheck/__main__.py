@@ -1,9 +1,21 @@
-"""CLI: ``python -m scripts.graftcheck [--rule GCnnn] [--all-findings]``.
+"""CLI: ``python -m scripts.graftcheck [--rule GCnnn] [--changed]
+[--format sarif] [--output FILE] [--all-findings]``.
 
 Exit 0 when the tree has zero unsuppressed, un-baselined findings (the
 tier-1 contract tests/test_graftcheck.py enforces); exit 1 with a report
 otherwise. Pure ast — no JAX import — so it runs as a fast standalone CI
 step next to check_metrics_coverage.py.
+
+``--changed`` is the pre-commit mode: findings are filtered to files the
+git working tree/index touches (contract rules GC005/GC009/GC010 always
+report in full — a drift can sit on the unchanged side of a diff), and an
+empty change set passes without scanning. Falls back to the full tree when
+git or the repository index is unavailable. The FULL run stays the CI and
+tier-1 gate.
+
+``--format sarif`` renders SARIF 2.1.0 for GitHub code-scanning upload
+(ci.yml), so findings become inline PR annotations; ``--output`` writes it
+to a file while the human-readable report still goes to stdout.
 """
 
 from __future__ import annotations
@@ -11,16 +23,43 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .core import RepoIndex, load_baseline, run_graftcheck
+from .core import (
+    changed_paths,
+    filter_changed,
+    load_baseline,
+    run_graftcheck,
+    RepoIndex,
+)
+
+
+def _all_checkers() -> dict:
+    from .core import _checkers
+
+    return {c.RULE: c for c in _checkers()}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         "graftcheck", description="repo-native static analysis "
         "(GC001 event-loop blocking, GC002 donation/aliasing, GC003 "
-        "tracer hygiene, GC004 lock discipline, GC005 endpoint parity)")
+        "tracer hygiene, GC004 lock discipline, GC005 endpoint parity, "
+        "GC006 task lifetime, GC007 thread ownership, GC008 off-loop "
+        "serialization, GC009 wire-contract parity, GC010 metric "
+        "discipline)")
     ap.add_argument("--rule", action="append", default=None,
                     help="run only these rule ids (repeatable), e.g. GC001")
+    ap.add_argument("--changed", action="store_true",
+                    help="pre-commit mode: report only findings on files "
+                    "the git working tree/index touches (contract rules "
+                    "GC005/GC009/GC010 still report in full); falls back "
+                    "to the full tree when git is unavailable")
+    ap.add_argument("--format", choices=("text", "sarif"), default="text",
+                    dest="fmt",
+                    help="report format; 'sarif' emits SARIF 2.1.0 for "
+                    "GitHub code-scanning upload (PR annotations)")
+    ap.add_argument("--output", default=None,
+                    help="write the --format report to this file (the "
+                    "human-readable summary still prints to stdout)")
     ap.add_argument("--all-findings", action="store_true",
                     help="also print findings silenced by suppressions/"
                     "baseline (audit view)")
@@ -28,13 +67,7 @@ def main(argv=None) -> int:
 
     checkers = None
     if args.rule:
-        from . import (gc001_eventloop, gc002_donation, gc003_tracer,
-                       gc004_locks, gc005_endpoints)
-
-        all_checkers = {c.RULE: c for c in (
-            gc001_eventloop, gc002_donation, gc003_tracer, gc004_locks,
-            gc005_endpoints,
-        )}
+        all_checkers = _all_checkers()
         unknown = [r for r in args.rule if r not in all_checkers]
         if unknown:
             print(f"unknown rule(s): {', '.join(unknown)}")
@@ -53,12 +86,43 @@ def main(argv=None) -> int:
         print(f"\n{len(raw)} raw finding(s) before suppression/baseline")
         return 0
 
+    changed = None
+    if args.changed:
+        changed = changed_paths()
+        if changed is not None and not changed:
+            print("graftcheck: --changed: clean working tree, nothing to check")
+            print("GRAFTCHECK PASSED")
+            return 0
+        if changed is None:
+            print("graftcheck: --changed: git index unavailable, "
+                  "falling back to the full tree")
+
     violations, stats = run_graftcheck(
         checkers=checkers, baseline=load_baseline(),
     )
+    if changed is not None:
+        full = len(violations)
+        violations = filter_changed(violations, changed)
+        stats["changed_files"] = len(changed)
+        stats["filtered_out"] = full - len(violations)
+
+    if args.fmt == "sarif":
+        from .sarif import render_sarif
+
+        sarif = render_sarif(violations, stats)
+        if args.output:
+            with open(args.output, "w", encoding="utf-8") as fh:
+                fh.write(sarif)
+        else:
+            sys.stdout.write(sarif)
+            return 1 if violations else 0
+
     print(
         f"graftcheck: {stats['files']} files, {stats['raw_findings']} raw, "
         f"{stats['suppressed']} suppressed, {stats['baselined']} baselined"
+        + (f", changed-only view over {stats['changed_files']} changed "
+           f"file(s) ({stats['filtered_out']} finding(s) elsewhere hidden)"
+           if changed is not None else "")
     )
     if violations:
         print("GRAFTCHECK FAILED:")

@@ -204,6 +204,59 @@ def iter_nodes_skipping_nested_defs(body: Iterable[ast.stmt]):
         stack.extend(ast.iter_child_nodes(node))
 
 
+# -- incremental (--changed) support -------------------------------------------
+
+# contract checkers diff whole surfaces against each other; a one-file diff
+# filter would hide the far side of a drift, so their findings always
+# survive --changed filtering (they are cheap — pure extraction + set diff)
+CONTRACT_RULES = ("GC005", "GC009", "GC010")
+
+
+def changed_paths(repo: pathlib.Path = REPO) -> "Optional[set[str]]":
+    """Repo-relative posix paths touched in the working tree + index
+    (staged, unstaged, untracked), from ``git status --porcelain``. Returns
+    None when git (or the repository index) is unavailable — callers fall
+    back to the full tree."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", str(repo), "status", "--porcelain",
+             "--untracked-files=all"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    paths: set[str] = set()
+    for line in out.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:]
+        if " -> " in path:  # rename: old -> new; the NEW path is the live one
+            path = path.split(" -> ", 1)[1]
+        paths.add(path.strip().strip('"'))
+    return paths
+
+
+def filter_changed(violations: "list[Finding]",
+                   changed: "set[str]") -> "list[Finding]":
+    """Pre-commit view: keep findings on changed files, every contract-rule
+    finding (the drift may sit on the unchanged side), and baseline-rot
+    findings only when baseline.json itself changed."""
+    out = []
+    for f in violations:
+        if f.rule in CONTRACT_RULES:
+            out.append(f)
+        elif f.rule == "GC-BASELINE":
+            if f.path in changed:
+                out.append(f)
+        elif f.path in changed:
+            out.append(f)
+    return out
+
+
 # -- runner --------------------------------------------------------------------
 
 BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "baseline.json"
@@ -217,10 +270,12 @@ def load_baseline(path: pathlib.Path = BASELINE_PATH) -> list[dict]:
 
 def _checkers() -> list:
     from . import gc001_eventloop, gc002_donation, gc003_tracer, gc004_locks
-    from . import gc005_endpoints
+    from . import gc005_endpoints, gc006_tasks, gc007_ownership
+    from . import gc008_offloop, gc009_wire, gc010_metrics
 
     return [gc001_eventloop, gc002_donation, gc003_tracer, gc004_locks,
-            gc005_endpoints]
+            gc005_endpoints, gc006_tasks, gc007_ownership, gc008_offloop,
+            gc009_wire, gc010_metrics]
 
 
 def run_graftcheck(

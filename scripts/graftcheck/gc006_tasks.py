@@ -1,0 +1,236 @@
+"""GC006 — asyncio task lifetime.
+
+The event loop holds only WEAK references to its tasks: a task whose result
+is dropped on the floor can be garbage-collected mid-flight and simply
+stops running, with no exception and no log line. PR 9 shipped this bug
+TWICE in one review cycle — the cache server's directory-persistence loop
+silently stopped snapshotting, and the fake engine's directory publishes
+were GC'd while parked on the publisher lock (flaky chaos assertions).
+Both fixes were one line: keep a strong reference.
+
+Every ``create_task`` / ``ensure_future`` result must therefore be
+RETAINED. Retention, in this repo's idioms:
+
+- assigned to an attribute (``self._task = loop.create_task(...)``,
+  ``cs._persist_task = ...``) or a subscript;
+- passed as an argument to a call (``self._bg.append(create_task(...))``,
+  ``tasks.add(t)``, ``asyncio.gather(create_task(...), ...)``);
+- awaited or returned/yielded;
+- placed in a container literal (incl. list/set comprehensions whose
+  result is itself a tracked local);
+- a local that is later awaited, passed as a call argument, stored, or
+  used at all — EXCEPT when its only use is ``add_done_callback`` (the
+  exact shipped trap: ``t.add_done_callback(tasks.discard)`` without a
+  matching ``tasks.add(t)`` retains nothing).
+
+``tg.create_task(...)`` on a TaskGroup-ish receiver (``tg``,
+``task_group``, ``group``, ``nursery``) is exempt — the group owns its
+tasks.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Finding, RepoIndex, dotted_name
+
+RULE = "GC006"
+
+_SPAWN_NAMES = ("create_task", "ensure_future")
+_GROUP_RECEIVERS = {"tg", "task_group", "taskgroup", "group", "nursery"}
+# receiver-method uses of a task local that do NOT keep it alive
+_NON_RETAINING_METHODS = {"add_done_callback"}
+
+
+def _spawn_call(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node when ``node`` is a create_task/ensure_future call."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    if name not in _SPAWN_NAMES:
+        return None
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        if fn.value.id in _GROUP_RECEIVERS:
+            return None  # TaskGroup owns its tasks
+    return node
+
+
+def _coro_detail(call: ast.Call) -> str:
+    """Stable identity for the finding key: the spawned coroutine's name."""
+    if call.args:
+        arg = call.args[0]
+        if isinstance(arg, ast.Call):
+            name = dotted_name(arg.func)
+            if name:
+                return name.split(".")[-1]
+            if isinstance(arg.func, ast.Attribute):
+                return arg.func.attr
+        name = dotted_name(arg)
+        if name:
+            return name.split(".")[-1]
+    return "task"
+
+
+class _FnScanner:
+    """Retention analysis for one function body (nested defs excluded —
+    they are scanned as their own functions)."""
+
+    def __init__(self, fn: ast.AST):
+        self.body = fn.body
+        self.parents: dict[int, ast.AST] = {}
+        self.nodes: list[ast.AST] = []
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            self.nodes.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+                stack.append(child)
+
+    def spawns(self):
+        for node in self.nodes:
+            call = _spawn_call(node)
+            if call is not None:
+                yield call
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))
+
+    # -- retention of the call expression itself --------------------------
+
+    def call_retained(self, call: ast.Call) -> "tuple[bool, Optional[str]]":
+        """(retained, local_name). ``local_name`` set when the value lands
+        in a bare local that needs liveness analysis."""
+        node: ast.AST = call
+        while True:
+            parent = self.parent(node)
+            if parent is None:
+                return False, None
+            if isinstance(parent, ast.Expr):
+                return False, None  # bare statement: fire-and-forget
+            if isinstance(parent, ast.Await):
+                return True, None
+            if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return True, None
+            if isinstance(parent, ast.Call) and node is not parent.func:
+                return True, None  # argument of append/add/gather/...
+            if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (parent.targets if isinstance(parent, ast.Assign)
+                           else [parent.target])
+                locals_only = [t for t in targets if isinstance(t, ast.Name)]
+                if len(locals_only) == len(targets) and locals_only:
+                    return False, locals_only[0].id  # needs liveness
+                return True, None  # attribute / subscript store
+            if isinstance(parent, ast.NamedExpr):
+                if isinstance(parent.target, ast.Name):
+                    return False, parent.target.id
+                return True, None
+            if isinstance(parent, (ast.List, ast.Tuple, ast.Set, ast.Dict,
+                                   ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp, ast.Starred, ast.IfExp,
+                                   ast.BoolOp)):
+                node = parent  # the container/expr carries the task onward
+                continue
+            return True, None  # conservatively quiet on exotic positions
+
+    # -- liveness of a task-holding local ---------------------------------
+
+    def _loop_ancestors(self, node: ast.AST) -> "set[int]":
+        out: set[int] = set()
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                out.add(id(cur))
+            cur = self.parent(cur)
+        return out
+
+    def local_retained(self, name: str, spawn: ast.Call) -> bool:
+        """A load of ``name`` retains the task only if it can execute AFTER
+        the spawn: textually later, or inside a loop that also contains the
+        spawn (next iteration re-reads it). A load that can only see the
+        PREVIOUS task bound to the name — the respawn idiom
+        ``t.cancel(); t = create_task(...)`` — retains nothing."""
+        spawn_pos = (spawn.lineno, spawn.col_offset)
+        spawn_loops = self._loop_ancestors(spawn)
+        for node in self.nodes:
+            if not isinstance(node, ast.Name) or node.id != name:
+                continue
+            if not isinstance(node.ctx, ast.Load):
+                continue
+            if ((node.lineno, node.col_offset) < spawn_pos
+                    and not (spawn_loops & self._loop_ancestors(node))):
+                continue  # pre-spawn load: it saw the OLD binding
+            parent = self.parent(node)
+            if (isinstance(parent, ast.Attribute)
+                    and parent.attr in _NON_RETAINING_METHODS):
+                continue  # t.add_done_callback(...) alone retains nothing
+            # any OTHER load — await t, tasks.add(t), gather(*ts), return t,
+            # t.cancel(), container literals — means a live reference path
+            # (the Load-ctx filter above already excluded the assignment
+            # target itself, which is a Store)
+            return True
+        return False
+
+
+def _iter_functions(tree: ast.Module):
+    """(scope, def_node) for every function at any depth, plus a synthetic
+    module-level pseudo-function for top-level statements."""
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                yield sub, child
+                yield from visit(child, sub)
+            elif isinstance(child, ast.ClassDef):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                yield from visit(child, sub)
+            else:
+                yield from visit(child, scope)
+    yield from visit(tree, "")
+
+
+class _ModuleBody:
+    """Adapter so module-level spawn statements get the same analysis."""
+
+    def __init__(self, tree: ast.Module):
+        self.body = tree.body
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for pf in index.files:
+        if pf.tree is None:
+            continue
+        units: list = [("<module>", _ModuleBody(pf.tree))]
+        units.extend(_iter_functions(pf.tree))
+        for scope, fn in units:
+            scanner = _FnScanner(fn)
+            for call in scanner.spawns():
+                retained, local = scanner.call_retained(call)
+                if retained:
+                    continue
+                if local is not None and scanner.local_retained(local, call):
+                    continue
+                coro = _coro_detail(call)
+                how = (
+                    f"task bound only to local {local!r} that is never "
+                    "awaited, stored, or passed on"
+                    if local is not None else
+                    "task result discarded (bare statement)"
+                )
+                findings.append(Finding(
+                    RULE, pf.path, call.lineno, scope or "<module>",
+                    f"unretained:{coro}",
+                    f"{how} — the event loop holds only a weak reference, "
+                    f"so the {coro} task can be GC'd mid-flight and silently "
+                    "stop (retain it in an attribute/collection, await it, "
+                    "or hand it to a TaskGroup)",
+                ))
+    return findings

@@ -1,0 +1,103 @@
+"""GC007 — thread-ownership discipline for annotated state.
+
+The engine is a two-context system (asyncio event loop + device thread),
+and PR 10's migration review verified BY HAND that ``engine._frozen`` is
+only ever touched on the device thread (freeze/commit/rollback all go
+through ``_run_on_device_thread``). That reasoning was correct but lived
+nowhere a refactor would trip over it. ``# owned-by:`` mechanizes it:
+
+    self._frozen: dict = {}  # owned-by: device-thread
+
+From then on, any access to ``._frozen`` — ANY receiver, ANY file in the
+scan surface, so ``self.engine._frozen`` in migration/manager.py counts —
+from a function whose execution context is lexically knowable and WRONG is
+a violation:
+
+- ``owned-by: device-thread`` state touched inside an ``async def``
+  (event-loop context), or
+- ``owned-by: event-loop`` state touched inside a function submitted to a
+  worker (``threading.Thread`` target, ``run_in_executor`` /
+  ``asyncio.to_thread`` / ``.submit`` / ``_run_on_device_thread`` callee),
+- ``owned-by: any`` never flags — it documents deliberately free-threaded
+  state (lock-free rings, atomic cursors).
+
+Functions with UNKNOWN context (plain sync defs) are never flagged: a
+helper may legitimately run in either context depending on its caller —
+the submission sites are where the context is decided, and those are what
+this checker reads. ``__init__`` and module top level are exempt
+(initialization happens before a second context exists). Ownership is
+claimed by ATTRIBUTE NAME across the surface — keep annotated names
+distinctive; conflicting annotations drop the name from the cross-file
+registry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, RepoIndex, iter_nodes_skipping_nested_defs
+from .ownership import (
+    ANY,
+    DEVICE,
+    EVENT_LOOP,
+    FileContexts,
+    effective_tables,
+    ownership_registry,
+)
+
+RULE = "GC007"
+
+
+def _violates(owner: str, ctx: str) -> bool:
+    if owner == ANY:
+        return False
+    if owner == DEVICE and ctx == EVENT_LOOP:
+        return True
+    if owner == EVENT_LOOP and ctx == DEVICE:
+        return True
+    return False
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    all_attrs, all_globals, per_file = ownership_registry(index.files)
+    if not all_attrs and not all_globals and not per_file:
+        return []
+    findings: list[Finding] = []
+    for pf in index.files:
+        if pf.tree is None:
+            continue
+        attrs, globals_ = effective_tables(
+            all_attrs, all_globals, per_file, pf.path)
+        fc = FileContexts(pf)
+        for scope, fn in fc.iter_defs():
+            if getattr(fn, "name", "") == "__init__":
+                continue  # pre-thread initialization
+            ctx = fc.context_of(fn)
+            if ctx is None:
+                continue
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            reported: set = set()
+            for node in iter_nodes_skipping_nested_defs(body):
+                attr = owner = None
+                if isinstance(node, ast.Attribute) and node.attr in attrs:
+                    attr, owner = node.attr, attrs[node.attr]
+                elif isinstance(node, ast.Name) and node.id in globals_:
+                    # module globals are annotated as bare names
+                    attr, owner = node.id, globals_[node.id]
+                if attr is None:
+                    continue
+                if not _violates(owner, ctx):
+                    continue
+                key = (attr, node.lineno)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(Finding(
+                    RULE, pf.path, node.lineno, scope,
+                    f"off-context:{attr}@{ctx}",
+                    f"{attr!r} is owned-by: {owner} but this code runs on "
+                    f"the {ctx} — touch it from its owning context (the "
+                    "engine idiom: submit via _run_on_device_thread / "
+                    "run_in_executor, or marshal a copy)",
+                ))
+    return findings

@@ -1,0 +1,158 @@
+"""GC008 — off-context iteration/serialization of loop-owned containers.
+
+PR 9's directory persistence died with "dictionary changed size during
+iteration" on every busy interval: the snapshot was serialized inside
+``asyncio.to_thread`` while the event loop — the index's single writer —
+kept mutating the dicts underneath it. The fix was to serialize ON the
+loop and push only the finished bytes off it. GC007 polices direct
+touches; this checker catches the two hand-off shapes GC007 structurally
+cannot see:
+
+1. **argument hand-off** — a container annotated ``# owned-by: event-loop``
+   passed INTO a worker submission, where the callee will iterate it off
+   the loop (the lexical access sits in the async def, so its context is
+   "correct"):
+
+       await asyncio.to_thread(json.dumps, self._claims)     # violation
+       loop.run_in_executor(None, write, self._data)          # violation
+       blob = json.dumps(self._claims)                        # fine (on loop)
+       await asyncio.to_thread(write, blob)                   # fine (bytes)
+
+2. **callee serialization** — a submitted function (same file, one level,
+   the GC001 transitive idiom) whose body iterates or serializes a
+   loop-owned container: ``for``/comprehensions over it, ``json.dumps`` /
+   ``list`` / ``dict`` / ``sorted`` / ``tuple`` of it, or ``.items()`` /
+   ``.values()`` / ``.keys()`` / ``.copy()`` on it — every one of these
+   walks the container element-by-element while the loop mutates it.
+
+Only ``owned-by: event-loop`` state participates: device-thread state
+handed to a device submission is the correct direction, and ``any`` is
+free-threaded by declaration.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    Finding,
+    RepoIndex,
+    dotted_name,
+    expr_text,
+    iter_nodes_skipping_nested_defs,
+)
+from .ownership import (
+    DEVICE,
+    EVENT_LOOP,
+    FileContexts,
+    _callable_refs,
+    effective_tables,
+    ownership_registry,
+)
+
+RULE = "GC008"
+
+_SERIALIZE_CALLS = {"dumps", "list", "dict", "sorted", "tuple", "set",
+                    "seal_bytes"}
+_ITERATING_METHODS = {"items", "values", "keys", "copy"}
+
+
+def _owned_refs(node: ast.AST, attrs: dict, globals_: dict) -> list[str]:
+    """Names of loop-owned attrs/globals referenced anywhere under node."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and attrs.get(sub.attr) == EVENT_LOOP:
+            out.append(sub.attr)
+        elif isinstance(sub, ast.Name) and globals_.get(sub.id) == EVENT_LOOP:
+            out.append(sub.id)
+    return out
+
+
+def _submission_args(call: ast.Call) -> list[ast.AST]:
+    """Non-callee argument expressions of a worker-submission call, or []
+    when the call is not a submission."""
+    refs = _callable_refs(call)
+    if not refs:
+        return []
+    ref_ids = {id(r) for r in refs}
+    out = [a for a in call.args if id(a) not in ref_ids]
+    out.extend(kw.value for kw in call.keywords
+               if id(kw.value) not in ref_ids and kw.arg != "target")
+    return out
+
+
+def _iterates_owned(fn: ast.AST, attrs: dict, globals_: dict):
+    """(node, attr) for iteration/serialization of loop-owned state in one
+    function body (nested defs skipped — they are their own contexts)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for node in iter_nodes_skipping_nested_defs(body):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            for attr in _owned_refs(node.iter, attrs, globals_):
+                yield node, attr
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                for attr in _owned_refs(gen.iter, attrs, globals_):
+                    yield node, attr
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            tail = (name or "").split(".")[-1]
+            if tail in _SERIALIZE_CALLS:
+                for arg in node.args:
+                    for attr in _owned_refs(arg, attrs, globals_):
+                        yield node, attr
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _ITERATING_METHODS):
+                for attr in _owned_refs(node.func.value, attrs, globals_):
+                    yield node, attr
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    all_attrs, all_globals, per_file = ownership_registry(index.files)
+    if not all_attrs and not all_globals and not per_file:
+        return []
+    findings: list[Finding] = []
+    for pf in index.files:
+        if pf.tree is None:
+            continue
+        attrs, globals_ = effective_tables(
+            all_attrs, all_globals, per_file, pf.path)
+        fc = FileContexts(pf)
+        reported: set = set()
+
+        def note(line: int, scope: str, detail: str, msg: str) -> None:
+            key = (detail, line)
+            if key not in reported:
+                reported.add(key)
+                findings.append(Finding(RULE, pf.path, line, scope, detail, msg))
+
+        # shape 1: loop-owned containers handed to a worker submission
+        for scope, fn in fc.iter_defs():
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for node in iter_nodes_skipping_nested_defs(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                for arg in _submission_args(node):
+                    for attr in _owned_refs(arg, attrs, globals_):
+                        note(
+                            node.lineno, scope, f"offloop-arg:{attr}",
+                            f"loop-owned {attr!r} is passed into a worker "
+                            f"submission ({expr_text(node.func)}) — the "
+                            "callee will iterate it OFF the event loop "
+                            "while the loop mutates it ('dict changed size"
+                            "'); serialize on the loop, ship bytes",
+                        )
+        # shape 2: a device-context function body serializing/iterating
+        # loop-owned state (the submitted-callee side of the same bug)
+        for scope, fn in fc.iter_defs():
+            if fc.context_of(fn) != DEVICE:
+                continue
+            for node, attr in _iterates_owned(fn, attrs, globals_):
+                note(
+                    node.lineno, scope, f"offloop-iter:{attr}",
+                    f"loop-owned {attr!r} is iterated/serialized inside a "
+                    "worker-submitted function — the event loop mutates it "
+                    "concurrently ('dict changed size'); snapshot it on the "
+                    "loop first",
+                )
+    return findings

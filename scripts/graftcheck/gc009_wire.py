@@ -1,0 +1,406 @@
+"""GC009 — wire-contract parity v2: frame ops, SSE control events, and
+migration snapshot/meta keys.
+
+GC005 guards HTTP route *paths*; everything else the fleet speaks is
+stringly-typed too, and each surface has already drifted once by hand:
+
+- **frame ops** — the cache server / transfer plane / KV controller all
+  speak the kvoffload frame protocol, dispatching on ``op == "<literal>"``;
+  clients build ``{"op": "<literal>", ...}`` headers. A server op no client
+  sends is dead protocol; a client op no server handles is a runtime
+  ``bad op`` error that only surfaces under load (PR 9/PR 10 added 16 ops
+  across four client modules).
+- **SSE control events** — the migration handoff rides ONE in-band event
+  (``data: {"pstpu_migration": {...}}``); the router's splice keys on the
+  event name and its payload keys (``target``, ``request_id``). A renamed
+  key on either side silently breaks the splice and leaks the raw event to
+  the client.
+- **snapshot/meta keys** — ``SequenceSnapshot`` travels as a JSON doc whose
+  producer (``to_doc``) and consumer (``from_doc``) key sets must match,
+  and the presentation ``meta`` dict written at admission is read by the
+  migration target and the fake engine; an unproduced-but-consumed key is
+  a silent default on every migration.
+
+Extraction is idiom-anchored (this is a repo-native checker, not a type
+system): op dispatch = ``op == "..."`` comparisons; op sends = dict
+literals with an ``"op"`` key; event producers = dict literals carrying the
+event-type key (or stored into ``*._migrated_out[...]``); event consumers
+= ``event.get("...")`` in the router's request_service; meta producers =
+dict literals containing both ``"oid"`` and ``"chat"`` plus constant keys
+added next to a ``**meta`` / ``**snap.meta`` spread; meta consumers =
+``.get("...")``/``[...]`` on receivers whose text ends in ``meta``. The
+tier-1 tests assert each extractor keeps seeing its real surface, so a
+refactor cannot silently turn this rule into a vacuous pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from .core import Finding, PyFile, RepoIndex, expr_text
+
+RULE = "GC009"
+
+# frame-protocol servers: files that dispatch on `op == "..."`
+SERVER_FILES = (
+    "production_stack_tpu/kvoffload/cache_server.py",
+    "production_stack_tpu/kvoffload/transfer.py",
+    "production_stack_tpu/kvoffload/controller.py",
+)
+# SSE control-event surfaces
+EVENT_PRODUCER_FILES = (
+    "production_stack_tpu/engine/api_server.py",
+    "production_stack_tpu/testing/fake_engine.py",
+)
+EVENT_CONSUMER_FILE = "production_stack_tpu/router/request_service.py"
+# migration snapshot + presentation-meta surfaces
+STATE_FILE = "production_stack_tpu/migration/state.py"
+META_PRODUCER_FILES = EVENT_PRODUCER_FILES
+META_CONSUMER_FILES = (
+    "production_stack_tpu/engine/api_server.py",
+    "production_stack_tpu/testing/fake_engine.py",
+    "production_stack_tpu/migration/manager.py",
+    "production_stack_tpu/migration/state.py",
+    "production_stack_tpu/router/request_service.py",
+)
+
+_MARKER_KEY_RE = re.compile(r'\{"([A-Za-z0-9_]+)"')
+
+
+# -- extraction: frame ops -----------------------------------------------------
+
+
+def extract_handled_ops(pf: PyFile) -> dict[str, int]:
+    """{op: line} for every ``op == "<literal>"`` dispatch comparison."""
+    out: dict[str, int] = {}
+    if pf.tree is None:
+        return out
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        if not (isinstance(node.left, ast.Name) and node.left.id == "op"):
+            continue
+        for cmp_op, comparator in zip(node.ops, node.comparators):
+            if not isinstance(cmp_op, (ast.Eq, ast.In)):
+                continue
+            consts: list = []
+            if isinstance(comparator, ast.Constant):
+                consts = [comparator.value]
+            elif isinstance(comparator, (ast.Tuple, ast.List, ast.Set)):
+                consts = [e.value for e in comparator.elts
+                          if isinstance(e, ast.Constant)]
+            for v in consts:
+                if isinstance(v, str) and v:
+                    out.setdefault(v, node.lineno)
+    return out
+
+
+def extract_sent_ops(files: Iterable[PyFile]) -> dict[str, tuple[str, int]]:
+    """{op: (file, line)} for every dict literal carrying an "op" key."""
+    out: dict[str, tuple[str, int]] = {}
+    for pf in files:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "op"
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    out.setdefault(v.value, (pf.path, node.lineno))
+    return out
+
+
+def check_frames(server_pfs: list[PyFile],
+                 client_pfs: list[PyFile]) -> list[Finding]:
+    handled: dict[str, tuple[str, int]] = {}
+    for pf in server_pfs:
+        for op, line in extract_handled_ops(pf).items():
+            handled.setdefault(op, (pf.path, line))
+    sent = extract_sent_ops(client_pfs)
+    findings: list[Finding] = []
+    for op, (path, line) in sorted(sent.items()):
+        if op not in handled:
+            findings.append(Finding(
+                RULE, path, line, "<frames>", f"undeclared-op:{op}",
+                f"client sends frame op {op!r} but no frame server "
+                "dispatches on it — the peer will answer 'bad op' at "
+                "runtime",
+            ))
+    for op, (path, line) in sorted(handled.items()):
+        if op not in sent:
+            findings.append(Finding(
+                RULE, path, line, "<frames>", f"unconsumed-op:{op}",
+                f"frame server handles op {op!r} but no client in the scan "
+                "surface ever sends it — dead protocol (or the client "
+                "moved without the server)",
+            ))
+    return findings
+
+
+# -- extraction: SSE control events --------------------------------------------
+
+
+def extract_event_consumer(pf: PyFile) -> "tuple[Optional[str], set, int]":
+    """(event_type_key, consumed_payload_keys, anchor_line) from the
+    router's splice: the marker byte-literal names the type key, and
+    ``event.get("...")`` calls name the payload keys."""
+    type_key: Optional[str] = None
+    keys: set = set()
+    line = 1
+    if pf.tree is None:
+        return None, keys, line
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, bytes):
+            m = _MARKER_KEY_RE.search(node.value.decode("utf-8", "replace"))
+            if m and type_key is None:
+                type_key = m.group(1)
+                line = node.lineno
+        # json.loads(payload)["<type key>"] — the parse-side key must agree
+        elif isinstance(node, ast.Subscript):
+            val = node.value
+            if (isinstance(val, ast.Call)
+                    and expr_text(val.func).endswith("json.loads")
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                if type_key is None:
+                    type_key = node.slice.value
+                    line = node.lineno
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr == "get"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in ("event", "next_event")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                keys.add(node.args[0].value)
+    return type_key, keys, line
+
+
+def extract_event_producers(files: Iterable[PyFile],
+                            type_key: str) -> "tuple[set, dict]":
+    """(payload_keys, sites): keys produced under the control-event type
+    key — inline dict-literal values, plus dict literals stored into a
+    ``*._migrated_out[...]`` subscript (the api_server indirection)."""
+    keys: set = set()
+    sites: dict[str, tuple[str, int]] = {}
+    for pf in files:
+        if pf.tree is None:
+            continue
+        produced_here = False
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and k.value == type_key:
+                        produced_here = True
+                        if isinstance(v, ast.Dict):
+                            keys.update(
+                                kk.value for kk in v.keys
+                                if isinstance(kk, ast.Constant)
+                            )
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(t.value, ast.Attribute)
+                            and t.value.attr == "_migrated_out"
+                            and isinstance(node.value, ast.Dict)):
+                        produced_here = True
+                        keys.update(
+                            kk.value for kk in node.value.keys
+                            if isinstance(kk, ast.Constant)
+                        )
+        if produced_here:
+            sites[pf.path] = (pf.path, 1)
+    return keys, sites
+
+
+def check_events(producer_pfs: list[PyFile],
+                 consumer_pf: PyFile) -> list[Finding]:
+    type_key, consumed, line = extract_event_consumer(consumer_pf)
+    if type_key is None:
+        return []  # no splice in this surface — nothing to diff
+    produced, sites = extract_event_producers(producer_pfs, type_key)
+    findings: list[Finding] = []
+    if not sites:
+        findings.append(Finding(
+            RULE, consumer_pf.path, line, "<events>",
+            f"event-type-unproduced:{type_key}",
+            f"the stream splice consumes control events typed {type_key!r} "
+            "but no producer in the engine/fake surface emits that key — "
+            "the splice can never trigger",
+        ))
+        return findings
+    for k in sorted(consumed - produced):
+        findings.append(Finding(
+            RULE, consumer_pf.path, line, "<events>", f"event-key-unproduced:{k}",
+            f"splice consumes control-event key {k!r} that no producer "
+            "writes — it reads as None and the handoff aborts",
+        ))
+    for k in sorted(produced - consumed):
+        src = sorted(sites)[0]
+        findings.append(Finding(
+            RULE, src, 1, "<events>", f"event-key-unconsumed:{k}",
+            f"control-event key {k!r} is produced but the splice never "
+            "reads it — producer/consumer drift (rename or dead field)",
+        ))
+    return findings
+
+
+# -- extraction: snapshot doc + presentation meta ------------------------------
+
+
+def extract_snapshot_keys(pf: PyFile) -> "tuple[set, set, int]":
+    """(produced, consumed, line): dataclass fields + to_doc literal keys
+    vs from_doc's ``doc[...]`` / ``doc.get(...)`` reads."""
+    produced: set = set()
+    consumed: set = set()
+    line = 1
+    if pf.tree is None:
+        return produced, consumed, line
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "SequenceSnapshot":
+            line = node.lineno
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name):
+                    produced.add(stmt.target.id)
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Dict):
+                            produced.update(
+                                k.value for k in sub.keys
+                                if isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)
+                            )
+                        if stmt.name == "from_doc":
+                            _collect_reads(sub, "doc", consumed)
+    return produced, consumed, line
+
+
+def _collect_reads(node: ast.AST, recv: str, into: set) -> None:
+    if isinstance(node, ast.Subscript):
+        if (isinstance(node.value, ast.Name) and node.value.id == recv
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)):
+            into.add(node.slice.value)
+    elif isinstance(node, ast.Call):
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "get"
+                and isinstance(fn.value, ast.Name) and fn.value.id == recv
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            into.add(node.args[0].value)
+
+
+def check_snapshot(state_pf: PyFile) -> list[Finding]:
+    produced, consumed, line = extract_snapshot_keys(state_pf)
+    if not produced or not consumed:
+        return []
+    findings: list[Finding] = []
+    for k in sorted(consumed - produced):
+        findings.append(Finding(
+            RULE, state_pf.path, line, "<snapshot>", f"snapshot-unproduced:{k}",
+            f"from_doc reads snapshot key {k!r} that to_doc never writes",
+        ))
+    for k in sorted(produced - consumed):
+        findings.append(Finding(
+            RULE, state_pf.path, line, "<snapshot>", f"snapshot-unconsumed:{k}",
+            f"snapshot key {k!r} is produced by to_doc but from_doc never "
+            "reads it — wire drift (a migrated field silently drops)",
+        ))
+    return findings
+
+
+def extract_meta_keys(producer_pfs: list[PyFile],
+                      consumer_pfs: list[PyFile]) -> "tuple[set, set]":
+    produced: set = set()
+    consumed: set = set()
+    for pf in producer_pfs:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            const_keys = {k.value for k in node.keys
+                          if isinstance(k, ast.Constant)
+                          and isinstance(k.value, str)}
+            if {"oid", "chat"} <= const_keys:
+                produced.update(const_keys)  # the meta literal itself
+                continue
+            # augmentation: {**meta, "k": v} / {**snap.meta, "k": v}
+            has_meta_spread = any(
+                k is None and expr_text(v).endswith("meta")
+                for k, v in zip(node.keys, node.values)
+            )
+            if has_meta_spread:
+                produced.update(const_keys)
+    for pf in consumer_pfs:
+        if pf.tree is None:
+            continue
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (isinstance(fn, ast.Attribute) and fn.attr == "get"
+                        and expr_text(fn.value).endswith("meta")
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    consumed.add(node.args[0].value)
+            elif isinstance(node, ast.Subscript):
+                if (isinstance(node.value, ast.Attribute)
+                        and node.value.attr == "meta"
+                        and isinstance(node.slice, ast.Constant)
+                        and isinstance(node.slice.value, str)):
+                    consumed.add(node.slice.value)
+    return produced, consumed
+
+
+def check_meta(producer_pfs: list[PyFile],
+               consumer_pfs: list[PyFile]) -> list[Finding]:
+    produced, consumed = extract_meta_keys(producer_pfs, consumer_pfs)
+    if not produced:
+        return []
+    anchor = producer_pfs[0]
+    findings: list[Finding] = []
+    for k in sorted(consumed - produced):
+        findings.append(Finding(
+            RULE, anchor.path, 1, "<meta>", f"meta-key-unproduced:{k}",
+            f"migration presentation meta key {k!r} is consumed but never "
+            "produced — every migrated stream silently falls back to the "
+            "default",
+        ))
+    for k in sorted(produced - consumed):
+        findings.append(Finding(
+            RULE, anchor.path, 1, "<meta>", f"meta-key-unconsumed:{k}",
+            f"migration presentation meta key {k!r} is produced but never "
+            "consumed — dead wire field or a renamed consumer",
+        ))
+    return findings
+
+
+# -- the real-tree gate --------------------------------------------------------
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    server_pfs = [pf for p in SERVER_FILES
+                  if (pf := index.get(p)) is not None]
+    if server_pfs:
+        findings.extend(check_frames(server_pfs, index.files))
+    consumer = index.get(EVENT_CONSUMER_FILE)
+    producers = [pf for p in EVENT_PRODUCER_FILES
+                 if (pf := index.get(p)) is not None]
+    if consumer is not None and producers:
+        findings.extend(check_events(producers, consumer))
+    state_pf = index.get(STATE_FILE)
+    if state_pf is not None:
+        findings.extend(check_snapshot(state_pf))
+    meta_consumers = [pf for p in META_CONSUMER_FILES
+                      if (pf := index.get(p)) is not None]
+    if producers and meta_consumers:
+        findings.extend(check_meta(producers, meta_consumers))
+    return findings

@@ -1,0 +1,361 @@
+"""GC010 — metric discipline over the hand-rolled Prometheus surface.
+
+check_metrics_coverage guards that every metric NAME is documented and
+dashboarded; nothing guards that the metrics behave like their declared
+types. This repo renders exposition by hand (``# TYPE <name> counter``
+literals + f-string sample lines), which makes the discipline mechanically
+checkable:
+
+- **type-conflict** — one family declared ``counter`` in one file and
+  ``gauge`` in another: Prometheus keeps whichever scrape came last and
+  rate() queries silently break.
+- **naming** — a ``counter`` must end ``_total`` (the convention every
+  dashboard query in observability/ relies on); a ``gauge`` must NOT end
+  ``_total`` (it would invite rate() over a resettable value).
+- **counter-decrement** — the int attribute backing a ``*_total`` family
+  must never be ``-=``-mutated (counters only reset on process restart;
+  a decrement makes rate() read negative and increase() lie).
+- **inc-only gauge** — a ``gauge`` whose backing attribute is only ever
+  ``+=``-mutated is a counter wearing the wrong type: rename it ``*_total``
+  and declare it counter, or make it actually level-valued.
+- **construct-once** — ``Histogram(...)`` (utils/metrics.py) built outside
+  module scope / class body / ``__init__`` churns a fresh family per call
+  and loses all history between scrapes.
+- **label drift** — the same family rendered with different label KEY sets
+  at different literal sites (``{model=...}`` here, ``{model_name=...}``
+  there) splits one family into unjoinable series; a label key produced by
+  interpolation (not literal text) is an open keyset the cardinality guard
+  cannot audit.
+
+Extraction is literal-anchored: only f-string sample lines whose LEADING
+text is the metric name participate (dynamic-name renderers like the
+shared ``Histogram.render`` are skipped — their call sites carry the
+literal labels). Backing attributes resolve through two idioms: the sample
+line's value expression (``f"vllm:x_total {self.n}"``) and stats-dict
+literals (``{"x_total": self.n}``) rendered by a generic exposition loop.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .core import Finding, PyFile, RepoIndex
+
+RULE = "GC010"
+
+_TYPE_RE = re.compile(r"#\s*TYPE\s+([A-Za-z_:][A-Za-z0-9_:]*)\s+(counter|gauge|histogram)")
+_NAME_RE = re.compile(r"^([A-Za-z_][A-Za-z0-9_]*:[A-Za-z0-9_:]+)")
+_LABEL_KEY_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*)=")
+_PLACEHOLDER = "\x00"
+
+_METRIC_PREFIXES = ("vllm:", "vllm_router:", "fake:")
+
+
+class Sample:
+    def __init__(self, name: str, labels: "Optional[frozenset]",
+                 dynamic_label_key: bool, value_attr: Optional[str],
+                 pf: PyFile, line: int):
+        self.name = name
+        self.labels = labels          # frozenset of label keys, or None
+        self.dynamic_label_key = dynamic_label_key
+        self.value_attr = value_attr  # self.<attr> backing the value
+        self.pf = pf
+        self.line = line
+
+
+def _joined_text(node: ast.JoinedStr) -> str:
+    """Literal text with formatted values replaced by a placeholder."""
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(str(v.value))
+        else:
+            parts.append(_PLACEHOLDER)
+    return "".join(parts)
+
+
+def _value_attr(node: ast.JoinedStr) -> Optional[str]:
+    """self.<attr> when the LAST formatted value is a plain attribute."""
+    fvs = [v for v in node.values if isinstance(v, ast.FormattedValue)]
+    if not fvs:
+        return None
+    expr = fvs[-1].value
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def _parse_sample(text: str) -> "Optional[tuple[str, Optional[frozenset], bool]]":
+    """(name, label_keys, dynamic_label_key) for a metric-shaped line."""
+    if not text.startswith(_METRIC_PREFIXES):
+        return None
+    m = _NAME_RE.match(text)
+    if not m:
+        return None
+    name = m.group(1)
+    rest = text[m.end():]
+    if rest.startswith("{"):
+        end = rest.find("}")
+        if end < 0:
+            return None
+        block = rest[1:end]
+        dynamic = False
+        opaque = False
+        keys = []
+        for item in block.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                if _PLACEHOLDER in item:
+                    # the repo idiom: a prebuilt label STRING variable
+                    # interpolated as (part of) the block — keyset unknown
+                    # here, audited at the site that builds the string
+                    opaque = True
+                continue
+            key_part = item.split("=", 1)[0]
+            if _PLACEHOLDER in key_part:
+                dynamic = True  # a label KEY formed by interpolation
+                continue
+            km = _LABEL_KEY_RE.match(item)
+            if km:
+                keys.append(km.group(1))
+        return name, (None if opaque else frozenset(keys)), dynamic
+    if not rest.startswith((" ", _PLACEHOLDER)):
+        return None  # prose mentioning a metric name, not a sample line
+    return name, frozenset(), False
+
+
+def _scan_file(pf: PyFile):
+    """(type_decls, samples, stats_backings) for one file.
+    stats_backings: (metric_key, attr, line) from ``{"x_total": self.x}``
+    dict literals rendered by generic exposition loops."""
+    types: list[tuple[str, str, int]] = []
+    samples: list[Sample] = []
+    stats: list[tuple[str, str, int]] = []
+    if pf.tree is None:
+        return types, samples, stats
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            m = _TYPE_RE.search(node.value)
+            if m:
+                types.append((m.group(1), m.group(2), node.lineno))
+        elif isinstance(node, ast.JoinedStr):
+            text = _joined_text(node)
+            m = _TYPE_RE.search(text)
+            if m and _PLACEHOLDER not in m.group(1):
+                types.append((m.group(1), m.group(2), node.lineno))
+                continue
+            parsed = _parse_sample(text)
+            if parsed is not None:
+                name, labels, dynamic = parsed
+                samples.append(Sample(
+                    name, labels, dynamic, _value_attr(node), pf, node.lineno
+                ))
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and k.value.endswith("_total")
+                        and isinstance(v, ast.Attribute)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id == "self"):
+                    stats.append((k.value, v.attr, v.lineno))
+    return types, samples, stats
+
+
+def _attr_mutations(pf: PyFile) -> "dict[str, dict]":
+    """attr -> {"dec": [lines], "inc": [lines], "assign": [lines]} for
+    ``self.<attr>`` mutations outside __init__/reset*."""
+    out: dict[str, dict] = {}
+    if pf.tree is None:
+        return out
+
+    def scan_fn(fn, exempt: bool):
+        for node in ast.walk(fn):
+            tgt = None
+            kind = None
+            if isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Attribute):
+                tgt = node.target
+                kind = "dec" if isinstance(node.op, ast.Sub) else "inc"
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        tgt, kind = t, "assign"
+            if tgt is None or not (isinstance(tgt.value, ast.Name)
+                                   and tgt.value.id == "self"):
+                continue
+            if exempt and kind != "dec":
+                continue  # __init__/reset may (re)initialize, never decrement
+            out.setdefault(tgt.attr, {"dec": [], "inc": [], "assign": []})[
+                kind].append(node.lineno)
+
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            exempt = node.name == "__init__" or node.name.startswith("reset")
+            # only scan the function's own statements, not nested defs —
+            # close enough for mutation bookkeeping
+            scan_fn(node, exempt)
+    return out
+
+
+def check(index: RepoIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    # name -> list[(type, file, line)]
+    decls: dict[str, list[tuple[str, str, int]]] = {}
+    all_samples: list[Sample] = []
+    backings: dict[str, list[tuple[str, str, int]]] = {}  # name -> (file, attr, line)
+    per_file_mutations: dict[str, dict] = {}
+
+    for pf in index.files:
+        types, samples, stats = _scan_file(pf)
+        for name, kind, line in types:
+            decls.setdefault(name, []).append((kind, pf.path, line))
+        all_samples.extend(samples)
+        for key, attr, line in stats:
+            backings.setdefault(key, []).append((pf.path, attr, line))
+        if types or samples or stats:
+            per_file_mutations[pf.path] = _attr_mutations(pf)
+
+    # -- type conflicts + naming ---------------------------------------------
+    for name, entries in sorted(decls.items()):
+        kinds = {k for k, _, _ in entries}
+        if len(kinds) > 1:
+            kind0, path0, line0 = entries[0]
+            findings.append(Finding(
+                RULE, path0, line0, "<metrics>", f"type-conflict:{name}",
+                f"{name} is declared {' and '.join(sorted(kinds))} at "
+                "different sites — one family, one TYPE",
+            ))
+            continue
+        kind, path, line = entries[0]
+        if kind == "counter" and not name.endswith("_total"):
+            findings.append(Finding(
+                RULE, path, line, "<metrics>", f"counter-name:{name}",
+                f"counter {name} does not end in _total — the convention "
+                "every rate() dashboard query relies on",
+            ))
+        if kind == "gauge" and name.endswith("_total"):
+            findings.append(Finding(
+                RULE, path, line, "<metrics>", f"gauge-name:{name}",
+                f"gauge {name} ends in _total — _total promises a "
+                "monotonic counter; rename it or declare it counter",
+            ))
+
+    # -- counter decrement / inc-only gauges ----------------------------------
+    checked_attrs: set = set()
+    counter_names = {n for n, e in decls.items() if e[0][0] == "counter"
+                     and len({k for k, _, _ in e}) == 1}
+    gauge_names = {n for n, e in decls.items() if e[0][0] == "gauge"
+                   and len({k for k, _, _ in e}) == 1}
+
+    def attr_sites(name: str):
+        """(file, attr, line) pairs backing a family, from sample f-strings
+        and stats-dict literals (dict keys drop the vllm:/... prefix)."""
+        out = []
+        for s in all_samples:
+            if s.name == name and s.value_attr:
+                out.append((s.pf.path, s.value_attr, s.line))
+        short = name.split(":", 1)[-1]
+        for key in (name, short):
+            out.extend(backings.get(key, []))
+        return out
+
+    for name in sorted(counter_names):
+        for path, attr, line in attr_sites(name):
+            if (path, attr) in checked_attrs:
+                continue
+            checked_attrs.add((path, attr))
+            muts = per_file_mutations.get(path, {}).get(attr)
+            if muts and muts["dec"]:
+                findings.append(Finding(
+                    RULE, path, muts["dec"][0], "<metrics>",
+                    f"counter-decrement:{name}:{attr}",
+                    f"{attr!r} backs counter {name} but is decremented — "
+                    "counters only go up (reset=restart); decrementing "
+                    "breaks rate()/increase()",
+                ))
+    gauge_checked: set = set()
+    for name in sorted(gauge_names):
+        for path, attr, line in attr_sites(name):
+            if (path, attr) in gauge_checked:
+                continue  # one finding per backing attr, not per sample site
+            gauge_checked.add((path, attr))
+            muts = per_file_mutations.get(path, {}).get(attr)
+            if muts and muts["inc"] and not muts["assign"] and not muts["dec"]:
+                findings.append(Finding(
+                    RULE, path, muts["inc"][0], "<metrics>",
+                    f"inc-only-gauge:{name}:{attr}",
+                    f"{attr!r} backs gauge {name} but is only ever "
+                    "incremented — that is a counter; rename *_total and "
+                    "declare counter",
+                ))
+
+    # -- label keyset discipline ----------------------------------------------
+    by_name: dict[str, list[Sample]] = {}
+    for s in all_samples:
+        by_name.setdefault(s.name, []).append(s)
+    for name, samples in sorted(by_name.items()):
+        for s in samples:
+            if s.dynamic_label_key:
+                findings.append(Finding(
+                    RULE, s.pf.path, s.line, "<metrics>",
+                    f"dynamic-label-key:{name}",
+                    f"{name} renders a label KEY by interpolation — the "
+                    "keyset must be closed literal text so the cardinality "
+                    "guard can audit it",
+                ))
+        keysets = {s.labels for s in samples if s.labels is not None
+                   and not s.dynamic_label_key}
+        if len(keysets) > 1:
+            anchor = samples[0]
+            rendered = " vs ".join(
+                "{" + ",".join(sorted(ks)) + "}" for ks in sorted(
+                    keysets, key=lambda k: sorted(k))
+            )
+            findings.append(Finding(
+                RULE, anchor.pf.path, anchor.line, "<metrics>",
+                f"label-drift:{name}",
+                f"{name} is rendered with different label keysets "
+                f"({rendered}) — one family must keep one keyset or "
+                "queries cannot join the series",
+            ))
+
+    # -- construct-once --------------------------------------------------------
+    for pf in index.files:
+        if pf.tree is None:
+            continue
+        for scope, node in _constructions(pf):
+            findings.append(Finding(
+                RULE, pf.path, node.lineno, scope,
+                "construct-in-function:Histogram",
+                "Histogram(...) constructed outside module scope/__init__ — "
+                "a per-call family loses all history between scrapes",
+            ))
+    return findings
+
+
+def _constructions(pf: PyFile):
+    """Histogram() calls in non-__init__ function bodies."""
+    def visit(node, scope, in_fn):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                yield from visit(child, sub, child.name != "__init__")
+            elif isinstance(child, ast.ClassDef):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                yield from visit(child, sub, in_fn)
+            else:
+                if in_fn and isinstance(child, ast.Call):
+                    fn = child.func
+                    name = fn.id if isinstance(fn, ast.Name) else (
+                        fn.attr if isinstance(fn, ast.Attribute) else None
+                    )
+                    if name == "Histogram":
+                        yield scope, child
+                yield from visit(child, scope, in_fn)
+    if pf.tree is not None:
+        yield from visit(pf.tree, "", False)

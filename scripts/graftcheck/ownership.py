@@ -1,0 +1,286 @@
+"""Shared ownership/context analysis for GC007 and GC008.
+
+Two pieces:
+
+1. ``# owned-by: <context>`` annotations — the thread-ownership mirror of
+   GC004's ``# guarded-by:``. An attribute (or module global) declares which
+   execution context owns it at its initializing assignment:
+
+       self._frozen: dict = {}          # owned-by: device-thread
+       self._data = OrderedDict()       # owned-by: event-loop
+       self._cursor = itertools.count() # owned-by: any
+
+   Contexts: ``event-loop`` (the asyncio loop's single writer),
+   ``device-thread`` (the engine step loop / executor / any worker thread),
+   ``any`` (explicitly free-threaded — documentation only, never flagged).
+
+   The registry is keyed by ATTRIBUTE NAME across the whole scan surface:
+   ``self.engine._frozen`` in migration/manager.py is checked against the
+   annotation in engine/engine.py — exactly the cross-file reasoning PR 10
+   did by hand. Keep annotated names distinctive; if the same name is
+   annotated with CONFLICTING contexts in two places, both drop out of the
+   cross-file check (self-file accesses still check against the local one).
+
+2. Execution-context inference per function, lexical and per-file:
+
+   - ``async def`` bodies run on the event loop;
+   - functions handed to ``threading.Thread(target=...)``,
+     ``loop.run_in_executor(...)``, ``asyncio.to_thread(...)``,
+     ``executor.submit(...)``, or the engine's ``_run_on_device_thread(...)``
+     run on a worker ("device-thread") — including lambdas and nested defs
+     submitted by name;
+   - everything else is UNKNOWN and is never flagged (a sync helper may be
+     called from either side; annotate its callers' submission sites
+     instead).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .core import PyFile
+
+EVENT_LOOP = "event-loop"
+DEVICE = "device-thread"
+ANY = "any"
+
+_OWNED_RE = re.compile(r"#\s*owned-by:\s*(event-loop|device-thread|any)\b")
+
+# call names whose first callable argument runs on a worker thread
+_SUBMIT_FIRST_ARG = {"to_thread", "submit", "_run_on_device_thread"}
+# loop.run_in_executor(executor, fn, *args): fn is the SECOND argument
+_SUBMIT_SECOND_ARG = {"run_in_executor"}
+
+
+class Annotation:
+    def __init__(self, attr: str, context: str, pf: PyFile, line: int,
+                 cls: Optional[str], is_attr: bool = True):
+        self.attr = attr
+        self.context = context
+        self.pf = pf
+        self.line = line
+        self.cls = cls      # declaring class, None outside any class
+        self.is_attr = is_attr  # False: module-level bare-name global
+
+
+def parse_annotations(pf: PyFile) -> list[Annotation]:
+    """Every '# owned-by: <ctx>' annotation sitting on an assignment."""
+    out: list[Annotation] = []
+    if pf.tree is None:
+        return out
+    ann_lines: dict[int, str] = {}
+    for i, line in enumerate(pf.lines, start=1):
+        m = _OWNED_RE.search(line)
+        if m:
+            ann_lines[i] = m.group(1)
+    if not ann_lines:
+        return out
+
+    def scan(body, cls: Optional[str]):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                scan(node.body, node.name)
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan(node.body, cls)
+                continue
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                ctx = ann_lines.get(node.lineno)
+                if ctx is not None:
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        if isinstance(t, ast.Attribute):
+                            out.append(Annotation(t.attr, ctx, pf,
+                                                  node.lineno, cls))
+                        elif isinstance(t, ast.Name) and cls is None:
+                            out.append(Annotation(t.id, ctx, pf,
+                                                  node.lineno, None,
+                                                  is_attr=False))
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(node, field, None)
+                if isinstance(sub, list) and sub and isinstance(
+                        sub[0], ast.stmt):
+                    scan(sub, cls)
+            for handler in getattr(node, "handlers", []) or []:
+                scan(handler.body, cls)
+
+    scan(pf.tree.body, None)
+    return out
+
+
+def ownership_registry(
+    files,
+) -> "tuple[dict[str, str], dict[str, str], dict[str, tuple[dict, dict]]]":
+    """(attrs, module_globals, per_file): name -> owning context.
+    ``attrs`` holds attribute annotations (checked on ``x.attr`` accesses),
+    ``module_globals`` holds module-level bare-name annotations (checked on
+    ``Name`` accesses). Conflicting annotations (same name, different
+    contexts) drop the name from the CROSS-FILE tables — that check needs
+    an unambiguous claim — but each annotating file keeps its own claim in
+    ``per_file[path] = (attrs, globals_)`` so self-file accesses still
+    check against the local annotation instead of silently un-guarding."""
+    attrs: dict[str, str] = {}
+    globals_: dict[str, str] = {}
+    per_file: dict[str, tuple[dict, dict]] = {}
+    conflicted: set[tuple[bool, str]] = set()
+    for pf in files:
+        for ann in parse_annotations(pf):
+            table = attrs if ann.is_attr else globals_
+            is_global = table is globals_
+            prev = table.get(ann.attr)
+            if prev is not None and prev != ann.context:
+                conflicted.add((is_global, ann.attr))
+            if prev is None:
+                table[ann.attr] = ann.context
+            local = per_file.setdefault(pf.path, ({}, {}))
+            local[1 if is_global else 0].setdefault(ann.attr, ann.context)
+    for is_global, name in conflicted:
+        (globals_ if is_global else attrs).pop(name, None)
+    return attrs, globals_, per_file
+
+
+def effective_tables(attrs: dict, globals_: dict, per_file: dict,
+                     path: str) -> "tuple[dict, dict]":
+    """Cross-file tables overlaid with ``path``'s own annotations, so a
+    conflict elsewhere in the surface never disables checking inside the
+    file that declared ownership."""
+    local_attrs, local_globals = per_file.get(path, ({}, {}))
+    if not local_attrs and not local_globals:
+        return attrs, globals_
+    return {**attrs, **local_attrs}, {**globals_, **local_globals}
+
+
+# -- context inference ---------------------------------------------------------
+
+
+def _callable_refs(call: ast.Call) -> list[ast.AST]:
+    """Expressions submitted to run on a worker thread by ``call``."""
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    out: list[ast.AST] = []
+    if name == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                out.append(kw.value)
+    elif name in _SUBMIT_FIRST_ARG:
+        if call.args:
+            out.append(call.args[0])
+    elif name in _SUBMIT_SECOND_ARG:
+        if len(call.args) >= 2:
+            out.append(call.args[1])
+    return out
+
+
+class FileContexts:
+    """Parent-map-based structural view of one file: enclosing function /
+    class per node, nested-def symbol tables, and the inferred execution
+    context per def node."""
+
+    _DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+    def __init__(self, pf: PyFile):
+        self.pf = pf
+        self.parents: dict[int, ast.AST] = {}
+        self.contexts: dict[int, str] = {}
+        self._methods: dict[tuple[Optional[str], str], ast.AST] = {}
+        self._children: dict[Optional[int], dict[str, ast.AST]] = {None: {}}
+        if pf.tree is None:
+            return
+        for node in ast.walk(pf.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[id(child)] = node
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, self._DEFS):
+                continue
+            if isinstance(node, ast.AsyncFunctionDef):
+                self.contexts[id(node)] = EVENT_LOOP
+            encl_fn = self.enclosing_function(node)
+            self._children.setdefault(
+                id(encl_fn) if encl_fn is not None else None, {}
+            )[node.name] = node
+            cls = self.enclosing_class_name(node)
+            if cls is not None or encl_fn is None:
+                # methods and module-level defs only: a def nested in a
+                # function must not shadow a same-named method/function in
+                # the self./module resolution table (_children handles it)
+                self._methods[(cls, node.name)] = node
+        for call in [n for n in ast.walk(pf.tree)
+                     if isinstance(n, ast.Call)]:
+            for ref in _callable_refs(call):
+                target = self._resolve_ref(ref, call)
+                if target is not None:
+                    # explicit submission to a worker wins over async-ness
+                    self.contexts[id(target)] = DEVICE
+
+    def _ancestors(self, node: ast.AST):
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(id(cur))
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self._ancestors(node):
+            if isinstance(anc, self._DEFS):
+                return anc
+        return None
+
+    def enclosing_class_name(self, node: ast.AST) -> Optional[str]:
+        for anc in self._ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc.name
+            if isinstance(anc, self._DEFS):
+                # a def inside a def belongs to the inner function, not
+                # any outer class (a method of a class nested inside a
+                # function still hits its ClassDef first, above)
+                return None
+        return None
+
+    def _resolve_ref(self, ref: ast.AST, at: ast.AST) -> Optional[ast.AST]:
+        if isinstance(ref, ast.Lambda):
+            return ref
+        if isinstance(ref, ast.Name):
+            fn = self.enclosing_function(at)
+            while True:
+                table = self._children.get(
+                    id(fn) if fn is not None else None, {}
+                )
+                if ref.id in table:
+                    return table[ref.id]
+                if fn is None:
+                    return None
+                fn = self.enclosing_function(fn)
+        if isinstance(ref, ast.Attribute) and isinstance(ref.value, ast.Name):
+            if ref.value.id == "self":
+                cls = None
+                for anc in self._ancestors(at):
+                    if isinstance(anc, ast.ClassDef):
+                        cls = anc.name
+                        break
+                return self._methods.get((cls, ref.attr))
+        return None
+
+    def context_of(self, def_node: ast.AST) -> Optional[str]:
+        """EVENT_LOOP, DEVICE, or None (unknown — never checked).
+        Lambdas submitted to an executor report DEVICE too."""
+        return self.contexts.get(id(def_node))
+
+    def iter_defs(self):
+        """(dotted_scope, def_node) for every function def in the file,
+        plus executor-submitted lambdas (scope suffix ``<lambda>``)."""
+        if self.pf.tree is None:
+            return
+        for node in ast.walk(self.pf.tree):
+            if isinstance(node, self._DEFS) or (
+                    isinstance(node, ast.Lambda)
+                    and id(node) in self.contexts):
+                name = getattr(node, "name", "<lambda>")
+                parts = [name]
+                for anc in self._ancestors(node):
+                    if isinstance(anc, (*self._DEFS, ast.ClassDef)):
+                        parts.append(anc.name)
+                yield ".".join(reversed(parts)), node

@@ -1,0 +1,82 @@
+"""SARIF 2.1.0 rendering for graftcheck findings.
+
+``python -m scripts.graftcheck --format sarif --output graftcheck.sarif``
+produces a log the GitHub code-scanning upload action
+(``github/codeql-action/upload-sarif``) turns into inline PR annotations —
+findings land on the offending line in the diff view instead of a CI log
+grep. ``partialFingerprints`` carries the line-independent finding key, so
+GitHub tracks a finding across rebases exactly like baseline.json does.
+"""
+
+from __future__ import annotations
+
+import json
+
+RULES_HELP = {
+    "GC001": "Blocking call reachable from an async def (event-loop stall)",
+    "GC002": "Use of an array after JAX donation / pallas aliasing",
+    "GC003": "Tracer-unsafe Python inside a jitted/scanned/Pallas function",
+    "GC004": "Access to '# guarded-by:' state outside its lock",
+    "GC005": "Router/engine/fake-engine endpoint-contract drift",
+    "GC006": "asyncio task not retained (weak-ref GC kills it silently)",
+    "GC007": "'# owned-by:' state touched from the wrong thread context",
+    "GC008": "Loop-owned container iterated/serialized off the event loop",
+    "GC009": "Wire-contract drift: frame ops / SSE control events / "
+             "migration snapshot+meta keys",
+    "GC010": "Metric discipline: counter/gauge typing, monotonicity, "
+             "label keysets, construct-once",
+    "GC-SUPPRESS-REASON": "Suppression without a reason",
+    "GC-SUPPRESS-UNUSED": "Suppression matching no finding (rot)",
+    "GC-BASELINE": "Baseline entry stale or reasonless (rot)",
+}
+
+
+def render_sarif(violations, stats) -> str:
+    rules_used = sorted({f.rule for f in violations} | set(RULES_HELP))
+    driver = {
+        "name": "graftcheck",
+        "informationUri":
+            "https://github.com/vllm-project/production-stack",
+        "version": "2.0.0",
+        "rules": [
+            {
+                "id": rule,
+                "shortDescription": {
+                    "text": RULES_HELP.get(rule, rule),
+                },
+                "helpUri":
+                    "docs/static-analysis.md",
+                "defaultConfiguration": {"level": "error"},
+            }
+            for rule in rules_used
+        ],
+    }
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f"{f.scope}: {f.message}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "%SRCROOT%",
+                    },
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+            "partialFingerprints": {"graftcheckKey/v1": f.key},
+        }
+        for f in sorted(violations, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": driver},
+            "results": results,
+            "properties": {"stats": stats},
+            "columnKind": "utf16CodeUnits",
+        }],
+    }
+    return json.dumps(doc, indent=2) + "\n"

@@ -1,0 +1,27 @@
+"""GC006 known-violation fixture: fire-and-forget task spawns.
+
+Encodes the PR 9 bug shape verbatim: the cache server's directory persist
+loop and the fake engine's directory publishes were both spawned as bare
+``create_task``/``ensure_future`` statements — the loop's weak ref was the
+ONLY ref, and GC killed them silently mid-flight."""
+
+import asyncio
+
+
+async def _persist_loop(path):
+    while True:
+        await asyncio.sleep(30)
+
+
+async def serve(path):
+    # the PR 9 cache-server shape: nothing retains the persist task
+    asyncio.get_running_loop().create_task(_persist_loop(path))  # VIOLATION
+
+
+async def publish_prompt(prompt):
+    await asyncio.sleep(0)
+
+
+def publish_bg(prompt):
+    # the PR 9 fake-engine shape: ensure_future result dropped
+    asyncio.ensure_future(publish_prompt(prompt))  # VIOLATION

@@ -1,0 +1,35 @@
+"""GC006 known-violation fixture: a task bound to a local that nothing
+retains — including the exact shipped trap of registering ONLY a
+done-callback (``add_done_callback(tasks.discard)`` without a matching
+``tasks.add(t)`` keeps no strong reference at all)."""
+
+import asyncio
+
+_tasks: set = set()
+
+
+async def work():
+    await asyncio.sleep(0)
+
+
+async def spawn_dead_local():
+    t = asyncio.create_task(work())  # VIOLATION: local never used again
+    del t
+
+
+async def spawn_callback_only():
+    t = asyncio.create_task(work())  # VIOLATION: done-callback retains nothing
+    t.add_done_callback(_tasks.discard)
+
+
+class Runner:
+    def __init__(self):
+        self._task = None
+
+    async def restart(self):
+        t = self._task
+        if t is not None:
+            t.cancel()
+        # VIOLATION: every load of `t` precedes the spawn — they saw the
+        # OLD task; the new one is bound to a dying local (respawn idiom)
+        t = asyncio.create_task(work())

@@ -1,0 +1,51 @@
+"""GC006 known-clean fixture: every repo-blessed retention idiom."""
+
+import asyncio
+
+_abort_tasks: set = set()
+
+
+async def work():
+    await asyncio.sleep(0)
+
+
+class Server:
+    def __init__(self):
+        self._bg = []
+
+    async def start(self):
+        # attribute store (the cache-server fix)
+        self._persist_task = asyncio.get_running_loop().create_task(work())
+        # collection append as a direct argument
+        self._bg.append(asyncio.create_task(work()))
+
+    async def handle(self):
+        # local + add to a module-level strong-ref set (the fake-engine fix)
+        t = asyncio.ensure_future(work())
+        _abort_tasks.add(t)
+        t.add_done_callback(_abort_tasks.discard)
+        # awaited local
+        u = asyncio.create_task(work())
+        await u
+        # comprehension into a gathered local
+        tasks = [asyncio.ensure_future(work()) for _ in range(3)]
+        await asyncio.gather(*tasks)
+        # held across an await then cancelled — the frame is the strong ref
+        log_task = asyncio.create_task(work())
+        await asyncio.sleep(0)
+        log_task.cancel()
+        # returned to the caller (ownership transferred)
+        return asyncio.create_task(work())
+
+    async def grouped(self):
+        async with asyncio.TaskGroup() as tg:  # the group owns its tasks
+            tg.create_task(work())
+
+    async def supervisor(self):
+        # the awaiting load sits BEFORE the spawn textually, but shares the
+        # loop: the next iteration re-reads the freshly bound task
+        t = None
+        while True:
+            if t is not None:
+                await t
+            t = asyncio.create_task(work())

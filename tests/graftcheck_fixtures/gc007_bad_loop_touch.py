@@ -1,0 +1,34 @@
+"""GC007 known-violation fixture: device-thread-owned state touched from
+the event loop — the hazard PR 10's migration review ruled out by hand for
+``engine._frozen`` (every touch must go through ``_run_on_device_thread``)."""
+
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._frozen_seqs = {}  # owned-by: device-thread
+        self._thread = threading.Thread(target=self._run_loop, daemon=True)
+
+    def _run_loop(self):
+        # correct: the owning device thread drains frozen sequences
+        self._frozen_seqs.pop("seq", None)
+
+    async def abort(self, seq_id):
+        # VIOLATION: event-loop handler reaches into device-thread state
+        seq = self._frozen_seqs.pop(seq_id, None)
+        return seq
+
+    def helper(self, seq_id):
+        # unknown context: never flagged (callers decide where this runs)
+        return self._frozen_seqs.get(seq_id)
+
+
+class Manager:
+    def __init__(self, engine):
+        self.engine = engine
+
+    async def status(self):
+        # VIOLATION: cross-file-shaped receiver (engine._frozen_seqs) — the
+        # annotation claims the attribute NAME, not just `self.`
+        return len(self.engine._frozen_seqs)

@@ -1,0 +1,34 @@
+"""GC007 known-violation fixture: event-loop-owned state touched from
+worker-submitted code (executor thunk, to_thread callee, Thread target)."""
+
+import asyncio
+import threading
+
+
+class Directory:
+    def __init__(self):
+        self._claims = {}  # owned-by: event-loop
+        self._ring = []    # owned-by: any
+
+    async def publish(self, k, v):
+        self._claims[k] = v  # correct: the loop is the single writer
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._flush)
+        await asyncio.to_thread(self._spill)
+
+    def _flush(self):
+        # VIOLATION: executor thread mutating loop-owned state
+        self._claims.pop("old", None)
+
+    def _spill(self):
+        # VIOLATION: to_thread callee reading loop-owned state
+        n = len(self._claims)
+        self._ring.append(n)  # owned-by: any — never flagged
+        return n
+
+    def start(self):
+        threading.Thread(target=self._daemon, daemon=True).start()
+
+    def _daemon(self):
+        # VIOLATION: daemon thread writing loop-owned state
+        self._claims["heartbeat"] = 1

@@ -1,0 +1,38 @@
+"""GC007 known-clean fixture: the engine's submission discipline — every
+cross-context touch goes through the owning context's submitter."""
+
+import asyncio
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._frozen_chain = {}  # owned-by: device-thread
+        self._index = {}         # owned-by: event-loop
+        self._counters = []      # owned-by: any
+        # __init__ may seed state for either context: no thread exists yet
+        self._frozen_chain["boot"] = None
+        self._thread = threading.Thread(target=self._run_loop, daemon=True)
+
+    def _run_loop(self):
+        # device thread touching its own state
+        self._frozen_chain.pop("seq", None)
+        self._counters.append(1)
+
+    def _run_on_device_thread(self, fn):
+        return fn()
+
+    async def freeze(self, seq_id):
+        # the PR 10 idiom: marshal device-state work onto the device thread
+        def run():
+            return self._frozen_chain.pop(seq_id, None)
+
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self._run_on_device_thread, run)
+        # loop-owned state touched on the loop: fine
+        self._index[seq_id] = "migrated"
+
+    def helper(self, seq_id):
+        # unknown context is never flagged — submission sites carry the
+        # discipline, and this may run under either
+        return self._frozen_chain.get(seq_id)

@@ -1,0 +1,21 @@
+"""GC008 known-violation fixture: the argument hand-off shape — the access
+sits lexically in the async def (so its GC007 context is "correct"), but
+the loop-owned container itself is shipped into a worker that will iterate
+it while the loop mutates it."""
+
+import asyncio
+import json
+
+
+class Directory:
+    def __init__(self):
+        self._claim_index = {}  # owned-by: event-loop
+
+    async def snapshot(self, path):
+        # VIOLATION: json.dumps runs in a worker over the live dict
+        await asyncio.to_thread(json.dumps, self._claim_index)
+
+    async def dump(self, writer):
+        loop = asyncio.get_running_loop()
+        # VIOLATION: the executor callee receives the loop-owned container
+        await loop.run_in_executor(None, writer.write_all, self._claim_index)

@@ -1,0 +1,25 @@
+"""GC008 known-violation fixture: the PR 9 snapshot crash — loop-owned
+dicts serialized/iterated inside worker-submitted code, dying with
+'dictionary changed size during iteration' on every busy interval."""
+
+import asyncio
+import json
+
+
+class CacheServer:
+    def __init__(self):
+        self._blob_map = {}  # owned-by: event-loop
+
+    async def persist_loop(self, path):
+        while True:
+            await asyncio.sleep(30)
+            # the callee serializes loop-owned dicts OFF the loop
+            await asyncio.to_thread(self._snapshot_to_disk, path)
+
+    def _snapshot_to_disk(self, path):
+        blob = json.dumps(self._blob_map)  # VIOLATION: off-loop serialize
+        for key in self._blob_map:         # VIOLATION: off-loop iteration
+            if key.startswith("tmp"):
+                continue
+        with open(path, "w") as f:
+            f.write(blob)

@@ -1,0 +1,30 @@
+"""GC008 known-clean fixture: the PR 9 fix shape — serialize ON the loop,
+ship only finished bytes off it."""
+
+import asyncio
+import json
+import os
+
+
+class CacheServer:
+    def __init__(self):
+        self._blob_map = {}  # owned-by: event-loop
+
+    def snapshot_blob(self) -> str:
+        # called on the loop (unknown-context helper; its callers are the
+        # async persist loop below — the loop is the single writer, so
+        # iterating here is safe)
+        return json.dumps(self._blob_map)
+
+    @staticmethod
+    def write_snapshot(path, blob):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    async def persist_loop(self, path):
+        while True:
+            await asyncio.sleep(30)
+            blob = self.snapshot_blob()          # serialize on the loop
+            await asyncio.to_thread(self.write_snapshot, path, blob)  # bytes off

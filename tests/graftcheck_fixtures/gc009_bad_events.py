@@ -1,0 +1,33 @@
+"""GC009 known-violation fixture: SSE control-event key drift — the
+producer writes {"target": ...} but the splice reads "dest" (unproduced),
+and the producer's "pages" field is consumed by nobody."""
+
+import json
+
+MIGRATION_MARKER = b'data: {"test_migration"'
+
+
+class Producer:
+    def __init__(self):
+        self._migrated_out = {}
+
+    def note(self, rid, target):
+        # the api_server indirection: the event dict is built here and
+        # emitted later through send({type_key: mi})
+        self._migrated_out[rid] = {
+            "target": target, "request_id": rid, "pages": 4,
+        }
+
+    async def send_event(self, send, mi):
+        await send({"test_migration": mi})
+
+
+class Splice:
+    def parse(self, payload):
+        event = json.loads(payload)["test_migration"]
+        return event
+
+    async def attach(self, event):
+        dest = event.get("dest")          # VIOLATION: nobody produces "dest"
+        rid = event.get("request_id")
+        return dest, rid

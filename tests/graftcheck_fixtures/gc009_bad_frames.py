@@ -1,0 +1,36 @@
+"""GC009 known-violation fixture: frame-op drift in both directions — a
+client op no server dispatches on ('bad op' at runtime), and a server op
+no client ever sends (dead protocol)."""
+
+
+class Server:
+    async def handle(self, hdr, writer):
+        op = hdr.get("op")
+        if op == "put":
+            pass
+        elif op == "get":
+            pass
+        elif op == "dir_publish":
+            pass
+        elif op == "dir_compact":  # VIOLATION: no client sends dir_compact
+            pass
+        else:
+            await writer.send({"ok": False, "error": f"bad op {op!r}"})
+
+
+class Client:
+    def put(self, key):
+        return self.request({"op": "put", "key": key})
+
+    def get(self, key):
+        return self.request({"op": "get", "key": key})
+
+    def publish(self, entries):
+        return self.request({"op": "dir_publish", "entries": entries})
+
+    def withdraw(self, hashes):
+        # VIOLATION: no server dispatches on dir_retract
+        return self.request({"op": "dir_retract", "hashes": hashes})
+
+    def request(self, hdr):
+        return hdr

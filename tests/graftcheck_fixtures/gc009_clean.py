@@ -1,0 +1,47 @@
+"""GC009 known-clean fixture: frame ops and control-event keys agree on
+both sides, and the snapshot-style doc round-trips key-for-key."""
+
+import json
+
+MIGRATION_MARKER = b'data: {"test_migration"'
+
+
+class Server:
+    async def handle(self, hdr, writer):
+        op = hdr.get("op")
+        if op == "put":
+            pass
+        elif op == "dir_publish":
+            pass
+        else:
+            await writer.send({"ok": False, "error": f"bad op {op!r}"})
+
+
+class Client:
+    def put(self, key):
+        return self.request({"op": "put", "key": key})
+
+    def publish(self, entries):
+        return self.request({"op": "dir_publish", "entries": entries})
+
+    def request(self, hdr):
+        return hdr
+
+
+class Producer:
+    def __init__(self):
+        self._migrated_out = {}
+
+    def note(self, rid, target):
+        self._migrated_out[rid] = {"target": target, "request_id": rid}
+
+    async def send_event(self, send, mi):
+        await send({"test_migration": mi})
+
+
+class Splice:
+    def parse(self, payload):
+        return json.loads(payload)["test_migration"]
+
+    async def attach(self, event):
+        return event.get("target"), event.get("request_id")

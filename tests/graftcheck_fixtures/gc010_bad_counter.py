@@ -1,0 +1,32 @@
+"""GC010 known-violation fixture: counter/gauge typing and monotonicity
+abuse — a decremented *_total, a counter without _total, a gauge named
+_total, and one family declared two TYPEs."""
+
+
+class Metrics:
+    def __init__(self):
+        self.sheds = 0
+        self.active = 0
+
+    def shed(self):
+        self.sheds += 1
+        self.active += 1
+
+    def undo_shed(self):
+        self.sheds -= 1  # VIOLATION: counters only go up
+
+    def render(self):
+        return [
+            "# TYPE vllm:sheds_total counter",
+            f"vllm:sheds_total {self.sheds}",
+            "# TYPE vllm:shed_events counter",      # VIOLATION: no _total
+            f"vllm:shed_events {self.sheds}",
+            "# TYPE vllm:active_total gauge",       # VIOLATION: gauge *_total
+            f"vllm:active_total {self.active}",
+        ]
+
+
+class OtherSurface:
+    def render(self):
+        # VIOLATION: same family, different TYPE than above
+        return ["# TYPE vllm:sheds_total gauge"]

@@ -1,0 +1,32 @@
+"""GC010 known-violation fixture: label-keyset drift, interpolated label
+keys, an inc-only gauge, and a per-call Histogram construction."""
+
+from production_stack_tpu.utils.metrics import Histogram
+
+
+class Metrics:
+    def __init__(self):
+        self.pulls = 0
+
+    def note_pull(self):
+        self.pulls += 1  # only ever incremented...
+
+    def observe(self, ms):
+        # VIOLATION: a fresh family per call loses history between scrapes
+        h = Histogram("vllm:pull_seconds", (0.1, 1.0))
+        h.observe(ms)
+        return h
+
+    def render(self, model, key):
+        return [
+            "# TYPE vllm:kv_pulls gauge",
+            # VIOLATION (inc-only gauge): .pulls backs a gauge but behaves
+            # as a counter
+            f"vllm:kv_pulls {self.pulls}",
+            "# TYPE vllm:pull_rounds_total counter",
+            # VIOLATION (label drift): model= here, model_name= below
+            f'vllm:pull_rounds_total{{model="{model}"}} {self.pulls}',
+            f'vllm:pull_rounds_total{{model_name="{model}"}} {self.pulls}',
+            # VIOLATION (dynamic label key): the KEY is interpolated
+            f'vllm:pull_tagged_total{{{key}="x"}} {self.pulls}',
+        ]

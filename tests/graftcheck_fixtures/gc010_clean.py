@@ -1,0 +1,32 @@
+"""GC010 known-clean fixture: the repo's blessed metric idioms — literal
+TYPE lines, _total counters, assigned gauges, one construct site, and the
+prebuilt-label-string interpolation (opaque block, audited at build site)."""
+
+from production_stack_tpu.utils.metrics import Histogram
+
+
+class Metrics:
+    def __init__(self):
+        self.sheds = 0
+        self.saturation = 0.0
+        self.hist = Histogram("vllm:fixture_seconds", (0.1, 1.0))
+
+    def shed(self):
+        self.sheds += 1
+
+    def tick(self, value):
+        self.saturation = value  # level-valued: a real gauge
+
+    def reset(self):
+        self.sheds = 0  # reset-to-zero in reset* is initialization, not abuse
+
+    def render(self, model):
+        labels = f'model_name="{model}"'
+        return [
+            "# TYPE vllm:fixture_sheds_total counter",
+            f"vllm:fixture_sheds_total{{{labels}}} {self.sheds}",
+            f'vllm:fixture_sheds_total{{{labels},reason="overload"}} '
+            f"{self.sheds}",
+            "# TYPE vllm:fixture_saturation gauge",
+            f"vllm:fixture_saturation {round(self.saturation, 4)}",
+        ]

@@ -48,6 +48,8 @@ def test_doc_flags_exist():
         "--kubeconfig", "--dry-run", "--image", "--tag", "--push", "--file",
         "--output", "--rm", "--overrides", "--local-dir", "--pool",
         "--enable-autoscaling",
+        # git flags quoted when documenting graftcheck --changed
+        "--porcelain",
         # reference vLLM flags, quoted when contrasting with our design
         "--distributed-executor-backend", "--enable-auto-tool-choice",
         # pytest flags quoted in the README dev section

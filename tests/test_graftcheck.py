@@ -1,14 +1,19 @@
-"""Tier-1 wiring for scripts/graftcheck: the four hazard checkers + the
+"""Tier-1 wiring for scripts/graftcheck: the nine hazard checkers + the
 endpoint-parity guard must (a) pass over the real tree with zero
 unsuppressed, un-baselined findings, and (b) provably FIRE — every rule has
 known-violation fixtures (tests/graftcheck_fixtures/) whose expected
 findings are asserted one by one, so deleting any fixture violation (or a
-checker silently rotting into a no-op) fails here."""
+checker silently rotting into a no-op) fails here. The historical tests
+additionally reconstruct each v2 rule's real shipped bug from the git
+archive of the PR that fixed it and assert the checker reproduces it."""
 
 import json
 import os
 import pathlib
+import subprocess
 import sys
+
+import pytest
 
 sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir, "scripts")
@@ -20,6 +25,11 @@ from graftcheck import (  # noqa: E402
     gc003_tracer,
     gc004_locks,
     gc005_endpoints,
+    gc006_tasks,
+    gc007_ownership,
+    gc008_offloop,
+    gc009_wire,
+    gc010_metrics,
 )
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
@@ -30,6 +40,10 @@ CHECKERS = {
     "GC002": gc002_donation,
     "GC003": gc003_tracer,
     "GC004": gc004_locks,
+    "GC006": gc006_tasks,
+    "GC007": gc007_ownership,
+    "GC008": gc008_offloop,
+    "GC010": gc010_metrics,
 }
 
 
@@ -66,7 +80,9 @@ def test_known_suppressions_and_baseline_are_exercised():
     refactor removes the hazard, run_graftcheck reports the stale silencer
     and the previous test fails; this one documents the expected counts."""
     _, stats = core.run_graftcheck()
-    assert stats["suppressed"] >= 1     # flightrecorder.dump_async pre-check
+    # flightrecorder.dump_async pre-check (GC004) + the KV controller's
+    # reference-parity query_inst op (GC009)
+    assert stats["suppressed"] >= 2
     assert stats["baselined"] >= 1      # TieredKVStore.get miss counter
     assert stats["raw_findings"] == stats["suppressed"] + stats["baselined"]
 
@@ -307,3 +323,546 @@ def test_cli_passes_on_the_tree():
     )
     assert out.returncode == 0, out.stdout + out.stderr
     assert "GRAFTCHECK PASSED" in out.stdout
+
+
+# -- GC006 asyncio task lifetime ----------------------------------------------
+
+def test_gc006_fire_and_forget_fires():
+    v, _ = _run_on_fixture(gc006_tasks, "gc006_bad_fireforget.py")
+    details = _details(v, "GC006")
+    # the two PR 9 shapes: bare create_task (persist loop) + bare
+    # ensure_future (fake-engine publish)
+    assert details == ["unretained:_persist_loop", "unretained:publish_prompt"]
+
+
+def test_gc006_dead_local_fires():
+    v, _ = _run_on_fixture(gc006_tasks, "gc006_bad_local.py")
+    details = _details(v, "GC006")
+    assert details == ["unretained:work"] * 3
+    scopes = sorted(f.scope for f in v)
+    # Runner.restart is the respawn idiom: t.cancel() loads the OLD task
+    # before the spawn rebinds the name — position-aware liveness sees it
+    assert scopes == ["Runner.restart", "spawn_callback_only",
+                      "spawn_dead_local"]
+
+
+def test_gc006_clean_is_quiet():
+    v, _ = _run_on_fixture(gc006_tasks, "gc006_clean.py")
+    assert not v, [f.render() for f in v]
+
+
+# -- GC007 thread-ownership discipline ----------------------------------------
+
+def test_gc007_event_loop_touch_fires():
+    v, _ = _run_on_fixture(gc007_ownership, "gc007_bad_loop_touch.py")
+    details = _details(v, "GC007")
+    # the async abort handler AND the cross-receiver (engine._frozen_seqs)
+    # touch — the annotation claims the attribute name, not just `self.`
+    assert details == [
+        "off-context:_frozen_seqs@event-loop",
+        "off-context:_frozen_seqs@event-loop",
+    ]
+    assert sorted(f.scope for f in v) == ["Engine.abort", "Manager.status"]
+
+
+def test_gc007_worker_touch_fires():
+    v, _ = _run_on_fixture(gc007_ownership, "gc007_bad_thread_touch.py")
+    details = _details(v, "GC007")
+    assert details == ["off-context:_claims@device-thread"] * 3
+    # executor thunk, to_thread callee, and Thread target all inferred
+    assert sorted(f.scope for f in v) == [
+        "Directory._daemon", "Directory._flush", "Directory._spill",
+    ]
+
+
+def test_gc007_clean_is_quiet():
+    v, _ = _run_on_fixture(gc007_ownership, "gc007_clean.py")
+    assert not v, [f.render() for f in v]
+
+
+def test_gc007_conflicting_annotations_keep_local_checking(tmp_path):
+    # a stray conflicting annotation elsewhere must not silently un-guard
+    # the declaring file: self-file accesses fall back to the LOCAL claim,
+    # only the cross-file check drops the ambiguous name
+    (tmp_path / "a.py").write_text(
+        "class Cache:\n"
+        "    def __init__(self):\n"
+        "        self._store = {}  # owned-by: device-thread\n"
+        "\n"
+        "    async def abort(self):\n"
+        "        return self._store.pop('k', None)\n"
+    )
+    (tmp_path / "b.py").write_text(
+        "class Other:\n"
+        "    def __init__(self):\n"
+        "        self._store = {}  # owned-by: event-loop\n"
+        "\n"
+        "    async def read(self):\n"
+        "        return len(self._store)\n"
+    )
+    (tmp_path / "c.py").write_text(
+        "async def peek(cache):\n"
+        "    return cache._store\n"
+    )
+    index = core.RepoIndex(repo=tmp_path, roots=("a.py", "b.py", "c.py"))
+    v = gc007_ownership.check(index)
+    assert [(f.path, f.detail) for f in v] == [
+        ("a.py", "off-context:_store@event-loop")
+    ]
+
+
+# -- GC008 off-context iteration/serialization --------------------------------
+
+def test_gc008_offloop_serialize_fires():
+    v, _ = _run_on_fixture(gc008_offloop, "gc008_bad_serialize.py")
+    details = _details(v, "GC008")
+    # json.dumps + for-loop inside the to_thread callee
+    assert details == ["offloop-iter:_blob_map", "offloop-iter:_blob_map"]
+
+
+def test_gc008_arg_handoff_fires():
+    v, _ = _run_on_fixture(gc008_offloop, "gc008_bad_args.py")
+    details = _details(v, "GC008")
+    assert details == ["offloop-arg:_claim_index", "offloop-arg:_claim_index"]
+
+
+def test_gc008_clean_is_quiet():
+    v, _ = _run_on_fixture(gc008_offloop, "gc008_clean.py")
+    assert not v, [f.render() for f in v]
+
+
+def test_gc008_nested_def_does_not_shadow_method(tmp_path):
+    # a nested def sharing a method's name must not hijack the
+    # self._flush submission resolution (methods and module-level defs
+    # only in the resolution table)
+    (tmp_path / "d.py").write_text(
+        "import asyncio\n"
+        "\n"
+        "class D:\n"
+        "    def __init__(self):\n"
+        "        self._claims = {}  # owned-by: event-loop\n"
+        "\n"
+        "    def _flush(self):\n"
+        "        for k in self._claims:\n"
+        "            print(k)\n"
+        "\n"
+        "    async def run(self):\n"
+        "        await asyncio.to_thread(self._flush)\n"
+        "\n"
+        "    async def other(self):\n"
+        "        def _flush():\n"
+        "            return 1\n"
+        "        return _flush()\n"
+    )
+    index = core.RepoIndex(repo=tmp_path, roots=("d.py",))
+    v = gc008_offloop.check(index)
+    assert [f.detail for f in v] == ["offloop-iter:_claims"], [
+        f.render() for f in v
+    ]
+
+
+# -- GC009 wire-contract parity -----------------------------------------------
+
+def _fixture_pf(name):
+    return core.PyFile(FIXTURES / name, FIXTURES)
+
+
+def test_gc009_frame_op_drift_fires_both_directions():
+    pf = _fixture_pf("gc009_bad_frames.py")
+    details = sorted(f.detail for f in gc009_wire.check_frames([pf], [pf]))
+    assert details == ["unconsumed-op:dir_compact", "undeclared-op:dir_retract"]
+
+
+def test_gc009_event_key_drift_fires():
+    pf = _fixture_pf("gc009_bad_events.py")
+    details = sorted(f.detail for f in gc009_wire.check_events([pf], pf))
+    assert details == [
+        "event-key-unconsumed:pages",
+        "event-key-unconsumed:target",
+        "event-key-unproduced:dest",
+    ]
+
+
+def test_gc009_clean_is_quiet():
+    pf = _fixture_pf("gc009_clean.py")
+    assert gc009_wire.check_frames([pf], [pf]) == []
+    assert gc009_wire.check_events([pf], pf) == []
+
+
+def test_gc009_real_surfaces_extract():
+    """Extraction liveness over the real tree (the GC005 pattern): a
+    refactor that empties a table must fail here, not silently turn the
+    parity rule into a vacuous pass."""
+    index = core.RepoIndex()
+    cache = index.get("production_stack_tpu/kvoffload/cache_server.py")
+    handled = gc009_wire.extract_handled_ops(cache)
+    assert {"put", "get", "dir_publish", "dir_lookup",
+            "dir_top_prefixes"} <= set(handled)
+    sent = gc009_wire.extract_sent_ops(index.files)
+    assert {"put", "get", "dir_publish", "dir_lookup_hashes",
+            "dir_top_prefixes"} <= set(sent)
+    consumer = index.get(gc009_wire.EVENT_CONSUMER_FILE)
+    type_key, consumed, _ = gc009_wire.extract_event_consumer(consumer)
+    assert type_key == "pstpu_migration"
+    assert {"target", "request_id"} <= consumed
+    producers = [index.get(p) for p in gc009_wire.EVENT_PRODUCER_FILES]
+    produced, sites = gc009_wire.extract_event_producers(producers, type_key)
+    assert {"target", "request_id"} <= produced
+    assert len(sites) == 2  # api_server AND fake_engine both emit it
+    prod_meta, cons_meta = gc009_wire.extract_meta_keys(
+        producers, [index.get(p) for p in gc009_wire.META_CONSUMER_FILES]
+    )
+    assert {"oid", "chat", "created", "model", "prompt_tokens",
+            "request_id", "prior_completion"} <= prod_meta
+    assert prod_meta == cons_meta  # the acceptance-criteria identity
+    snap_prod, snap_cons, _ = gc009_wire.extract_snapshot_keys(
+        index.get(gc009_wire.STATE_FILE)
+    )
+    assert {"tokens", "page_hashes", "params", "meta"} <= snap_prod
+    assert snap_prod == snap_cons
+
+
+def test_gc009_snapshot_drift_fires(tmp_path):
+    _write(tmp_path, "state.py", (
+        "import dataclasses\n"
+        "@dataclasses.dataclass\n"
+        "class SequenceSnapshot:\n"
+        "    tokens: list\n"
+        "    prompt_len: int\n"
+        "    def to_doc(self):\n"
+        "        return {'format': 1, **dataclasses.asdict(self)}\n"
+        "    @staticmethod\n"
+        "    def from_doc(doc):\n"
+        "        return SequenceSnapshot(doc['tokens'], doc['position'])\n"
+    ))
+    pf = core.PyFile(tmp_path / "state.py", tmp_path)
+    details = sorted(f.detail for f in gc009_wire.check_snapshot(pf))
+    assert details == [
+        "snapshot-unconsumed:format",       # from_doc never checks it
+        "snapshot-unconsumed:prompt_len",   # renamed on one side...
+        "snapshot-unproduced:position",     # ...is drift on both
+    ]
+
+
+# -- GC010 metric discipline ---------------------------------------------------
+
+def test_gc010_counter_abuse_fires():
+    v, _ = _run_on_fixture(gc010_metrics, "gc010_bad_counter.py")
+    details = _details(v, "GC010")
+    assert details == [
+        "counter-decrement:vllm:shed_events:sheds",
+        "counter-name:vllm:shed_events",
+        "gauge-name:vllm:active_total",
+        "inc-only-gauge:vllm:active_total:active",
+        "type-conflict:vllm:sheds_total",
+    ]
+
+
+def test_gc010_label_and_construction_abuse_fires():
+    v, _ = _run_on_fixture(gc010_metrics, "gc010_bad_labels.py")
+    details = _details(v, "GC010")
+    assert details == [
+        "construct-in-function:Histogram",
+        "dynamic-label-key:vllm:pull_tagged_total",
+        "inc-only-gauge:vllm:kv_pulls:pulls",
+        "label-drift:vllm:pull_rounds_total",
+    ]
+
+
+def test_gc010_clean_is_quiet():
+    v, _ = _run_on_fixture(gc010_metrics, "gc010_clean.py")
+    assert not v, [f.render() for f in v]
+
+
+def test_gc010_inc_only_gauge_deduped_across_sample_sites(tmp_path):
+    # a gauge rendered at two sample sites backs ONE defect — duplicate
+    # findings would double-count against the baseline hygiene accounting
+    (tmp_path / "m.py").write_text(
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self.active = 0\n"
+        "\n"
+        "    def bump(self):\n"
+        "        self.active += 1\n"
+        "\n"
+        "    def render(self):\n"
+        "        return [\n"
+        "            '# TYPE vllm:active gauge',\n"
+        "            f'vllm:active {self.active}',\n"
+        "        ]\n"
+        "\n"
+        "    def render_again(self):\n"
+        "        return [f'vllm:active {self.active}']\n"
+    )
+    index = core.RepoIndex(repo=tmp_path, roots=("m.py",))
+    v = gc010_metrics.check(index)
+    assert [f.detail for f in v] == ["inc-only-gauge:vllm:active:active"], [
+        f.render() for f in v
+    ]
+
+
+def test_gc010_real_surfaces_extract():
+    """The real tree's literal TYPE declarations and backed samples must
+    keep being visible, or GC010 is a vacuous pass."""
+    index = core.RepoIndex()
+    decls = {}
+    samples = 0
+    stats_backings = 0
+    for pf in index.files:
+        t, s, st = gc010_metrics._scan_file(pf)
+        for name, kind, _line in t:
+            decls[name] = kind
+        samples += len(s)
+        stats_backings += len(st)
+    assert decls.get("vllm_router:retries_total") == "counter"
+    assert decls.get("vllm_router:fleet_saturation") == "gauge"
+    assert decls.get("vllm:fleet_controller_migrations_started_total") == "counter"
+    assert len(decls) >= 30
+    assert samples >= 40
+    assert stats_backings >= 20
+
+
+# -- historical verification: each v2 rule reproduces its shipped bug ----------
+#
+# The review closures landed inside the PRs, so the ARCHIVED trees are the
+# fixed shapes: each test (a) asserts the shipped archive is clean under
+# today's rule, then (b) reverts exactly the shipped fix (or injects
+# today's annotation into yesterday's code) and asserts the rule fires
+# with the historical bug's shape.
+
+PR9_SHA = "f80a058"   # fleet-wide KV directory (task-GC + off-loop serialize)
+PR10_SHA = "7dbfa3d"  # live migration (ownership + wire contract)
+
+
+def _git_show(sha, path):
+    out = subprocess.run(
+        ["git", "-C", str(REPO), "show", f"{sha}:{path}"],
+        capture_output=True, text=True,
+    )
+    if out.returncode != 0:
+        pytest.skip(f"git archive unavailable for {sha}:{path}")
+    return out.stdout
+
+
+def _index_of(tmp_path, **files):
+    for name, text in files.items():
+        (tmp_path / f"{name}.py").write_text(text)
+    roots = tuple(f"{n}.py" for n in files)
+    return core.RepoIndex(repo=tmp_path, roots=roots)
+
+
+def test_historical_gc006_pr9_persist_task_gc(tmp_path):
+    """PR 9 shipped the cache server's persist loop as a strong-ref'd task
+    only after review; the pre-fix shape was a bare create_task the loop's
+    weak ref let GC kill — directory persistence silently stopped."""
+    fixed = _git_show(PR9_SHA, "production_stack_tpu/kvoffload/cache_server.py")
+    assert "cs._persist_task = asyncio.get_running_loop().create_task(" in fixed
+    idx = _index_of(tmp_path, cache_server=fixed)
+    assert not gc006_tasks.check(idx), "shipped fix must be clean"
+    prefix = _index_of(
+        tmp_path,
+        cache_server_prefix=fixed.replace(
+            "cs._persist_task = asyncio.get_running_loop().create_task(",
+            "asyncio.get_running_loop().create_task(",
+        ),
+    )
+    details = [f.detail for f in gc006_tasks.check(prefix)]
+    assert details == ["unretained:_persist_loop"]
+
+
+def test_historical_gc008_pr9_offloop_serialize(tmp_path):
+    """PR 9's snapshot crash: serialization ran inside asyncio.to_thread
+    over dicts the event loop kept mutating. With today's owned-by
+    annotation applied to yesterday's code, handing the live container to
+    the worker fires; the shipped serialize-on-loop shape stays quiet."""
+    fixed = _git_show(PR9_SHA, "production_stack_tpu/kvoffload/cache_server.py")
+    annotated = fixed.replace(
+        "self._data: OrderedDict[str, bytes] = OrderedDict()",
+        "self._data: OrderedDict[str, bytes] = OrderedDict()"
+        "  # owned-by: event-loop",
+    )
+    assert annotated != fixed
+    idx = _index_of(tmp_path, cache_server=annotated)
+    assert not gc008_offloop.check(idx), "shipped fix must be clean"
+    pre_fix = annotated.replace(
+        "await asyncio.to_thread(cs.write_snapshot, path, blob)",
+        "await asyncio.to_thread(cs.write_snapshot, path, cs._data)",
+    )
+    assert pre_fix != annotated
+    bad = _index_of(tmp_path, cache_server_bad=pre_fix)
+    details = [f.detail for f in gc008_offloop.check(bad)]
+    assert details == ["offloop-arg:_data"]
+
+
+def test_historical_gc007_pr10_frozen_ownership(tmp_path):
+    """PR 10's review verified by hand that `_frozen` is device-thread-only
+    (every touch via _run_on_device_thread). Annotating the archived engine
+    confirms the shipped discipline holds, and an event-loop touch — the
+    refactor hazard the review feared — fires."""
+    engine = _git_show(PR10_SHA, "production_stack_tpu/engine/engine.py")
+    manager = _git_show(PR10_SHA, "production_stack_tpu/migration/manager.py")
+    annotated = engine.replace(
+        "self._frozen: dict[str, Sequence] = {}",
+        "self._frozen: dict[str, Sequence] = {}  # owned-by: device-thread",
+    )
+    assert annotated != engine
+    idx = _index_of(tmp_path, engine=annotated, manager=manager)
+    assert not gc007_ownership.check(idx), (
+        "the shipped device-thread discipline must hold under GC007"
+    )
+    hazard = annotated + (
+        "\n\nasync def bad_abort(engine, seq_id):\n"
+        "    return engine._frozen.pop(seq_id, None)\n"
+    )
+    bad = _index_of(tmp_path, engine_bad=hazard, manager2=manager)
+    details = [f.detail for f in gc007_ownership.check(bad)]
+    assert details == ["off-context:_frozen@event-loop"]
+
+
+def test_historical_gc009_pr10_wire_contract(tmp_path):
+    """PR 10's marker/wire shapes: the archived producer/consumer surfaces
+    agree key-for-key, and reverting one side (the splice reading 'dest'
+    instead of 'target', a client renaming a frame op) fires."""
+    api = _git_show(PR10_SHA, "production_stack_tpu/engine/api_server.py")
+    fake = _git_show(PR10_SHA, "production_stack_tpu/testing/fake_engine.py")
+    rs = _git_show(PR10_SHA, "production_stack_tpu/router/request_service.py")
+    cache = _git_show(PR10_SHA, "production_stack_tpu/kvoffload/cache_server.py")
+    client = _git_show(PR10_SHA, "production_stack_tpu/kvdirectory/client.py")
+
+    def pf(text):
+        p = tmp_path / f"f{abs(hash(text)) % 10**8}.py"
+        p.write_text(text)
+        return core.PyFile(p, tmp_path)
+
+    api_pf, fake_pf, rs_pf = pf(api), pf(fake), pf(rs)
+    # (a) the shipped archive holds the contract
+    assert gc009_wire.check_events([api_pf, fake_pf], rs_pf) == []
+    type_key, consumed, _ = gc009_wire.extract_event_consumer(rs_pf)
+    assert type_key == "pstpu_migration"
+    assert {"target", "request_id"} <= consumed
+    # (b) consumer-side drift: the splice reads a key nobody produces
+    drifted = pf(rs.replace('event.get("target")', 'event.get("dest")'))
+    details = sorted(
+        f.detail for f in gc009_wire.check_events([api_pf, fake_pf], drifted)
+    )
+    assert "event-key-unproduced:dest" in details
+    assert "event-key-unconsumed:target" in details
+    # (c) frame-op drift: a client renames an op the server still handles
+    cache_pf, client_pf = pf(cache), pf(client)
+    clients = [client_pf, fake_pf, api_pf]
+    ok = gc009_wire.check_frames([cache_pf], clients)
+    assert not [f for f in ok if f.detail.startswith("undeclared-op:dir_")]
+    renamed = pf(client.replace('"op": "dir_withdraw"', '"op": "dir_retract"'))
+    bad = gc009_wire.check_frames([cache_pf], [renamed, fake_pf, api_pf])
+    details = sorted(f.detail for f in bad)
+    assert "undeclared-op:dir_retract" in details
+    assert "unconsumed-op:dir_withdraw" in details
+
+
+def test_historical_gc010_pr10_counter_discipline(tmp_path):
+    """The fleet controller's counters are the newest metric surface; the
+    archived rendering is clean under GC010, and decrementing a *_total
+    backing attribute — the misuse class GC010 encodes — fires."""
+    ctl = _git_show(PR10_SHA, "production_stack_tpu/migration/controller.py")
+    idx = _index_of(tmp_path, controller=ctl)
+    assert not gc010_metrics.check(idx), "shipped metrics must be clean"
+    hazard = ctl + (
+        "\n\nclass _Regression(FleetController):\n"
+        "    def undo(self):\n"
+        "        self.migrations_started -= 1\n"
+    )
+    bad = _index_of(tmp_path, controller_bad=hazard)
+    details = [f.detail for f in gc010_metrics.check(bad)]
+    assert details == [
+        "counter-decrement:vllm:fleet_controller_migrations_started_total:"
+        "migrations_started",
+    ]
+
+
+# -- incremental (--changed) mode ----------------------------------------------
+
+def test_changed_paths_reads_git_status(tmp_path):
+    out = subprocess.run(["git", "init", "-q", str(tmp_path)],
+                         capture_output=True, text=True)
+    if out.returncode != 0:
+        pytest.skip("git unavailable")
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    (sub / "m2.py").write_text("y = 2\n")
+    changed = core.changed_paths(tmp_path)
+    assert changed == {"mod.py", "pkg/m2.py"}
+    subprocess.run(["git", "-C", str(tmp_path), "add", "-A"],
+                   capture_output=True)
+    assert core.changed_paths(tmp_path) == {"mod.py", "pkg/m2.py"}  # staged
+
+
+def test_changed_paths_none_without_git(tmp_path):
+    # not a git repository -> None -> callers fall back to the full tree
+    assert core.changed_paths(tmp_path) is None
+
+
+def test_filter_changed_keeps_contract_rules():
+    mk = core.Finding
+    vs = [
+        mk("GC001", "a.py", 1, "h", "time.sleep", "m"),
+        mk("GC001", "b.py", 1, "h", "open", "m"),
+        mk("GC009", "c.py", 1, "<frames>", "undeclared-op:x", "m"),
+        mk("GC005", "d.py", 1, "<routes>", "fake-missing:/x", "m"),
+        mk("GC-BASELINE", "scripts/graftcheck/baseline.json", 0, "<baseline>",
+           "k", "m"),
+    ]
+    out = core.filter_changed(vs, {"a.py"})
+    # a.py finding kept, b.py dropped; contract rules ALWAYS kept (the
+    # drift may sit on the unchanged side); baseline rot only when the
+    # baseline file itself changed
+    assert [f.rule for f in out] == ["GC001", "GC009", "GC005"]
+    assert out[0].path == "a.py"
+    out2 = core.filter_changed(vs, {"scripts/graftcheck/baseline.json"})
+    assert "GC-BASELINE" in [f.rule for f in out2]
+
+
+def test_cli_changed_mode_runs():
+    out = subprocess.run(
+        [sys.executable, "-m", "scripts.graftcheck", "--changed"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    # whatever the working tree looks like, the changed view of a tree
+    # whose FULL run passes must pass too
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "GRAFTCHECK PASSED" in out.stdout
+
+
+# -- SARIF output ---------------------------------------------------------------
+
+def test_sarif_rendering_shape():
+    from graftcheck.sarif import render_sarif
+
+    f = core.Finding("GC006", "production_stack_tpu/x.py", 12, "Cls.fn",
+                     "unretained:worker", "task dropped")
+    doc = json.loads(render_sarif([f], {"files": 1}))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"GC001", "GC006", "GC010"} <= rule_ids
+    res = run["results"][0]
+    assert res["ruleId"] == "GC006"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "production_stack_tpu/x.py"
+    assert loc["region"]["startLine"] == 12
+    # the line-independent key rides partialFingerprints so GitHub tracks
+    # findings across rebases exactly like baseline.json does
+    assert res["partialFingerprints"]["graftcheckKey/v1"] == f.key
+
+
+def test_cli_sarif_on_the_tree(tmp_path):
+    sarif_path = tmp_path / "graftcheck.sarif"
+    out = subprocess.run(
+        [sys.executable, "-m", "scripts.graftcheck",
+         "--format", "sarif", "--output", str(sarif_path)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(sarif_path.read_text())
+    assert doc["runs"][0]["results"] == []  # clean tree -> no results
+    assert "GRAFTCHECK PASSED" in out.stdout  # human summary still printed
