@@ -259,6 +259,7 @@ def main() -> None:
     }
     extras.update(lc_metrics)
     extras.update(http_stack_metrics(on_tpu, model_dir))
+    extras.update(tp_engine_metrics(on_tpu))
 
     qa_p50 = extras.get("qa_p50_ttft_ms")
     if qa_p50:
@@ -280,6 +281,125 @@ def main() -> None:
             "extras": extras,
         }
     emit_primary(primary)
+
+
+def tp_engine_metrics(on_tpu: bool) -> dict:
+    """Tensor-parallel SERVING phase (ISSUE 12): the same HTTP llama path as
+    the stack phases, served by engines at tp=1 vs tp=2/4 — decode and
+    prefill tok/s per shape (``http_decode_tokens_per_sec_tp{N}`` /
+    ``http_prefill_tokens_per_sec_tp{N}``). Runs only when the backend
+    exposes >= 2 devices (a TPU slice, or the virtual CPU mesh tests/CI
+    provision); a single-chip run records nothing, and update_bench_docs
+    renders the rows conditionally. Fail-soft like the stack phases."""
+    import asyncio
+    import threading
+
+    out: dict = {}
+    try:
+        import concurrent.futures as cf
+
+        import requests
+
+        from production_stack_tpu.engine import api_server as engine_api
+        from production_stack_tpu.engine.config import EngineConfig
+        from production_stack_tpu.testing.procs import free_port
+
+        n_dev = len(jax.devices())
+        tps = [1] + [t for t in (2, 4) if t <= n_dev]
+        if len(tps) == 1:
+            return out
+        # flagship on TPU slices (8 kv heads shard over tp in {2, 4});
+        # the tp-shardable debug twin on the virtual CPU mesh
+        model = "llama-3.2-1b" if on_tpu else "llama-debug-4kv"
+        plen, gen, conc, n_pre = (1024, 64, 8, 6) if on_tpu else (64, 16, 4, 3)
+        prompt_words = "tensor parallel serving phase " * (plen // 30)
+
+        for tp in tps:
+            loop = asyncio.new_event_loop()
+            thread = threading.Thread(target=loop.run_forever, daemon=True)
+            thread.start()
+            server = runner = None
+            try:
+                port = free_port()
+                cfg = EngineConfig(
+                    model=model, host="127.0.0.1", port=port,
+                    tensor_parallel_size=tp,
+                    max_model_len=4096 if on_tpu else 512,
+                    max_num_seqs=max(conc, 8), prefill_chunk=plen,
+                    num_pages=None if on_tpu else 256,
+                )
+                server, runner = asyncio.run_coroutine_threadsafe(
+                    engine_api.serve(cfg), loop
+                ).result(600)
+                url = f"http://127.0.0.1:{port}/v1/completions"
+                # one Session per worker thread: requests.Session is not
+                # thread-safe, and the decode sub-phase posts concurrently
+                # (same pattern as http_stack_metrics' http_session)
+                tls = threading.local()
+
+                def one(max_tokens, prompt):
+                    sess = getattr(tls, "session", None)
+                    if sess is None:
+                        sess = tls.session = requests.Session()
+                    r = sess.post(url, json={
+                        "model": model, "prompt": prompt,
+                        "max_tokens": max_tokens, "temperature": 0.0,
+                        "ignore_eos": True,
+                    }, timeout=600)
+                    r.raise_for_status()
+                    return r.json()["usage"]
+
+                # prefill: fresh non-cacheable prompts, 1 gen token each
+                one(1, f"warm {prompt_words}")
+                t0 = time.perf_counter()
+                toks = sum(
+                    one(1, f"p{i} {prompt_words}")["prompt_tokens"]
+                    for i in range(n_pre)
+                )
+                out[f"http_prefill_tokens_per_sec_tp{tp}"] = round(
+                    toks / (time.perf_counter() - t0), 1
+                )
+                # decode: concurrent short-prompt generations at steady state
+                with cf.ThreadPoolExecutor(max_workers=conc) as pool:
+                    list(pool.map(
+                        lambda i: one(gen, f"warmup {i}"), range(conc)
+                    ))
+                    t0 = time.perf_counter()
+                    done = list(pool.map(
+                        lambda i: one(gen, f"decode bench {i}"),
+                        range(conc * 2),
+                    ))
+                dt = time.perf_counter() - t0
+                out[f"http_decode_tokens_per_sec_tp{tp}"] = round(
+                    sum(u["completion_tokens"] for u in done) / dt, 1
+                )
+                out["tp_phase_devices"] = n_dev
+                out["tp_phase_model"] = model
+            finally:
+                if runner is not None:
+                    async def _cleanup(r=runner):
+                        try:
+                            await asyncio.wait_for(r.cleanup(), 10)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    try:
+                        asyncio.run_coroutine_threadsafe(
+                            _cleanup(), loop
+                        ).result(30)
+                    except Exception:  # noqa: BLE001 - teardown best-effort
+                        pass
+                if server is not None:
+                    try:
+                        server.engine.stop()
+                    except Exception:  # noqa: BLE001
+                        pass
+                loop.call_soon_threadsafe(loop.stop)
+                thread.join(timeout=10)
+                if not loop.is_running():
+                    loop.close()
+    except Exception as e:  # noqa: BLE001 - fail-soft, like the stack phases
+        out["tp_phase_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def emit_primary(primary: dict) -> None:

@@ -182,8 +182,8 @@ def main() -> None:
     out_dir.mkdir(parents=True, exist_ok=True)
 
     order = ["index", "getting-started", "user-manual", "deployment",
-             "benchmarking", "tracing", "observability", "kv-directory",
-             "static-analysis", "developer-guide"]
+             "multichip-serving", "benchmarking", "tracing", "observability",
+             "kv-directory", "static-analysis", "developer-guide"]
     handbook = sorted(
         DOCS.glob("*.md"),
         key=lambda p: (order.index(p.stem) if p.stem in order else 99, p.stem),

@@ -780,6 +780,9 @@ class EngineServer:
         emit("num_requests_shed_total", "counter",
              s.get("num_requests_shed_total", 0),
              "generation requests shed with 429 (queue full or queue deadline)")
+        emit("tensor_parallel_degree", "gauge",
+             s.get("tensor_parallel", 1),
+             "tp mesh-axis size of the serving mesh (chips per replica)")
         emit("gpu_cache_usage_perc", "gauge", s["gpu_cache_usage_perc"])
         emit("gpu_prefix_cache_hit_rate", "gauge", s["gpu_prefix_cache_hit_rate"])
         emit("gpu_prefix_cache_hits_total", "counter", s["gpu_prefix_cache_hits_total"])

@@ -111,6 +111,13 @@ class EngineConfig:
     # three times. Disable to fall back to the stacked-output + scatter
     # path (same numerics; tests assert bit-identical pools).
     prefill_fused_kv_write: bool = True
+    # tensor parallelism: attention heads + MLP hidden shard over the tp mesh
+    # axis (parallel/shardings.py); the paged KV pool becomes per-chip — each
+    # chip holds its kv-head shard of every page, so page ids, chains, hashes,
+    # eviction, offload, and migration are tp-invariant (one logical page = N
+    # physical head-shards; serde blobs gather/scatter shards at the tier
+    # boundary — docs/multichip-serving.md). ``--tensor-parallel N`` is
+    # accepted as an alias (reference vLLM spells it -tp).
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     # sequence/context parallelism: long prefill chunks run ring attention
@@ -375,13 +382,22 @@ _FLAG_HELP = {
 }
 
 
+# short/alias spellings accepted in addition to the canonical --<field-name>
+# flag (parity with the reference chart's TP config, which spells the knob
+# both --tensor-parallel-size and -tp)
+_FLAG_ALIASES = {
+    "tensor_parallel_size": ("--tensor-parallel",),
+}
+
+
 def add_engine_args(p: argparse.ArgumentParser) -> None:
     for f in dataclasses.fields(EngineConfig):
         flag = "--" + f.name.replace("_", "-")
+        aliases = _FLAG_ALIASES.get(f.name, ())
         ftype = str(f.type)
         help_ = _FLAG_HELP.get(f.name)
         if ftype == "bool" or isinstance(f.default, bool):
-            p.add_argument(flag, action=argparse.BooleanOptionalAction,
+            p.add_argument(flag, *aliases, action=argparse.BooleanOptionalAction,
                            default=f.default, help=help_)
         else:
             typ = str
@@ -389,7 +405,8 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                 typ = int
             elif "float" in ftype or isinstance(f.default, float):
                 typ = float
-            p.add_argument(flag, type=typ, default=f.default, help=help_)
+            p.add_argument(flag, *aliases, type=typ, default=f.default,
+                           dest=f.name, help=help_)
 
 
 def config_from_args(args: argparse.Namespace) -> EngineConfig:

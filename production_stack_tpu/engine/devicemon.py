@@ -171,6 +171,20 @@ class DeviceMonitor:
         except Exception:  # noqa: BLE001 - psutil missing: zero row
             return {"device": "host", "bytes_in_use": 0, "bytes_limit": 0}
 
+    def _pool_shards(self) -> "list[tuple[str, int]]":
+        """Per-mesh-device KV pool footprint from the runner's static pool
+        sharding (engine/runner.py kv_pool_shard_layout) — live buffers are
+        donated every step and must not be introspected from the scrape
+        thread. Fake/test engines without a runner degrade to no rows."""
+        runner = getattr(self.engine, "runner", None)
+        layout = getattr(runner, "kv_pool_shard_layout", None)
+        if layout is None:
+            return []
+        try:
+            return list(layout())
+        except Exception:  # noqa: BLE001 - telemetry must never break a scrape
+            return []
+
     # -- compile cache ------------------------------------------------------
 
     def _compile_cache_size(self) -> tuple[int, int]:
@@ -254,6 +268,19 @@ class DeviceMonitor:
                 "# TYPE vllm:kv_pool_used_bytes gauge",
                 f"vllm:kv_pool_used_bytes{{{labels}}} {used}",
             ]
+            # per-mesh-device pool footprint: under tensor parallelism each
+            # chip holds its kv-head shard of every page, so the per-shard
+            # series (≈ pool/tp each) is what the per-shard HBM-headroom
+            # panel charts — a device-0-only row would claim N× the real
+            # per-chip load (docs/multichip-serving.md)
+            shards = self._pool_shards()
+            if shards:
+                lines.append("# TYPE vllm:kv_pool_shard_bytes gauge")
+                for dev, nbytes in shards:
+                    dl = f'{labels},device="{dev}"'
+                    lines.append(
+                        f"vllm:kv_pool_shard_bytes{{{dl}}} {nbytes}"
+                    )
         secs, events = compile_totals()
         entries, cache_bytes = self._compile_cache_size()
         lines += [

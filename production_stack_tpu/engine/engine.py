@@ -106,7 +106,8 @@ class LLMEngine:
         )
         page_bytes = (
             2 * model_cfg.num_layers * cfg.page_size * model_cfg.num_kv_heads
-            * model_cfg.head_dim * 2  # k+v, bf16
+            * model_cfg.head_dim  # k+v
+            * np.dtype(getattr(model_cfg, "dtype", None) or "bfloat16").itemsize
         )
         # device telemetry (engine/devicemon.py): page footprint for the KV
         # pool-vs-headroom gauges, and the jax.monitoring compile listener
@@ -166,6 +167,14 @@ class LLMEngine:
             enable_lora=cfg.enable_lora, max_loras=cfg.max_loras,
             max_lora_rank=cfg.max_lora_rank, lora_targets=lora_targets,
         )
+        # serving mesh degrees, read from the ACTUAL mesh (a caller-passed
+        # mesh wins over the config): /stats + vllm:tensor_parallel_degree +
+        # the flight recorder's sched events all report these, and the paged
+        # pool's per-chip footprint is kv_page_bytes / tp per shard
+        # (docs/multichip-serving.md)
+        mesh_shape = dict(mesh.shape)
+        self.tensor_parallel = mesh_shape.get("tp", 1)
+        self.mesh_devices = int(mesh.devices.size)
         self.lora: Optional[LoRAManager] = None
         if cfg.enable_lora:
             self.lora = LoRAManager(
@@ -1241,6 +1250,7 @@ class LLMEngine:
         ][:4]
         fr.record(
             "sched", step=self.step_idx, batch_kind=batch.kind,
+            tp=self.tensor_parallel,
             rows=len(batch.seqs), bursts=batch.bursts,
             chunk_tokens=sum(batch.chunk_sizes) if batch.chunk_sizes else 0,
             seq_ids=[s.seq_id for s in batch.seqs[:8]],
@@ -1419,6 +1429,23 @@ class LLMEngine:
             # (per-token, burst, or speculative round) must not change the
             # streamed text. Held-back chars flush on the finishing emit.
             full = full.rstrip("�")
+        if not seq.finished and seq.params.stop:
+            # hold back a trailing PARTIAL stop-string match until later
+            # tokens resolve it: a decode_steps=1 engine otherwise streams
+            # the stop's first chars one token at a time (they cannot be
+            # retracted once emitted), while a burst engine sees the whole
+            # stop inside one dispatch and trims before it — the emitted
+            # text must not depend on the dispatch boundary. A completed
+            # stop is handled by the trim below; non-stop text flushes on
+            # the finishing emit (gate above), exactly like the byte hold.
+            hold = 0
+            for s in seq.params.stop:
+                for j in range(min(len(s) - 1, len(full)), hold, -1):
+                    if full.endswith(s[:j]):
+                        hold = j
+                        break
+            if hold:
+                full = full[: len(full) - hold]
         # under _lock: generate()'s finally pops this entry from the event
         # loop concurrently (unlocked read found by graftcheck GC004)
         with self._lock:
@@ -1876,6 +1903,11 @@ class LLMEngine:
                 self.requests_shed["queue_deadline"]
             ),
             "engine_saturated": int(self.saturated()),
+            # serving-mesh shape: the router's scraper and the fleet
+            # controller read these to reason about per-engine capacity (a
+            # tp=4 engine is one replica on 4 chips, not 4 replicas)
+            "tensor_parallel": self.tensor_parallel,
+            "mesh_devices": self.mesh_devices,
             "gpu_cache_usage_perc": self.kv.usage(),
             "gpu_prefix_cache_hits_total": self.kv.prefix_hits,
             "gpu_prefix_cache_queries_total": self.kv.prefix_queries,

@@ -845,6 +845,28 @@ class ModelRunner:
         for k in [k for k, (_, _, d) in self.kv_staged.items() if d < now]:
             self.kv_staged.pop(k, None)
 
+    def kv_pool_shard_layout(self) -> "list[tuple[str, int]]":
+        """Static per-device KV pool footprint: ``(device_label, bytes)`` for
+        every mesh device, k+v pools together.
+
+        Computed from the pool SHARDING (shard_shape), not the live buffers —
+        the live arrays are donated into every step, and a scrape racing the
+        device thread would intermittently see a deleted buffer. With kv
+        heads sharded over tp each chip holds ``total / (tp * pp)`` bytes
+        (the per-chip pool the multichip serving path is sized by:
+        docs/multichip-serving.md); a GQA pool that cannot split (KH % tp
+        != 0) reports the full replicated footprint per device."""
+        shape = (
+            self.cfg.num_layers, self.num_pages, self.page_size,
+            getattr(self.cfg, "num_kv_heads", 1), self.cfg.head_dim,
+        )
+        sh = self._kv_sharding()
+        per = 2 * int(np.prod(sh.shard_shape(shape)))
+        per *= np.dtype(self.cfg.dtype).itemsize
+        return [
+            (f"{d.platform}:{d.id}", per) for d in self.mesh.devices.flat
+        ]
+
     def _kv_sharding(self) -> NamedSharding:
         """Pool sharding for this mesh (pp shards the layer axis).
 

@@ -180,6 +180,46 @@ class Int8Serde(NaiveSerde):
         return k, v
 
 
+# -- tensor-parallel shard boundary -------------------------------------------
+#
+# Under tensor parallelism the device pool holds one KV-HEAD SHARD of every
+# page per chip (parallel/shardings.KV_PAGES_SPEC); the offload tiers hold
+# whole logical pages. The gather/scatter between the two happens at this
+# serde boundary: runner.get_pages lays the page out replicated (the
+# all-gather rides ICI) before serialize, and set_pages scatters the
+# deserialized page back into the tp-sharded pool device-side. Blobs are
+# therefore tp-INVARIANT: a page spilled by a tp=4 engine restores into a
+# tp=1 or tp=2 engine bit-identically (warm starts, migration snapshots, and
+# directory pulls all cross tp shapes freely — docs/multichip-serving.md).
+# The helpers below express one logical page <-> N head-shards for staging
+# and for shard-consistency checks in tests.
+
+
+def split_kv_heads(
+    k: np.ndarray, v: np.ndarray, shards: int
+) -> "list[tuple[np.ndarray, np.ndarray]]":
+    """Split one logical page's K/V ``[L, page, KH, D]`` into ``shards``
+    contiguous head-shards (shard i holds kv heads ``[i*KH/N, (i+1)*KH/N)``
+    — the same contiguous split NamedSharding uses for the pool's KH axis).
+    KH must divide evenly; the pool replicates instead when it cannot
+    (runner._kv_sharding), and whole-page blobs need no split."""
+    KH = k.shape[2]
+    if KH % shards:
+        raise ValueError(f"cannot split {KH} kv heads into {shards} shards")
+    return list(zip(np.split(k, shards, axis=2), np.split(v, shards, axis=2)))
+
+
+def join_kv_heads(
+    parts: "list[tuple[np.ndarray, np.ndarray]]",
+) -> tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`split_kv_heads`: reassemble a logical page from its
+    head-shards (shard order = head order)."""
+    return (
+        np.concatenate([k for k, _ in parts], axis=2),
+        np.concatenate([v for _, v in parts], axis=2),
+    )
+
+
 SERDES = {"naive": NaiveSerde, "int8": Int8Serde}
 
 

@@ -500,20 +500,17 @@ def ragged_paged_attention_decode_sharded(
     # made manual (the pp pipeline region). When called inside a manual
     # region the context mesh (with those axes marked Manual) must be the
     # one passed to the nested shard_map, not the concrete mesh.
-    from jax.sharding import get_abstract_mesh
+    from production_stack_tpu.parallel import compat
 
-    ctx = get_abstract_mesh()
-    manual_already = (
-        set(ctx.manual_axes) if ctx is not None and not ctx.empty else set()
-    )
+    manual_already, ctx = compat.current_manual_axes()
     sm_mesh = mesh if not manual_already else ctx
     manual = ({"dp", "tp", "sp", "ep"} & set(mesh.axis_names)) - manual_already
-    out = jax.shard_map(
+    out = compat.shard_map(
         body,
-        mesh=sm_mesh,
+        sm_mesh,
         axis_names=manual,
         in_specs=tuple(in_specs),
         out_specs=head,
-        check_vma=False,
+        check=False,
     )(*operands)
     return out

@@ -1,0 +1,93 @@
+"""shard_map compatibility across the jax versions this stack deploys on.
+
+The serving tree targets the modern API (``jax.shard_map`` with
+``axis_names=``/``check_vma=``, introduced around jax 0.6), but the baked
+container toolchain pins jax 0.4.x where the same machinery lives at
+``jax.experimental.shard_map.shard_map`` with the complementary calling
+convention: partial-manual regions are expressed as ``auto=<unmapped axes>``
+instead of ``axis_names=<mapped axes>``, and replication checking is
+``check_rep=`` instead of ``check_vma=``. Every sharded entry point
+(ring attention, the layer pipeline, the sharded ragged decode kernel) calls
+through this module so the version split lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+_HAS_NEW_API = hasattr(jax, "shard_map")
+
+# Partial-manual regions (only SOME mesh axes mapped, the rest flowing
+# through GSPMD automatically) exist on old jax as shard_map's ``auto=``
+# parameter, but on this toolchain they raise NotImplementedError eagerly
+# and fatally CHECK-fail XLA's SPMD partitioner under jit — unusable either
+# way. Callers branch on this flag: with partial manual unavailable they map
+# EVERY axis and leave the would-be-auto axes out of their specs, which
+# shard_map's boundary resharding turns into replicated (redundant) compute
+# along those axes — numerically identical, and the unmapped axes are size 1
+# in every tier-1 serving config that reaches these paths.
+PARTIAL_MANUAL = _HAS_NEW_API
+
+
+def shard_map(
+    f,
+    mesh,
+    *,
+    in_specs,
+    out_specs,
+    axis_names: Optional[set] = None,
+    check: bool = False,
+):
+    """Version-bridging ``shard_map``.
+
+    ``axis_names`` is the MODERN meaning: the mesh axes the body is manual
+    over (None = all of them). Old jax cannot do partial-manual (see
+    PARTIAL_MANUAL above), so there the region is widened to full-manual:
+    axes absent from a spec then mean "replicated into every shard" rather
+    than "GSPMD-managed", which computes redundantly along them but returns
+    the same values.
+    """
+    if _HAS_NEW_API:
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check, **kw,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check,
+    )
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis, from inside a shard_map body."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax._src.core import axis_frame  # old jax: returns the size itself
+
+    sz = axis_frame(axis_name)
+    return sz if isinstance(sz, int) else sz.size
+
+
+def current_manual_axes() -> tuple[set, Optional[object]]:
+    """(axes already Manual in the current trace context, the context mesh).
+
+    Modern jax exposes this as ``jax.sharding.get_abstract_mesh()`` — a
+    nested shard_map inside a manual region must be built against that
+    abstract mesh, not the concrete one. Old jax has no public probe; the
+    serving paths that nest (the decode kernel inside the pp pipeline's
+    manual region) are TPU-only there, and the single-level regions tier-1
+    exercises never need it — so (empty, None) is the correct degradation.
+    """
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+    except AttributeError:
+        return set(), None
+    if ctx is None or ctx.empty:
+        return set(), None
+    return set(ctx.manual_axes), ctx

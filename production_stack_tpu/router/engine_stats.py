@@ -38,6 +38,10 @@ class EngineStats:
     # and the measured host<->device link bandwidth (0 = not exported)
     kv_offload_max_io_pages: float = -1.0
     kv_offload_link_bandwidth_bytes_per_sec: float = 0.0
+    # serving-mesh tp degree (chips per replica): capacity math — a tp=4
+    # engine is ONE replica on 4 chips, not 4x the seats; the fleet
+    # controller and dashboards read it through the router's scrape
+    tensor_parallel: float = 1.0
 
     _FIELDS = {
         "vllm:num_requests_running": "num_running_requests",
@@ -51,6 +55,7 @@ class EngineStats:
         "vllm:kv_offload_link_bandwidth_bytes_per_sec": (
             "kv_offload_link_bandwidth_bytes_per_sec"
         ),
+        "vllm:tensor_parallel_degree": "tensor_parallel",
     }
 
     @staticmethod

@@ -306,6 +306,10 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     hang = bool(faults.get("hang", False))
     hang_after_chunks = faults.get("hang_after_chunks")
     saturate_after_n = faults.get("saturate_after_n")
+    # advertised serving-mesh tp degree (--tensor-parallel): chaos scenarios
+    # run fleets of mixed-shape fakes to prove router scraping, migration,
+    # and warm-start round-trip the sharded-engine advert unchanged
+    tensor_parallel = int(faults.get("tensor_parallel") or 1)
     shed_rate = float(faults.get("shed_rate", 0.0))
     retry_after = f"{float(faults.get('retry_after') or 1):g}"
     crash_after_n = faults.get("crash_after_n")
@@ -686,6 +690,9 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             f'vllm:gpu_prefix_cache_hits_total{{model_name="{model}"}} 10\n'
             f'vllm:gpu_prefix_cache_queries_total{{model_name="{model}"}} 20\n'
             f'vllm:engine_saturated{{model_name="{model}"}} {saturated}\n'
+            # serving-mesh advert (--tensor-parallel): the router's scraper
+            # and the fleet controller read capacity shape through this
+            f'vllm:tensor_parallel_degree{{model_name="{model}"}} {tensor_parallel}\n'
             f'vllm:num_requests_shed_total{{model_name="{model}"}} {STATE["shed"]}\n'
             # fake-only observability: bounded-queue proof for overload tests,
             # per-process served/completed/abort counters for restart + replay
@@ -1259,6 +1266,11 @@ def main():
                    help="pull this many top fleet-warm chunk hashes "
                         "(dir_top_prefixes) at startup and count warm "
                         "prefix hits against them; needs --kv-directory-url")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="advertised serving-mesh tp degree "
+                        "(vllm:tensor_parallel_degree on /metrics), so "
+                        "router scraping and fleet-capacity math can be "
+                        "tested against sharded-engine fleets without TPUs")
     args = p.parse_args()
     app = make_app(
         args.model, args.speed, args.ttft, args.model_label,
@@ -1279,6 +1291,7 @@ def main():
             "kv_directory_url": args.kv_directory_url,
             "migration": args.migration,
             "warm_prefetch_on_boot": args.warm_prefetch_on_boot,
+            "tensor_parallel": args.tensor_parallel,
             "self_url": f"http://127.0.0.1:{args.port}",
         },
     )

@@ -30,6 +30,9 @@ _DEFAULT_DIR = os.path.join(
 )
 
 _enabled_dir: str | None = None
+# the UNSCOPED base the enabled dir was derived from: later scoped calls
+# must re-derive from this, never from the already-scoped result
+_base_dir: str | None = None
 
 
 def _cpu_feature_scope() -> str:
@@ -81,7 +84,7 @@ def enable_persistent_cache(
     boundaries — observed to hang the jax.distributed rendezvous), and
     per-process subdirs also keep concurrent writers apart.
     """
-    global _enabled_dir
+    global _enabled_dir, _base_dir
     import jax
 
     env = os.environ.get("PSTPU_COMPILE_CACHE_DIR")
@@ -91,6 +94,20 @@ def enable_persistent_cache(
     if cache_dir is None:
         # respect a cache dir the operator already configured via JAX's env
         cache_dir = jax.config.jax_compilation_cache_dir
+        if cache_dir is not None and cache_dir == _enabled_dir:
+            # already wired by an earlier call in this process (conftest,
+            # bench, a previous engine): the configured dir is the SCOPED
+            # result, and re-scoping it would nest cpu-<digest> subdirs one
+            # level deeper per engine construction — every engine then
+            # compiles against a brand-new empty cache (observed: a
+            # 23-level-deep .cache/xla chain and a tier-1 suite that
+            # recompiled cold for every LLMEngine test)
+            if not scope:
+                return _enabled_dir
+            # a scoped request (multi-host topology) must derive from the
+            # ORIGINAL base, not the already-scoped result
+            if _base_dir is not None:
+                cache_dir = _base_dir
     if cache_dir is None:
         # Default-on only for TPU backends, where a cold compile costs
         # 20-40 s per program. XLA:CPU AOT cache loads are NOT robust: an
@@ -102,6 +119,7 @@ def enable_persistent_cache(
         if jax.default_backend() != "tpu":
             return None
         cache_dir = _DEFAULT_DIR
+    _base_dir = cache_dir
     if scope:
         cache_dir = os.path.join(cache_dir, scope)
     try:

@@ -722,6 +722,7 @@ def run_scale_cycle(
     warm_prefetch: int = 8,
     drain_deadline: float = 20.0,
     ttft_p99_bound_s: float = 8.0,
+    tensor_parallel: int = 1,
 ) -> dict:
     """Scale-cycle scenario (ISSUE 10): 2 -> 4 -> 2 engines under sustained
     streaming load, driven by the fleet controller (docs/migration.md).
@@ -765,12 +766,20 @@ def run_scale_cycle(
     urls = [f"http://127.0.0.1:{p}" for p in ports]
 
     def start_fake(port: int, extra: list) -> "object":
+        # with tensor_parallel > 1 every fake advertises a sharded serving
+        # mesh (vllm:tensor_parallel_degree, ISSUE 12): the scenario then
+        # proves router scraping, migration, and warm-start all round-trip
+        # against a sharded-engine fleet unchanged
+        tp_args = (
+            ["--tensor-parallel", str(tensor_parallel)]
+            if tensor_parallel != 1 else []
+        )
         proc = start_proc([
             "-m", "production_stack_tpu.testing.fake_engine",
             "--port", str(port), "--model", "fake/model",
             "--speed", str(speed), "--kv-directory-url", dir_url,
             "--migration",
-        ] + extra)
+        ] + tp_args + extra)
         # drain stdout: sustained load + a full 64 KB pipe wedges the
         # process's event loop (PR 5 lesson)
         threading.Thread(
@@ -1019,6 +1028,23 @@ def run_scale_cycle(
 
         router_m = scrape(base)
         fleet = {u: scrape(u) for u in fakes}
+        # serving-mesh advert round trip: each engine's own
+        # vllm:tensor_parallel_degree, and the router's SCRAPED view of it
+        # (/engines engine_stats — what the fleet controller's capacity
+        # math reads)
+        engine_tp = {
+            u: m.get("vllm:tensor_parallel_degree", 0.0)
+            for u, m in fleet.items()
+        }
+        router_tp: dict = {}
+        try:
+            eng_view = requests.get(f"{base}/engines", timeout=10).json()
+            for ep in eng_view.get("engines", []):
+                es = ep.get("engine_stats")
+                if es is not None and ep["url"] in fakes:
+                    router_tp[ep["url"]] = es.get("tensor_parallel")
+        except requests.RequestException:
+            pass
         # out-count = confirmed migrate_out ships: the evacuation reports'
         # moved counts (a victim's own counter can be unreadable in the
         # instant between its last stream leaving and the process exiting)
@@ -1055,6 +1081,9 @@ def run_scale_cycle(
             "controller_decisions": (
                 dict(ctrl.decider.decisions_total) if ctrl else {}
             ),
+            "tensor_parallel_cfg": tensor_parallel,
+            "engine_advertised_tp": engine_tp,
+            "router_scraped_tp": router_tp,
         }
     finally:
         stop_load.set()

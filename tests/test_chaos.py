@@ -1,7 +1,11 @@
-"""Chaos tests for the router failure-domain layer — fast tier-1 (NOT marked
-slow): failover regressions must be caught on every run, not just in the
-nightly slow suite. Fake engines with fault injection stand in for broken
-pods (production_stack_tpu/testing/fake_engine.py --fail-rate/--hang/
+"""Chaos tests for the router failure-domain layer. The flagship failover
+run (chaos_run), overload shedding, the stall/deadline/breaker cases, and
+the scale-cycle scenario stay fast tier-1 — failover regressions must be
+caught on every run, not just in the nightly slow suite; the two heaviest
+subprocess-fleet rotations (rolling restart, directory restart) carry the
+`slow` marker and run in CI's unfiltered job. Fake engines with fault
+injection stand in for broken pods
+(production_stack_tpu/testing/fake_engine.py --fail-rate/--hang/
 --hang-after-chunks/--fail-first-n); scripts/chaos_check.py provides the
 three-engine scenario harness."""
 
@@ -11,6 +15,7 @@ import re
 import sys
 import time
 
+import pytest
 import requests
 
 sys.path.insert(
@@ -111,6 +116,8 @@ def test_overload_sheds_cleanly_with_bounded_queue_depth():
     ), s["anomaly_dumps"]
 
 
+@pytest.mark.slow  # ~25 s subprocess fleet; chaos_run + scale-cycle
+# keep fast-suite chaos coverage
 def test_rolling_restart_under_load_zero_errors_and_traffic_returns():
     """Acceptance (zero-loss restarts, ISSUE 5): three engines restarted one
     at a time under sustained load — SIGTERM drain, exit, rebirth on the same
@@ -142,6 +149,8 @@ def test_rolling_restart_under_load_zero_errors_and_traffic_returns():
         assert d["crosslinked_trace_ids"] > 0, d
 
 
+@pytest.mark.slow  # ~25 s subprocess fleet; directory expiry logic is
+# unit-covered in test_kvdirectory
 def test_directory_restart_expires_stale_claims_with_zero_routing_errors():
     """Acceptance (fleet-wide KV directory, ISSUE 9): a KV-aware-v2 router
     over three directory-publishing fake engines and a directory-hosting
@@ -172,8 +181,15 @@ def test_scale_cycle_zero_loss_with_migration_and_warm_prefetch():
     streams included), bounded TTFT p99, every drained engine evacuates all
     in-flight sequences before a clean exit, and each scaled-up engine
     pulls fleet-warm chunks via directory prefetch and serves warm prefix
-    hits from its first requests."""
-    s = chaos_check.run_scale_cycle()
+    hits from its first requests.
+
+    The whole cycle runs against a SHARDED-engine fleet (ISSUE 12): every
+    fake advertises tensor_parallel=4, so router scraping, migration, and
+    directory-driven warm-start are proven insensitive to the serving-mesh
+    shape, and the advert round-trips engine -> router scrape (the shard
+    gather/scatter of real page blobs at the serde boundary is covered by
+    tests/test_kvoffload.py::TestShardBoundary and test_tp_serving)."""
+    s = chaos_check.run_scale_cycle(tensor_parallel=4)
     assert s["non_429_errors"] == 0, s["errors"]
     assert s["statuses"].get(200, 0) > 0, s["statuses"]
     assert s["dropped_streams"] == 0, s["dropped_examples"]
@@ -196,6 +212,16 @@ def test_scale_cycle_zero_loss_with_migration_and_warm_prefetch():
         assert up["served"] > 0, up
         assert up["warm_prefetch_chunks"] > 0, up
         assert up["warm_prefix_hits"] > 0, up
+    # sharded-fleet advert round trip: every surviving engine advertises
+    # tp=4 on its own /metrics, and the router's scraper surfaced the same
+    # degree (what the fleet controller's capacity math reads — a tp=4
+    # engine is ONE replica on 4 chips, not 4x the seats)
+    assert s["engine_advertised_tp"], s
+    for url, tp in s["engine_advertised_tp"].items():
+        assert tp == 4, (url, tp)
+    assert s["router_scraped_tp"], "router never scraped the tp gauge"
+    for url, tp in s["router_scraped_tp"].items():
+        assert tp == 4, (url, tp)
 
 
 def test_inter_chunk_stall_aborts_engine_and_sends_sse_error():

@@ -62,8 +62,16 @@ def _details(findings, rule):
 
 # -- the tier-1 guard: the real tree stays clean ------------------------------
 
-def test_real_tree_has_no_unsuppressed_findings():
-    violations, stats = core.run_graftcheck()
+@pytest.fixture(scope="module")
+def tree_run():
+    """One full-tree run_graftcheck() shared by the tree-level assertions —
+    a full AST scan costs ~10 s and the two tests below interrogate the
+    same result, not different inputs."""
+    return core.run_graftcheck()
+
+
+def test_real_tree_has_no_unsuppressed_findings(tree_run):
+    violations, stats = tree_run
     assert not violations, (
         "graftcheck failed on the tree (fix the hazard, or use a reasoned "
         "'# graftcheck: disable=GCnnn — <reason>' / baseline.json entry — "
@@ -74,12 +82,12 @@ def test_real_tree_has_no_unsuppressed_findings():
     assert stats["files"] > 60
 
 
-def test_known_suppressions_and_baseline_are_exercised():
+def test_known_suppressions_and_baseline_are_exercised(tree_run):
     """The shipped suppression (flightrecorder racy pre-check) and baseline
     entry (tiers.py miss counter) must keep matching real findings — if a
     refactor removes the hazard, run_graftcheck reports the stale silencer
     and the previous test fails; this one documents the expected counts."""
-    _, stats = core.run_graftcheck()
+    _, stats = tree_run
     # flightrecorder.dump_async pre-check (GC004) + the KV controller's
     # reference-parity query_inst op (GC009)
     assert stats["suppressed"] >= 2

@@ -511,6 +511,8 @@ def _post(base, prompt, errors, max_tokens=4):
     return r
 
 
+@pytest.mark.slow  # ~55 s: 3 subprocess engines + cache server; the
+# directory protocol itself has in-process coverage above
 def test_three_engine_fleet_warm_cross_engine_pull(tmp_path):
     """Acceptance (ISSUE 9): engine A serves a long shared prefix and its
     warm-start spill lands the blobs in the shared cache server + directory;

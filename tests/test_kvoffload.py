@@ -252,6 +252,79 @@ class TestIntegrity:
         assert cs.stats()["corrupt"] == 1
 
 
+class TestShardBoundary:
+    """Tensor-parallel shard gather/scatter at the serde boundary (ISSUE
+    12): tier blobs are whole logical pages — one logical page = tp
+    physical head-shards, gathered before serialize and scattered after
+    deserialize — so a blob corrupted in ANY shard's head slice converts to
+    a miss, and split/join round the shard decomposition exactly."""
+
+    def _page(self, KH=4):
+        rng = np.random.RandomState(7)
+        k = rng.randn(2, 8, KH, 16).astype(np.float32)
+        v = rng.randn(2, 8, KH, 16).astype(np.float32)
+        return k, v
+
+    def test_split_join_roundtrip(self):
+        from production_stack_tpu.kvoffload.serde import (
+            join_kv_heads,
+            split_kv_heads,
+        )
+
+        k, v = self._page()
+        for shards in (1, 2, 4):
+            parts = split_kv_heads(k, v, shards)
+            assert len(parts) == shards
+            for ks, vs in parts:
+                assert ks.shape[2] == 4 // shards
+            k2, v2 = join_kv_heads(parts)
+            np.testing.assert_array_equal(k, k2)
+            np.testing.assert_array_equal(v, v2)
+
+    def test_split_rejects_uneven_heads(self):
+        from production_stack_tpu.kvoffload.serde import split_kv_heads
+
+        k, v = self._page(KH=2)
+        with pytest.raises(ValueError, match="split"):
+            split_kv_heads(k, v, 4)
+
+    def test_blob_is_shard_invariant(self):
+        """serialize(gathered page) == serialize(join(shards)) — the tier
+        never sees which tp shape wrote a blob."""
+        from production_stack_tpu.kvoffload.serde import (
+            join_kv_heads,
+            split_kv_heads,
+        )
+
+        k, v = self._page()
+        whole = get_serde("naive").serialize(k, v)
+        rejoined = get_serde("naive").serialize(
+            *join_kv_heads(split_kv_heads(k, v, 4))
+        )
+        assert whole == rejoined
+
+    def test_corruption_in_one_shard_slice_rejected(self):
+        """Flip one byte inside EACH head-shard's slice of the body in
+        turn: the CRC covers the whole gathered page, so damage to any
+        single shard's bytes converts the blob to a miss, never to a
+        silently wrong shard scattered back into the pool."""
+        from production_stack_tpu.kvoffload import serde as serde_mod
+
+        k, v = self._page()
+        blob = get_serde("naive").serialize(k, v)
+        hdr_len = 4 + int.from_bytes(blob[:4], "big")
+        body_len = len(blob) - hdr_len
+        for shard in range(4):
+            bad = bytearray(blob)
+            # a byte within shard i's kv-head slice of the K payload
+            off = hdr_len + (body_len // 2) * shard // 4 + 5
+            bad[off] ^= 0x01
+            with pytest.raises(KVIntegrityError):
+                verify_blob(bytes(bad))
+            with pytest.raises(KVIntegrityError):
+                serde_mod.deserialize(bytes(bad))
+
+
 class TestCorruptionRecomputeFallback:
     """End-to-end: a corrupted offload tier must yield token-identical output
     via recompute — checksum rejection converts a restore into a miss, never

@@ -660,6 +660,8 @@ def test_greedy_continuation_bit_identical_across_cpu_engines(tmp_path):
         B.stop()
 
 
+@pytest.mark.slow  # ~30 s: 2 subprocess engines + router SSE splice;
+# migration choreography has in-process engine-level coverage above
 def test_real_engine_http_migration_via_router(tmp_path):
     """Acceptance e2e over the wire: two real CPU engine processes sharing
     an offload directory behind the router; a greedy stream is migrated

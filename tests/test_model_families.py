@@ -58,6 +58,8 @@ def _check(tmp_path, model, vocab: int, T: int = 16, B: int = 2):
     assert corr > 0.999, f"logit correlation {corr}"
 
 
+@pytest.mark.slow  # ~50 s: real-weights HF load; the debug-size parity
+# tests above cover every family's forward against transformers
 def test_llama_parity(tmp_path):
     from transformers import LlamaConfig, LlamaForCausalLM
 

@@ -138,6 +138,8 @@ class TestEngineWithPallasDecode:
         assert self._run("llama-debug", "pallas_interpret") == \
             self._run("llama-debug", "xla")
 
+    @pytest.mark.slow  # ~30 s: four full engines (two windowed families x
+    # two attn impls); window semantics are kernel-covered above
     def test_windowed_families_match_xla_engine(self):
         """Mistral (static window) and Gemma-2 (per-layer traced window +
         softcap) through the kernel's windowed path."""

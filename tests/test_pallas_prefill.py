@@ -364,6 +364,8 @@ class TestModelLevelFusedPrefill:
     post-scan scatter) is BIT-identical to the stacked-output + scatter
     path, and the kernel path tracks the XLA forward within bf16 noise."""
 
+    @pytest.mark.slow  # ~25 s: full-model double forward; the fused-write
+    # kernel path is bit-checked page-level in TestFusedPagedKVWrite
     def test_forward_fused_equals_scatter_path(self):
         import jax
 

@@ -1,0 +1,247 @@
+"""Tensor-parallel SERVING engine on the virtual 8-device CPU mesh (ISSUE 12).
+
+The parallelism layer has dryrun tp for five PRs; this asserts the real
+serving path: an `EngineConfig.tensor_parallel_size` (alias
+``--tensor-parallel``) engine — scheduler, continuous batching, paged pool,
+fused decode bursts, HTTP API — where model params shard over the ``tp``
+mesh axis and the paged KV pool holds each chip's kv-head shard of every
+page. Contracts under test (docs/multichip-serving.md):
+
+- greedy output is token-identical across tp in {1, 2, 4} (f32 debug twin:
+  tp changes all-reduce partial-sum order, and bf16 reduction noise flips
+  greedy near-ties on random weights);
+- the pool genuinely shards: per-chip pool bytes == total / tp;
+- tier blobs are tp-INVARIANT: pages gathered at the serde boundary by a
+  tp=4 engine restore bit-identically into a tp=1 pool (offload,
+  warm-start, and migration all ride this);
+- the HTTP surface serves and advertises the shape (/stats
+  ``tensor_parallel``, /metrics ``vllm:tensor_parallel_degree`` +
+  per-device ``vllm:kv_pool_shard_bytes`` rows).
+"""
+
+import asyncio
+import re
+
+import numpy as np
+import pytest
+import requests
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.scheduler import SamplingParams
+from production_stack_tpu.testing.procs import (
+    free_port,
+    start_proc,
+    stop_proc,
+    wait_healthy,
+)
+
+MODEL = "llama-debug-4kv-f32"
+
+
+def _cfg(**kw):
+    base = dict(
+        model=MODEL, max_model_len=128, num_pages=64, page_size=8,
+        max_num_seqs=4, decode_steps=2, prefill_chunk=32,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _gen_ids(engine, prompt, n=8):
+    async def run():
+        ids = []
+        async for out in engine.generate(
+            f"t-{np.random.randint(1 << 30)}", prompt=prompt,
+            params=SamplingParams(
+                max_tokens=n, temperature=0.0, ignore_eos=True
+            ),
+        ):
+            ids += out.token_ids
+        return ids
+
+    return asyncio.run(run())
+
+
+class TestTensorParallelEngine:
+    def test_tp_token_identical_and_pool_sharded(self, eight_devices):
+        """tp in {1, 2, 4} serve byte-identical greedy streams through the
+        full engine (chunked prefill + fused decode bursts + paged pool),
+        and each chip holds exactly 1/tp of the pool bytes."""
+        prompts = ["tensor parallel serving engine " * 2, "short"]
+        outs = {}
+        for tp in (1, 2, 4):
+            e = LLMEngine(_cfg(tensor_parallel_size=tp))
+            e.start()
+            try:
+                outs[tp] = [_gen_ids(e, p) for p in prompts]
+                assert e.tensor_parallel == tp
+                assert e.stats()["tensor_parallel"] == tp
+                layout = e.runner.kv_pool_shard_layout()
+                assert len(layout) == tp
+                total = sum(b for _, b in layout)
+                for _dev, nbytes in layout:
+                    assert nbytes == total // tp
+            finally:
+                e.stop()
+        assert outs[1] == outs[2] == outs[4]
+
+    def test_tp4_pool_bytes_quarter_of_tp1(self, eight_devices):
+        e1 = LLMEngine(_cfg(tensor_parallel_size=1))
+        e4 = LLMEngine(_cfg(tensor_parallel_size=4))
+        try:
+            b1 = e1.runner.kv_pool_shard_layout()[0][1]
+            per_shard = dict(e4.runner.kv_pool_shard_layout())
+            assert len(per_shard) == 4
+            for nbytes in per_shard.values():
+                assert nbytes == b1 // 4
+        finally:
+            e1.stop(), e4.stop()
+
+    def test_tp_rejects_oversized_mesh(self, eight_devices):
+        with pytest.raises(ValueError, match="devices"):
+            LLMEngine(_cfg(tensor_parallel_size=16))
+
+
+class TestShardBlobPortability:
+    """One logical page = N physical head-shards; the serde boundary
+    gathers/scatters, so tier blobs cross tp shapes freely."""
+
+    def test_page_blob_tp4_to_tp1_bit_identical(self, eight_devices):
+        from production_stack_tpu.kvoffload.serde import deserialize, get_serde
+
+        e4 = LLMEngine(_cfg(tensor_parallel_size=4))
+        e1 = LLMEngine(_cfg(tensor_parallel_size=1))
+        try:
+            e4.start()
+            _gen_ids(e4, "fill some pages with kv " * 3)
+            # gather a REGISTERED page (full, hashed) from the tp=4 pool
+            pid = next(iter(e4.kv.hash_to_page.values()))
+            ks, vs = e4.runner.get_pages([pid])
+            blob = get_serde("naive").serialize(
+                np.asarray(ks[0]), np.asarray(vs[0])
+            )
+            k2, v2 = deserialize(blob)  # CRC-verified round trip
+            np.testing.assert_array_equal(np.asarray(ks[0]), k2)
+            # scatter into the tp=1 pool and read back
+            e1.runner.set_pages([3], [k2], [v2])
+            k1, v1 = e1.runner.get_pages([3])
+            np.testing.assert_array_equal(k2, np.asarray(k1[0]))
+            np.testing.assert_array_equal(v2, np.asarray(v1[0]))
+            # and back into a DIFFERENT tp shape (tp=2)
+            e2 = LLMEngine(_cfg(tensor_parallel_size=2))
+            try:
+                e2.runner.set_pages([5], [k2], [v2])
+                kb, _vb = e2.runner.get_pages([5])
+                np.testing.assert_array_equal(k2, np.asarray(kb[0]))
+            finally:
+                e2.stop()
+        finally:
+            e4.stop(), e1.stop()
+
+    def test_warm_start_roundtrip_tp4_to_tp1(self, eight_devices, tmp_path):
+        """A tp=4 engine's drain manifest warm-starts a tp=1 engine: the
+        restored prefix serves with a cache hit and the greedy continuation
+        is token-identical — blobs written sharded-gathered restore
+        scattered into any shape."""
+        prompt = "warm start across tensor parallel shapes " * 2
+        common = dict(
+            warm_start=True, warm_start_namespace="tp-roundtrip",
+            kv_offload_dir=str(tmp_path), kv_offload_cpu_gb=0.001,
+        )
+        e4 = LLMEngine(_cfg(tensor_parallel_size=4, **common))
+        e4.start()
+        try:
+            ids4 = _gen_ids(e4, prompt)
+            assert e4.warm_spill() > 0
+        finally:
+            e4.stop()
+        e1 = LLMEngine(_cfg(tensor_parallel_size=1, **common))
+        e1.start()
+        try:
+            assert e1.warm is not None and e1.warm.restored_pages > 0
+            hits0 = e1.kv.prefix_hits
+            ids1 = _gen_ids(e1, prompt)
+            assert e1.kv.prefix_hits > hits0, "restored prefix must hit"
+            assert ids1 == ids4
+        finally:
+            e1.stop()
+
+
+@pytest.fixture(scope="module")
+def tp_http_pair(request):
+    """tp=1 and tp=4 api_server subprocesses on the same debug model."""
+    devs = pytest.importorskip("jax").devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    procs, bases = [], {}
+    for tp in (1, 4):
+        port = free_port()
+        proc = start_proc(
+            ["-m", "production_stack_tpu.engine.api_server",
+             "--model", MODEL, "--port", str(port),
+             "--tensor-parallel", str(tp),
+             "--max-model-len", "128", "--num-pages", "64",
+             "--page-size", "8", "--max-num-seqs", "4",
+             "--prefill-chunk", "32", "--decode-steps", "2"]
+        )
+        base = f"http://127.0.0.1:{port}"
+        procs.append(proc)
+        bases[tp] = base
+    try:
+        for tp, base in bases.items():
+            wait_healthy(f"{base}/health", procs[0 if tp == 1 else 1],
+                         timeout=240.0)
+        yield bases
+    finally:
+        for proc in procs:
+            stop_proc(proc)
+
+
+class TestTensorParallelHTTP:
+    def test_tp4_http_greedy_matches_tp1(self, tp_http_pair):
+        """The REAL HTTP llama path at tp=4: /v1/completions greedy output
+        equals the tp=1 engine's, token count included."""
+        payload = {
+            "model": MODEL,
+            "prompt": "the sharded engine serves http",
+            "max_tokens": 12, "temperature": 0.0, "ignore_eos": True,
+        }
+        texts = {}
+        for tp, base in tp_http_pair.items():
+            r = requests.post(f"{base}/v1/completions", json=payload,
+                              timeout=120)
+            assert r.status_code == 200, r.text
+            body = r.json()
+            texts[tp] = (
+                body["choices"][0]["text"],
+                body["usage"]["completion_tokens"],
+            )
+        assert texts[1] == texts[4]
+        assert texts[4][1] == 12
+
+    def test_tp4_stats_and_metrics_advertise_shape(self, tp_http_pair):
+        base = tp_http_pair[4]
+        s = requests.get(f"{base}/stats", timeout=30).json()
+        assert s["tensor_parallel"] == 4
+        assert s["mesh_devices"] == 4
+        m = requests.get(f"{base}/metrics", timeout=30).text
+        assert re.search(
+            r"vllm:tensor_parallel_degree\{[^}]*\} 4(\.0)?\b", m
+        )
+        shard_rows = re.findall(
+            r'vllm:kv_pool_shard_bytes\{[^}]*device="([^"]+)"[^}]*\} (\d+)',
+            m,
+        )
+        assert len(shard_rows) == 4, m[:2000]
+        sizes = {int(v) for _, v in shard_rows}
+        assert len(sizes) == 1, "every shard holds the same slice"
+        # the engine-stats scraper the router runs surfaces the degree
+        from production_stack_tpu.router.engine_stats import EngineStats
+
+        es = EngineStats.from_scrape(m)
+        assert es.tensor_parallel == 4
+
+    def test_tp1_stats_default_shape(self, tp_http_pair):
+        s = requests.get(f"{tp_http_pair[1]}/stats", timeout=30).json()
+        assert s["tensor_parallel"] == 1

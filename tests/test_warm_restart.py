@@ -18,6 +18,7 @@ import re
 import signal
 import time
 
+import pytest
 import requests
 
 from production_stack_tpu.engine.kv_manager import KVPageManager
@@ -329,6 +330,8 @@ def _post(base, prompt, max_tokens=4):
     )
 
 
+@pytest.mark.slow  # ~20 s subprocess restart e2e; spill/restore logic
+# is covered in-process above and across tp shapes in test_tp_serving
 def test_sigterm_restart_serves_warm_prefixes(tmp_path):
     """Acceptance: build a warm working set, SIGTERM-restart the engine, and
     the FIRST post-restart round of shared-prefix traffic hits >= 0.5 of its
