@@ -37,6 +37,10 @@ helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version }}
 - "--expert-parallel-size"
 - {{ .model.expertParallelSize | quote }}
 {{- end }}
+{{- if .model.kvCacheDtype }}
+- "--kv-cache-dtype"
+- {{ .model.kvCacheDtype | quote }}
+{{- end }}
 - "--max-model-len"
 - {{ .model.maxModelLen | default 4096 | quote }}
 - "--max-num-seqs"

@@ -111,6 +111,20 @@ class EngineConfig:
     # three times. Disable to fall back to the stacked-output + scatter
     # path (same numerics; tests assert bit-identical pools).
     prefill_fused_kv_write: bool = True
+    # KV cache dtype (threaded into the model config; ops/quant.py):
+    # auto (= model dtype) | bf16 | fp16 | int8. "int8" stores pages
+    # quantized with per-page per-kv-head scales in a parallel scales pool:
+    # the bandwidth-bound long-context decode step streams HALF the HBM
+    # bytes, and the same kv_cache_memory_gb holds ~2x the tokens.
+    # Dequantization happens inside the kernels' VMEM copy rings (fp KV
+    # never round-trips through HBM); quantization inside the fused prefill
+    # write and on the decode feedback commit. Offload/warm-start/
+    # directory/migration blobs ship the int8 bytes + scales (serde v3,
+    # CRC-framed, tp split/join-aware). Quality: ~1-1.5% relative logit
+    # error measured (docs/benchmarking.md); bench.py records the greedy
+    # token-match delta. Requires kv_write_mode=post; not compatible with
+    # speculative_k>0, sp/pp meshes, disagg kv_role, or device KV transfer.
+    kv_cache_dtype: str = "auto"
     # tensor parallelism: attention heads + MLP hidden shard over the tp mesh
     # axis (parallel/shardings.py); the paged KV pool becomes per-chip — each
     # chip holds its kv-head shard of every page, so page ids, chains, hashes,
@@ -306,6 +320,12 @@ _FLAG_HELP = {
         "commit each prefill chunk's K/V to its pool pages from inside the "
         "attention kernel instead of a separate post-scan scatter pass "
         "(same numerics; --no-prefill-fused-kv-write falls back)"
+    ),
+    "kv_cache_dtype": (
+        "KV cache dtype: auto (= model dtype) | bf16 | fp16 | int8. int8 "
+        "halves the decode HBM byte stream and doubles effective pool "
+        "capacity (per-page scales, in-kernel dequant; serde v3 blobs ship "
+        "the quantized bytes through every KV tier)"
     ),
     "warm_start": (
         "spill a warm-start manifest (hot chain-head KV pages + prefix-index "

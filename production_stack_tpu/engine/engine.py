@@ -100,15 +100,59 @@ class LLMEngine:
             model_cfg = dataclasses.replace(
                 model_cfg, prefill_fused_kv_write=cfg.prefill_fused_kv_write
             )
+        # KV cache dtype rides the model config like attn_impl (the
+        # quantized read/write sites live in the model forwards); int8 is
+        # gated on the combinations the quant contract covers
+        self.kv_quant = cfg.kv_cache_dtype == "int8"
+        if cfg.kv_cache_dtype != "auto":
+            if not any(
+                f.name == "kv_cache_dtype" for f in dataclasses.fields(model_cfg)
+            ):
+                raise ValueError(
+                    f"kv_cache_dtype={cfg.kv_cache_dtype} is not supported "
+                    "for this model family"
+                )
+            model_cfg = dataclasses.replace(
+                model_cfg, kv_cache_dtype=cfg.kv_cache_dtype
+            )
+        if self.kv_quant:
+            if cfg.kv_write_mode != "post":
+                raise ValueError(
+                    "--kv-cache-dtype int8 requires --kv-write-mode post"
+                )
+            if cfg.speculative_k:
+                raise ValueError(
+                    "--kv-cache-dtype int8 is not compatible with "
+                    "--speculative-k (the spec scan carries raw pool blocks)"
+                )
+            if cfg.sequence_parallel_size > 1 or cfg.pipeline_parallel_size > 1:
+                raise ValueError(
+                    "--kv-cache-dtype int8 does not compose with sp/pp meshes"
+                )
+            if cfg.kv_role != "none" or cfg.kv_transfer_device:
+                raise ValueError(
+                    "--kv-cache-dtype int8 is not compatible with "
+                    "disaggregated-prefill KV transfer yet (raw device pages "
+                    "would ship without their scales)"
+                )
         self.model_cfg = model_cfg
         self.tokenizer = load_tokenizer(
             cfg.tokenizer or (cfg.model if "/" in cfg.model or cfg.model.startswith(".") else None)
         )
+        kv_itemsize = (
+            1 if self.kv_quant
+            else np.dtype(getattr(model_cfg, "dtype", None) or "bfloat16").itemsize
+        )
         page_bytes = (
             2 * model_cfg.num_layers * cfg.page_size * model_cfg.num_kv_heads
             * model_cfg.head_dim  # k+v
-            * np.dtype(getattr(model_cfg, "dtype", None) or "bfloat16").itemsize
+            * kv_itemsize
         )
+        if self.kv_quant:
+            # per-page scale rows ride the pool budget too (f32 per kv head,
+            # k and v) — a rounding detail next to the 2x page shrink that
+            # DOUBLES how many tokens the same kv_cache_memory_gb holds
+            page_bytes += 2 * model_cfg.num_layers * model_cfg.num_kv_heads * 4
         # device telemetry (engine/devicemon.py): page footprint for the KV
         # pool-vs-headroom gauges, and the jax.monitoring compile listener
         # feeding vllm:compile_seconds_total + flight-recorder compile events
@@ -167,6 +211,35 @@ class LLMEngine:
             enable_lora=cfg.enable_lora, max_loras=cfg.max_loras,
             max_lora_rank=cfg.max_lora_rank, lora_targets=lora_targets,
         )
+        # KV quantization observability: bytes one token costs the pool
+        # (the byte-wall number), and a startup quantize->dequantize
+        # round-trip error bound on synthetic normal data — a cheap on-box
+        # sanity check that the quant math is sane on this build, exported
+        # as vllm:kv_quant_dequant_err_max
+        from production_stack_tpu.ops.quant import kv_bytes_per_token
+
+        self.kv_bytes_per_token = kv_bytes_per_token(
+            model_cfg.num_layers, model_cfg.num_kv_heads, model_cfg.head_dim,
+            cfg.page_size, self.kv_quant,
+            np.dtype(getattr(model_cfg, "dtype", None) or "bfloat16").itemsize,
+        )
+        self.kv_quant_dequant_err_max = 0.0
+        if self.kv_quant:
+            from production_stack_tpu.ops.quant import (
+                dequantize_page_host,
+                quantize_page_host,
+            )
+
+            rng_chk = np.random.RandomState(0)
+            x = rng_chk.randn(
+                model_cfg.num_layers, cfg.page_size, model_cfg.num_kv_heads,
+                model_cfg.head_dim,
+            ).astype(np.float32)
+            qx, sx = quantize_page_host(x)
+            self.kv_quant_dequant_err_max = float(
+                np.abs(dequantize_page_host(qx, sx) - x).max()
+                / max(np.abs(x).max(), 1e-9)
+            )
         # serving mesh degrees, read from the ACTUAL mesh (a caller-passed
         # mesh wins over the config): /stats + vllm:tensor_parallel_degree +
         # the flight recorder's sched events all report these, and the paged
@@ -1908,6 +1981,15 @@ class LLMEngine:
             # tp=4 engine is one replica on 4 chips, not 4 replicas)
             "tensor_parallel": self.tensor_parallel,
             "mesh_devices": self.mesh_devices,
+            # KV quantization surface (docs/benchmarking.md byte-wall
+            # model): pool bytes per token, quantized page count (= whole
+            # pool when int8, 0 otherwise), and the startup dequant
+            # round-trip error bound. cache_dtype is the string form for
+            # GET /stats (non-numeric, so the /metrics kv_* sweep skips it)
+            "cache_dtype": self.cfg.kv_cache_dtype,
+            "kv_cache_dtype_bytes_per_token": round(self.kv_bytes_per_token, 3),
+            "kv_quant_pages": self.kv.num_pages if self.kv_quant else 0,
+            "kv_quant_dequant_err_max": round(self.kv_quant_dequant_err_max, 6),
             "gpu_cache_usage_perc": self.kv.usage(),
             "gpu_prefix_cache_hits_total": self.kv.prefix_hits,
             "gpu_prefix_cache_queries_total": self.kv.prefix_queries,

@@ -160,14 +160,67 @@ class ModelRunner:
             and self._pp == 1
         )
 
+        # KV cache dtype (ops/quant.py): "auto" = model dtype; "bf16"/"fp16"
+        # pin an explicit fp pool dtype; "int8" stores quantized pages plus
+        # per-page per-kv-head scales pools — half the decode byte stream,
+        # double the effective pool capacity
+        kvdt = str(getattr(cfg, "kv_cache_dtype", "auto") or "auto")
+        known = {
+            "auto": None, "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+            "fp16": jnp.float16, "float16": jnp.float16, "int8": jnp.int8,
+        }
+        if kvdt not in known:
+            raise ValueError(
+                f"unknown kv_cache_dtype {kvdt!r}; options: {sorted(known)}"
+            )
+        self.kv_quant = kvdt == "int8"
+        self.kv_pool_dtype = known[kvdt] or getattr(cfg, "dtype", jnp.bfloat16)
+        if self.kv_quant:
+            fwd_params = inspect.signature(self.module.forward).parameters
+            if "kv_scales" not in fwd_params:
+                raise ValueError(
+                    f"model family {self.module.__name__.rsplit('.', 1)[-1]!r} "
+                    "does not support kv_cache_dtype=int8"
+                )
+            if getattr(cfg, "kv_write_mode", "pre") != "post":
+                raise ValueError(
+                    "kv_cache_dtype=int8 requires kv_write_mode='post'"
+                )
+            if not self._kv_burst_ok:
+                raise ValueError(
+                    "kv_cache_dtype=int8 requires the deferred-burst decode "
+                    "path (post write mode, kv_burst-capable family)"
+                )
+            if self._sp > 1 or self._pp > 1:
+                raise ValueError(
+                    "kv_cache_dtype=int8 does not compose with sp/pp meshes"
+                )
+
         if params is None:
             params = self.module.init_params(cfg, jax.random.key(seed))
         pspecs = shardings.param_specs_for(params, pp=self._pp > 1)
         self.params = shardings.shard_tree(params, pspecs, self.mesh)
-        kp, vp = self.module.init_kv_pages(cfg, num_pages, page_size)
+        self._kv_init_kw = {} if kvdt == "auto" else {"dtype": known[kvdt]}
+        kp, vp = self.module.init_kv_pages(
+            cfg, num_pages, page_size, **self._kv_init_kw
+        )
         kv_sh = self._kv_sharding()
         self.k_pages = jax.device_put(kp, kv_sh)
         self.v_pages = jax.device_put(vp, kv_sh)
+        self.k_scales = self.v_scales = None
+        if self.kv_quant:
+            from production_stack_tpu.ops.quant import init_kv_scales
+
+            sc_sh = self._kv_scales_sharding()
+            KH = getattr(cfg, "num_kv_heads", 1)
+            # two independent buffers: both are donated every step, and a
+            # shared device_put result would be one buffer donated twice
+            self.k_scales = jax.device_put(
+                init_kv_scales(cfg.num_layers, num_pages, KH), sc_sh
+            )
+            self.v_scales = jax.device_put(
+                init_kv_scales(cfg.num_layers, num_pages, KH), sc_sh
+            )
         self._rng = jax.random.key(seed)
 
         self.enable_lora = enable_lora
@@ -286,11 +339,15 @@ class ModelRunner:
             self._note_program_variant("step", sig)
             rep, n = self._rep, None
             outs = (rep, n, rep, rep, rep, n, n) if want_lp else (rep, n, n, n)
+            donate = (1, 2)
+            if self.kv_quant:
+                outs = outs + (n, n)  # updated scales pools
+                donate = (1, 2, 15)   # kv_scales tuple rides at arg 15
             self._steps[sig] = jax.jit(
                 functools.partial(
                     _step_fn, self._forward, self.cfg, want_lp, want_pen
                 ),
-                donate_argnums=(1, 2),
+                donate_argnums=donate,
                 out_shardings=outs,
             )
         return self._steps[sig]
@@ -307,14 +364,15 @@ class ModelRunner:
             s["temperature"], s["top_k"], s["top_p"], s["key"],
             self.lora, s["lora_ids"], s.get("pen"), s.get("bias"),
         )
+        if self.kv_quant:
+            args = args + ((self.k_scales, self.v_scales),)
+        out = self._get_step(want_logprobs, want_pen)(*args)
+        if self.kv_quant:
+            *out, self.k_scales, self.v_scales = out
         if want_logprobs:
-            ids, logits, lp, tids, tlp, self.k_pages, self.v_pages = (
-                self._get_step(True, want_pen)(*args)
-            )
+            ids, logits, lp, tids, tlp, self.k_pages, self.v_pages = out
             return ids, logits, (lp, tids, tlp)
-        ids, logits, self.k_pages, self.v_pages = (
-            self._get_step(False, want_pen)(*args)
-        )
+        ids, logits, self.k_pages, self.v_pages = out
         return ids, logits
 
     def step_multi(self, inp: StepInput, k: int, want_logprobs: bool = False):
@@ -354,12 +412,19 @@ class ModelRunner:
                 else (rep, rep, n, n)
             )
             fn = _multi_step_deferred_fn if self._kv_burst_ok else _multi_step_fn
+            donate = (1, 2)
+            if self.kv_quant:
+                # int8 pools require the deferred-burst path (enforced at
+                # construction): pools + scales stay scan constants, and the
+                # single burst commit is the quantizer
+                outs = outs + (n, n)
+                donate = (1, 2, 16)
             self._multi_steps[sig] = jax.jit(
                 functools.partial(
                     fn, self._forward, self.cfg, k,
                     want_logprobs, want_pen,
                 ),
-                donate_argnums=(1, 2),
+                donate_argnums=donate,
                 out_shardings=outs,
             )
         args = (
@@ -368,13 +433,16 @@ class ModelRunner:
             s["kv_limits"], s["temperature"], s["top_k"], s["top_p"], s["key"],
             self.lora, s["lora_ids"], s.get("pen"), s.get("bias"),
         )
+        if self.kv_quant:
+            args = args + ((self.k_scales, self.v_scales),)
+        out = self._multi_steps[sig](*args)
+        if self.kv_quant:
+            *out, self.k_scales, self.v_scales = out
         if want_logprobs:
-            toks, lp, tids, tlp, hist_f, self.k_pages, self.v_pages = (
-                self._multi_steps[sig](*args)
-            )
+            toks, lp, tids, tlp, hist_f, self.k_pages, self.v_pages = out
             self._last_hist = hist_f if want_pen else None
             return toks, (lp, tids, tlp)
-        toks, hist_f, self.k_pages, self.v_pages = self._multi_steps[sig](*args)
+        toks, hist_f, self.k_pages, self.v_pages = out
         self._last_hist = hist_f if want_pen else None
         return toks
 
@@ -486,6 +554,11 @@ class ModelRunner:
           history: [B, H] int32 token ids (prompt + output so far), 0-padded.
         Returns tokens [B, steps, 1+spec_k] int32, -1 where nothing emitted.
         """
+        if self.kv_quant:
+            raise ValueError(
+                "speculative decoding is not supported with "
+                "kv_cache_dtype=int8 (the spec scan carries raw pool blocks)"
+            )
         sig = (steps, spec_k, ngram)
         if sig not in self._spec_fns:
             self._note_program_variant("spec_step", sig)
@@ -655,6 +728,75 @@ class ModelRunner:
         vd = jax.device_put(jnp.asarray(v, dt), rep)
         self.k_pages, self.v_pages = fn(
             self.k_pages, self.v_pages, jnp.asarray(ids), kd, vd
+        )
+
+    # -- quantized pools: the serde boundary moves int8 pages + scales -------
+    # (KVOffloadConnector detects runner.kv_quant and uses these so blobs
+    # ship the halved int8 byte stream end-to-end — ops/quant.py contract)
+
+    def get_pages_quant(self, pids: "list[int]"):
+        """Fetch N quantized pages + their scales in ONE host round trip.
+        Returns (ks, vs, sks, svs): per-page ``[L, page, KH, D]`` int8 and
+        ``[L, KH]`` f32 host arrays — the exact pool bytes, no dequant."""
+        n = len(pids)
+        if n == 0:
+            return [], [], [], []
+        bucket = 1
+        while bucket < n:
+            bucket <<= 1
+        ids = jnp.asarray(
+            np.asarray(list(pids) + [pids[-1]] * (bucket - n), np.int32)
+        )
+        fn = self._get_pages_fns.get(("q", bucket))
+        if fn is None:
+            rep = NamedSharding(self.mesh, P())
+            fn = jax.jit(
+                lambda kp, vp, ks, vs, i: (
+                    kp[:, i], vp[:, i], ks[:, i], vs[:, i]
+                ),
+                out_shardings=(rep, rep, rep, rep),
+            )
+            self._get_pages_fns[("q", bucket)] = fn
+        k, v, sk, sv = jax.device_get(
+            fn(self.k_pages, self.v_pages, self.k_scales, self.v_scales, ids)
+        )
+        return (
+            [k[:, i] for i in range(n)], [v[:, i] for i in range(n)],
+            [sk[:, i] for i in range(n)], [sv[:, i] for i in range(n)],
+        )
+
+    def set_pages_quant(self, pids: "list[int]", ks, vs, sks, svs) -> None:
+        """Write N quantized pages + scales in ONE upload + scatter (the
+        restore twin of :meth:`get_pages_quant`)."""
+        n = len(pids)
+        if n == 0:
+            return
+        bucket = 1
+        while bucket < n:
+            bucket <<= 1
+        pad = bucket - n
+        ids = np.asarray(list(pids) + [pids[-1]] * pad, np.int32)
+        k = np.stack(list(ks) + [ks[-1]] * pad, axis=1)
+        v = np.stack(list(vs) + [vs[-1]] * pad, axis=1)
+        sk = np.stack(list(sks) + [sks[-1]] * pad, axis=1)
+        sv = np.stack(list(svs) + [svs[-1]] * pad, axis=1)
+        fn = self._set_pages_fns.get(("q", bucket))
+        if fn is None:
+            fn = jax.jit(
+                lambda kp, vp, ksc, vsc, i, k, v, sk, sv: (
+                    kp.at[:, i].set(k), vp.at[:, i].set(v),
+                    ksc.at[:, i].set(sk), vsc.at[:, i].set(sv),
+                ),
+                donate_argnums=(0, 1, 2, 3),
+            )
+            self._set_pages_fns[("q", bucket)] = fn
+        rep = self._rep
+        put = lambda x, dt: jax.device_put(jnp.asarray(x, dt), rep)
+        self.k_pages, self.v_pages, self.k_scales, self.v_scales = fn(
+            self.k_pages, self.v_pages, self.k_scales, self.v_scales,
+            jnp.asarray(ids),
+            put(k, jnp.int8), put(v, jnp.int8),
+            put(sk, jnp.float32), put(sv, jnp.float32),
         )
 
     def get_page_device(self, pid: int):
@@ -856,13 +998,19 @@ class ModelRunner:
         (the per-chip pool the multichip serving path is sized by:
         docs/multichip-serving.md); a GQA pool that cannot split (KH % tp
         != 0) reports the full replicated footprint per device."""
+        KH = getattr(self.cfg, "num_kv_heads", 1)
         shape = (
             self.cfg.num_layers, self.num_pages, self.page_size,
-            getattr(self.cfg, "num_kv_heads", 1), self.cfg.head_dim,
+            KH, self.cfg.head_dim,
         )
         sh = self._kv_sharding()
         per = 2 * int(np.prod(sh.shard_shape(shape)))
-        per *= np.dtype(self.cfg.dtype).itemsize
+        per *= np.dtype(self.kv_pool_dtype).itemsize  # 1 under int8
+        if self.kv_quant:
+            ssh = self._kv_scales_sharding()
+            per += 2 * 4 * int(
+                np.prod(ssh.shard_shape((self.cfg.num_layers, self.num_pages, KH)))
+            )
         return [
             (f"{d.platform}:{d.id}", per) for d in self.mesh.devices.flat
         ]
@@ -880,10 +1028,19 @@ class ModelRunner:
             spec = P(*[None if ax == "tp" else ax for ax in spec])
         return NamedSharding(self.mesh, spec)
 
+    def _kv_scales_sharding(self) -> NamedSharding:
+        """Scales-pool sharding [L, P, KH]: the pool spec minus its
+        page-slot and head-dim axes — the KH axis shards over tp exactly
+        like the pages', so each chip holds its head-shard's scales."""
+        spec = self._kv_sharding().spec
+        return NamedSharding(self.mesh, P(spec[0], spec[1], spec[3]))
+
     def drop_kv_pools(self) -> None:
         """Release the KV pools' device memory (sleep level 1+)."""
         self.k_pages = None
         self.v_pages = None
+        self.k_scales = None
+        self.v_scales = None
 
     def offload_params(self) -> None:
         """Move params to host RAM (sleep level 2). Each process fetches its
@@ -932,10 +1089,23 @@ class ModelRunner:
 
     def reset_kv(self) -> None:
         """Zero the page pools (sleep/wake support frees and re-creates them)."""
-        kp, vp = self.module.init_kv_pages(self.cfg, self.num_pages, self.page_size)
+        kp, vp = self.module.init_kv_pages(
+            self.cfg, self.num_pages, self.page_size, **self._kv_init_kw
+        )
         kv_sh = self._kv_sharding()
         self.k_pages = jax.device_put(kp, kv_sh)
         self.v_pages = jax.device_put(vp, kv_sh)
+        if self.kv_quant:
+            from production_stack_tpu.ops.quant import init_kv_scales
+
+            KH = getattr(self.cfg, "num_kv_heads", 1)
+            sc_sh = self._kv_scales_sharding()
+            self.k_scales = jax.device_put(
+                init_kv_scales(self.cfg.num_layers, self.num_pages, KH), sc_sh
+            )
+            self.v_scales = jax.device_put(
+                init_kv_scales(self.cfg.num_layers, self.num_pages, KH), sc_sh
+            )
 
 
 def _multi_step_fn(forward, cfg, k, want_lp, want_pen, params, k_pages,
@@ -1030,7 +1200,7 @@ def _multi_step_deferred_fn(forward, cfg, k, want_lp, want_pen, params,
                             k_pages, v_pages, input_ids, positions,
                             page_table, kv_lens, kv_limits, temperature,
                             top_k, top_p, key, lora=None, lora_ids=None,
-                            pen=None, bias=None):
+                            pen=None, bias=None, kv_scales=None):
     """k fused decode steps with DEFERRED KV scatters (kv_burst mode).
 
     The classic _multi_step_fn gathers the batch's pages into a local block
@@ -1044,11 +1214,17 @@ def _multi_step_deferred_fn(forward, cfg, k, want_lp, want_pen, params,
     B = input_ids.shape[0]
     L, _, page_size, KH, D = k_pages.shape
     C = k
-    k_acc = jnp.zeros((L, B, C, KH, D), k_pages.dtype)
-    v_acc = jnp.zeros((L, B, C, KH, D), v_pages.dtype)
+    quant = kv_scales is not None
+    # int8 pools: the burst window holds the quantizer's fp INPUT (committed
+    # once, below); only the read path touches int8
+    acc_dt = cfg.dtype if quant else k_pages.dtype
+    k_acc = jnp.zeros((L, B, C, KH, D), acc_dt)
+    v_acc = jnp.zeros((L, B, C, KH, D), acc_dt)
     counts = jnp.zeros((B,), jnp.int32)
     pos0 = positions[:, 0]
     kw = {} if lora is None else {"lora": lora, "lora_ids": lora_ids}
+    if quant:
+        kw["kv_scales"] = kv_scales
     keys = jax.random.split(key, k)
     if want_pen:
         hist0, plens, pres, freq, rep = pen
@@ -1110,6 +1286,24 @@ def _multi_step_deferred_fn(forward, cfg, k, want_lp, want_pen, params,
         pos0[:, None] + jj,
         -1,
     )
+    if quant:
+        # the decode feedback write IS the quantizer (ops/quant.py): fresh
+        # pages reset their scale, mid-page appends grow it and re-quantize
+        from production_stack_tpu.ops.quant import (
+            write_kv_pages_all_layers_quant,
+        )
+
+        k_scales, v_scales = kv_scales
+        k_pages, v_pages, k_scales, v_scales = write_kv_pages_all_layers_quant(
+            k_pages, v_pages, k_scales, v_scales, k_acc, v_acc,
+            page_table, commit_pos,
+        )
+        if want_lp:
+            _, lp, tids, tlp = emitted
+            return (toks.T, lp.T, jnp.swapaxes(tids, 0, 1),
+                    jnp.swapaxes(tlp, 0, 1), hist_f, k_pages, v_pages,
+                    k_scales, v_scales)
+        return toks.T, hist_f, k_pages, v_pages, k_scales, v_scales
     k_pages, v_pages = write_kv_pages_all_layers(
         k_pages, v_pages, k_acc, v_acc, page_table, commit_pos
     )
@@ -1226,12 +1420,21 @@ def _spec_fn(forward, cfg, steps, k, n, params, k_pages, v_pages, history,
 
 def _step_fn(forward, cfg, want_lp, want_pen, params, k_pages, v_pages,
              input_ids, positions, page_table, kv_lens, temperature, top_k,
-             top_p, key, lora=None, lora_ids=None, pen=None, bias=None):
+             top_p, key, lora=None, lora_ids=None, pen=None, bias=None,
+             kv_scales=None):
     kw = {} if lora is None else {"lora": lora, "lora_ids": lora_ids}
-    logits, k_pages, v_pages = forward(
-        params, cfg, input_ids, positions, k_pages, v_pages, page_table, kv_lens,
-        **kw,
-    )
+    quant = kv_scales is not None
+    if quant:
+        kw["kv_scales"] = kv_scales
+        logits, k_pages, v_pages, k_sc, v_sc = forward(
+            params, cfg, input_ids, positions, k_pages, v_pages, page_table,
+            kv_lens, **kw,
+        )
+    else:
+        logits, k_pages, v_pages = forward(
+            params, cfg, input_ids, positions, k_pages, v_pages, page_table,
+            kv_lens, **kw,
+        )
     sample_from = logits
     if want_pen:
         hist, plens, pres, freq, rep = pen
@@ -1247,6 +1450,10 @@ def _step_fn(forward, cfg, want_lp, want_pen, params, k_pages, v_pages,
         ids, lp, tids, tlp = sample_with_logprobs(
             logits, key, temperature, top_k, top_p, sample_from=sample_from
         )
+        if quant:
+            return ids, logits, lp, tids, tlp, k_pages, v_pages, k_sc, v_sc
         return ids, logits, lp, tids, tlp, k_pages, v_pages
     ids = sample(sample_from, key, temperature, top_k, top_p)
+    if quant:
+        return ids, logits, k_pages, v_pages, k_sc, v_sc
     return ids, logits, k_pages, v_pages

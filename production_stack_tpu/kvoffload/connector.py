@@ -115,7 +115,15 @@ class KVOffloadConnector:
         engine_url: str = "",
     ):
         self.runner = runner
-        self.serde = get_serde(serde)
+        # quantized pools (runner.kv_quant, ops/quant.py): the serde
+        # boundary ships the pool's OWN int8 bytes + scales (format v3) —
+        # every tier, the cache server, warm starts, directory pulls, and
+        # migration snapshots move the halved byte stream, and a local
+        # spill + restore is bit-exact (no requant drift). The configured
+        # serde would either double bytes (naive dequant) or requantize
+        # lossily (int8 transport), so int8page overrides it.
+        self.quant = bool(getattr(runner, "kv_quant", False))
+        self.serde = get_serde("int8page" if self.quant else serde)
         self.reporter: Optional[ControllerReporter] = None
         if controller_url and instance_id:
             self.reporter = ControllerReporter(
@@ -144,6 +152,29 @@ class KVOffloadConnector:
 
     # -- KVPageManager hooks (engine device thread) ---------------------------
 
+    def _serialize_pages(self, pids: "list[int]") -> "list[bytes]":
+        """Blobs for a batch of pool pages — ONE device fetch. Quantized
+        pools ship their exact int8 bytes + scales (v3); fp pools go
+        through the configured serde."""
+        if self.quant:
+            ks, vs, sks, svs = self.runner.get_pages_quant(pids)
+            try:  # the fp dtype a non-quant reader should dequantize into
+                dt = np.dtype(getattr(self.runner.cfg, "dtype", None))
+            except TypeError:
+                dt = None
+            return [
+                self.serde.serialize_quant(
+                    np.asarray(k), np.asarray(sk), np.asarray(v),
+                    np.asarray(sv), orig_dtype=dt,
+                )
+                for k, v, sk, sv in zip(ks, vs, sks, svs)
+            ]
+        ks, vs = self.runner.get_pages(pids)
+        return [
+            self.serde.serialize(np.asarray(k), np.asarray(v))
+            for k, v in zip(ks, vs)
+        ]
+
     def save_page(self, pid: int, h: bytes) -> None:
         """Offload one HBM page before its slot is reused. Never raises — an
         offload I/O failure (ENOSPC, remote down) must not kill the engine
@@ -156,8 +187,7 @@ class KVOffloadConnector:
             key = h.hex()
             if self.store.contains_local(key):
                 return  # blob already offloaded (e.g. restored earlier); skip
-            k, v = self.runner.get_page(pid)
-            blob = self.serde.serialize(np.asarray(k), np.asarray(v))
+            blob = self._serialize_pages([pid])[0]
             self.store.put(key, blob)
             self.saved_pages += 1
         except Exception:
@@ -192,9 +222,8 @@ class KVOffloadConnector:
                     todo.append((pid, h))
             for i in range(0, len(todo), 64):
                 chunk = todo[i : i + 64]
-                ks, vs = self.runner.get_pages([pid for pid, _ in chunk])
-                for (pid, h), k, v in zip(chunk, ks, vs):
-                    blob = self.serde.serialize(np.asarray(k), np.asarray(v))
+                blobs = self._serialize_pages([pid for pid, _ in chunk])
+                for (pid, h), blob in zip(chunk, blobs):
                     self.store.put(h.hex(), blob)
                     self.saved_pages += 1
                     stored += 1
@@ -207,6 +236,27 @@ class KVOffloadConnector:
             self.report_evict([h for _, h in todo[stored:]])
         return ok
 
+    def _deserialize_for_pool(self, blob: bytes):
+        """Blob -> the tuple the runner's restore path wants: (k, v) for fp
+        pools, (qk, sk, qv, sv) for quantized ones. Cross-dtype blobs
+        convert at this boundary (fp blob -> host quantize; v3 blob -> fp
+        dequant via the recorded serde)."""
+        if self.quant:
+            return serde_mod.get_serde("int8page").deserialize_quant(blob)
+        return serde_mod.deserialize(blob, verify=False)
+
+    def _set_pool_pages(self, ids: "list[int]", payloads: "list") -> None:
+        if self.quant:
+            self.runner.set_pages_quant(
+                ids,
+                [p[0] for p in payloads], [p[2] for p in payloads],
+                [p[1] for p in payloads], [p[3] for p in payloads],
+            )
+        else:
+            self.runner.set_pages(
+                ids, [p[0] for p in payloads], [p[1] for p in payloads]
+            )
+
     def load_pages(self, pairs: "list[tuple[int, bytes]]") -> int:
         """Restore a batch of pages into HBM — one upload + one scatter
         program per <=64 pages (see save_pages). Returns the length of the
@@ -215,23 +265,21 @@ class KVOffloadConnector:
         raises."""
         done = 0
         batch_ids: list[int] = []
-        batch_k: list = []
-        batch_v: list = []
+        batch_p: list = []
 
         def flush() -> bool:
             nonlocal done
             if not batch_ids:
                 return True
             try:
-                self.runner.set_pages(batch_ids, batch_k, batch_v)
+                self._set_pool_pages(batch_ids, batch_p)
             except Exception:
                 logger.exception("kv offload batched restore failed")
                 return False
             done += len(batch_ids)
             self.loaded_pages += len(batch_ids)
             batch_ids.clear()
-            batch_k.clear()
-            batch_v.clear()
+            batch_p.clear()
             return True
 
         for pid, h in pairs:
@@ -251,10 +299,8 @@ class KVOffloadConnector:
                 blob = self.store.get(h.hex())
                 if blob is None:
                     break
-                k, v = serde_mod.deserialize(blob, verify=False)
                 batch_ids.append(pid)
-                batch_k.append(k)
-                batch_v.append(v)
+                batch_p.append(self._deserialize_for_pool(blob))
                 if len(batch_ids) >= 64 and not flush():
                     return done
             except Exception:
@@ -273,14 +319,13 @@ class KVOffloadConnector:
         ok = [False] * len(pairs)
         batch_idx: list[int] = []
         batch_ids: list[int] = []
-        batch_k: list = []
-        batch_v: list = []
+        batch_p: list = []
 
         def flush() -> None:
             if not batch_ids:
                 return
             try:
-                self.runner.set_pages(batch_ids, batch_k, batch_v)
+                self._set_pool_pages(batch_ids, batch_p)
             except Exception:
                 logger.exception("kv warm restore batch failed")
             else:
@@ -289,19 +334,17 @@ class KVOffloadConnector:
                 self.loaded_pages += len(batch_ids)
             batch_idx.clear()
             batch_ids.clear()
-            batch_k.clear()
-            batch_v.clear()
+            batch_p.clear()
 
         for i, (pid, h) in enumerate(pairs):
             try:
                 blob = self.store.get(h.hex())  # verifies + quarantines
                 if blob is None:
                     continue
-                k, v = serde_mod.deserialize(blob)
+                serde_mod.verify_blob(blob)
                 batch_idx.append(i)
                 batch_ids.append(pid)
-                batch_k.append(k)
-                batch_v.append(v)
+                batch_p.append(self._deserialize_for_pool(blob))
                 if len(batch_ids) >= 64:
                     flush()
             except Exception:
@@ -341,8 +384,11 @@ class KVOffloadConnector:
             blob = self.store.get(h.hex())
             if blob is None:
                 return False
-            k, v = serde_mod.deserialize(blob, verify=False)
-            self.runner.set_page(pid, k, v)
+            if self.quant:
+                self._set_pool_pages([pid], [self._deserialize_for_pool(blob)])
+            else:
+                k, v = serde_mod.deserialize(blob, verify=False)
+                self.runner.set_page(pid, k, v)
             self.loaded_pages += 1
             return True
         except Exception:

@@ -9,15 +9,25 @@ helm/templates/deployment-vllm-multi.yaml:309-314):
 - ``int8``: per-(layer, head) symmetric int8 quantization (CacheGen-style
   compression, lossy but ~2x smaller than bf16) for DCN/disk tiers.
 
+- ``int8page``: the QUANTIZED-POOL passthrough serde (format v3): when the
+  engine runs ``kv_cache_dtype=int8`` (ops/quant.py) the pool already holds
+  int8 pages + per-page per-kv-head scales, and this serde ships those
+  EXACT bytes — no dequant/requant round trip, and every KV hop (offload
+  tiers, cache server, warm-start manifests, directory pulls, migration
+  snapshots) moves the halved byte stream. The scales travel INSIDE the
+  blob body, CRC-framed with it, and ``split_kv_heads_quant`` /
+  ``join_kv_heads_quant`` keep the blobs tp-invariant like fp ones.
+
 Blob layout: ``u32 header_len | header JSON | body``.
 
-Integrity (format v2): the header additionally records ``v`` (format
+Integrity (format v2+): the header additionally records ``v`` (format
 version), ``blen`` (body length) and ``crc`` (CRC32 of the body). Readers
 call :func:`verify_blob` before trusting a blob pulled from any tier — a
 bit-flipped or truncated page must convert to a cache MISS (recompute), never
 to silently-wrong KV. v1 blobs (no ``crc``) still parse, so a disk tier
 surviving an upgrade keeps serving; a blob from a FUTURE format version is
-rejected as unreadable rather than misparsed.
+rejected as unreadable rather than misparsed (a v2-era reader refuses v3
+quantized blobs instead of misparsing their scales as KV).
 """
 
 from __future__ import annotations
@@ -37,8 +47,9 @@ except ImportError:  # pragma: no cover
 
 _HDR = struct.Struct("!I")
 
-# blob format version written by this build; readers accept <= this
-SERDE_FORMAT_VERSION = 2
+# blob format version written by this build; readers accept <= this.
+# v3 adds the quantized-page body layout (int8page serde).
+SERDE_FORMAT_VERSION = 3
 
 
 class KVIntegrityError(ValueError):
@@ -46,9 +57,15 @@ class KVIntegrityError(ValueError):
     treat the entry as a miss (quarantine + recompute), never deserialize."""
 
 
-def _seal(hdr: dict, body: bytes) -> bytes:
-    """Finish a blob: stamp version + body length + CRC32 into the header."""
-    hdr["v"] = SERDE_FORMAT_VERSION
+def _seal(hdr: dict, body: bytes, version: int = 2) -> bytes:
+    """Finish a blob: stamp version + body length + CRC32 into the header.
+
+    ``version`` is the MINIMUM format version able to parse this blob —
+    fp blobs keep stamping v2 so a mixed-version fleet's older readers
+    still accept them during a rolling upgrade; only quantized-page blobs
+    (whose body layout is new) claim v3 and get refused by old readers
+    instead of misparsed."""
+    hdr["v"] = version
     hdr["blen"] = len(body)
     hdr["crc"] = zlib.crc32(body) & 0xFFFFFFFF
     enc = json.dumps(hdr).encode()
@@ -180,6 +197,99 @@ class Int8Serde(NaiveSerde):
         return k, v
 
 
+class Int8PageSerde(NaiveSerde):
+    """Quantized-POOL passthrough serde (format v3, ops/quant.py contract).
+
+    Unlike :class:`Int8Serde` — which quantizes an fp page at serialize
+    time and dequantizes at deserialize time (a lossy transport encoding) —
+    this serde ships the pool's OWN int8 bytes and per-page per-kv-head
+    scales verbatim: ``serialize_quant``/``deserialize_quant`` round-trip
+    bit-exactly, so a spill + restore on a quantized engine reproduces the
+    exact pool state (no requant drift), and every tier/hop moves half the
+    bytes. ``deserialize`` (the generic fp entry point) dequantizes, so a
+    NON-quantized engine pulling a v3 blob from the shared tier still gets
+    usable fp KV; ``serialize``/``deserialize_quant`` quantize/accept fp
+    input, covering the other cross-dtype direction.
+
+    Body layout: ``sk [L, KH] f32 | qk [L, page, KH, D] int8 | sv | qv``.
+    """
+
+    name = "int8page"
+
+    def serialize(self, k: np.ndarray, v: np.ndarray) -> bytes:
+        from production_stack_tpu.ops.quant import quantize_page_host
+
+        qk, sk = quantize_page_host(np.asarray(k))
+        qv, sv = quantize_page_host(np.asarray(v))
+        return self.serialize_quant(qk, sk, qv, sv, orig_dtype=k.dtype)
+
+    def serialize_quant(
+        self, qk: np.ndarray, sk: np.ndarray, qv: np.ndarray, sv: np.ndarray,
+        orig_dtype=None,
+    ) -> bytes:
+        """Pool bytes in, blob out — zero-copy of the quantized state."""
+        hdr = {
+            "serde": self.name,
+            "shape": list(qk.shape),
+            "dtype": _dtype_name(
+                np.dtype(orig_dtype) if orig_dtype is not None else BF16
+            ),
+        }
+        body = (
+            np.ascontiguousarray(sk, np.float32).tobytes()
+            + np.ascontiguousarray(qk, np.int8).tobytes()
+            + np.ascontiguousarray(sv, np.float32).tobytes()
+            + np.ascontiguousarray(qv, np.int8).tobytes()
+        )
+        return _seal(hdr, body, version=3)
+
+    @staticmethod
+    def _split_quant(blob: bytes):
+        hdr, body = NaiveSerde._split(blob)
+        L, page, KH, D = hdr["shape"]
+        sbytes = L * KH * 4
+        qbytes = L * page * KH * D
+
+        def part(off):
+            s = np.frombuffer(body[off : off + sbytes], np.float32)
+            q = np.frombuffer(
+                body[off + sbytes : off + sbytes + qbytes], np.int8
+            )
+            return (
+                q.reshape(L, page, KH, D),
+                s.reshape(L, KH),
+            )
+
+        qk, sk = part(0)
+        qv, sv = part(sbytes + qbytes)
+        return hdr, qk, sk, qv, sv
+
+    def deserialize_quant(self, blob: bytes):
+        """(qk, sk, qv, sv) — the exact pool bytes. Accepts fp blobs from
+        other serdes too (cross-dtype restore): those quantize host-side
+        with fresh per-page scales."""
+        hdr, _ = NaiveSerde._split(blob)
+        if hdr.get("serde") != self.name:
+            from production_stack_tpu.ops.quant import quantize_page_host
+
+            k, v = get_serde(hdr.get("serde", "naive")).deserialize(blob)
+            qk, sk = quantize_page_host(np.asarray(k))
+            qv, sv = quantize_page_host(np.asarray(v))
+            return qk, sk, qv, sv
+        _, qk, sk, qv, sv = self._split_quant(blob)
+        return qk, sk, qv, sv
+
+    def deserialize(self, blob: bytes) -> tuple[np.ndarray, np.ndarray]:
+        from production_stack_tpu.ops.quant import dequantize_page_host
+
+        hdr, qk, sk, qv, sv = self._split_quant(blob)
+        dt = _dtype_of(hdr["dtype"])
+        return (
+            dequantize_page_host(qk, sk, dt),
+            dequantize_page_host(qv, sv, dt),
+        )
+
+
 # -- tensor-parallel shard boundary -------------------------------------------
 #
 # Under tensor parallelism the device pool holds one KV-HEAD SHARD of every
@@ -220,7 +330,39 @@ def join_kv_heads(
     )
 
 
-SERDES = {"naive": NaiveSerde, "int8": Int8Serde}
+def split_kv_heads_quant(
+    qk: np.ndarray, sk: np.ndarray, qv: np.ndarray, sv: np.ndarray,
+    shards: int,
+) -> "list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]":
+    """Quantized twin of :func:`split_kv_heads`: per-kv-head scales split
+    ALONG their heads (axis 1 of [L, KH]) exactly like the page bytes'
+    KH axis, so a tp=4 engine's shard i carries precisely the scales for
+    its heads — blobs stay tp-invariant under int8 too."""
+    KH = qk.shape[2]
+    if KH % shards:
+        raise ValueError(f"cannot split {KH} kv heads into {shards} shards")
+    return [
+        (k, s_k, v, s_v)
+        for (k, s_k), (v, s_v) in zip(
+            zip(np.split(qk, shards, axis=2), np.split(sk, shards, axis=1)),
+            zip(np.split(qv, shards, axis=2), np.split(sv, shards, axis=1)),
+        )
+    ]
+
+
+def join_kv_heads_quant(
+    parts: "list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]",
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Inverse of :func:`split_kv_heads_quant` (shard order = head order)."""
+    return (
+        np.concatenate([p[0] for p in parts], axis=2),
+        np.concatenate([p[1] for p in parts], axis=1),
+        np.concatenate([p[2] for p in parts], axis=2),
+        np.concatenate([p[3] for p in parts], axis=1),
+    )
+
+
+SERDES = {"naive": NaiveSerde, "int8": Int8Serde, "int8page": Int8PageSerde}
 
 
 def get_serde(name: str):

@@ -63,6 +63,10 @@ class Gemma2Config:
     prefill_pages_per_block: int = 0  # same contract as LlamaConfig
     prefill_prefetch_pages: int = 0
     prefill_fused_kv_write: bool = True
+    # KV cache dtype (same contract as LlamaConfig.kv_cache_dtype): "int8"
+    # stores quantized pages + per-page per-kv-head scales (ops/quant.py);
+    # ModelRunner builds the scales pools and threads them as ``kv_scales``
+    kv_cache_dtype: str = "auto"
 
     @property
     def tie_word_embeddings(self) -> bool:
@@ -191,6 +195,7 @@ def forward(
     all_logits: bool = False,
     kv_burst=None,
     mesh=None,
+    kv_scales=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
@@ -210,6 +215,13 @@ def forward(
 
     post_write = cfg.kv_write_mode == "post"
     burst = kv_burst is not None
+    quant = kv_scales is not None
+    if quant:
+        k_scales, v_scales = kv_scales
+        if not post_write:
+            raise ValueError("kv_cache_dtype=int8 requires kv_write_mode='post'")
+    else:
+        k_scales = v_scales = None
     if burst:
         if not post_write or T != 1:
             raise ValueError("kv_burst requires kv_write_mode='post' decode")
@@ -247,16 +259,25 @@ def forward(
 
     def layer(x_carry, layer_in):
         if fused_prefill:
-            x, kp_c, vp_c = x_carry  # pools ride the scan as aliased carry
+            if quant:  # scales pools ride the same aliased carry
+                x, kp_c, vp_c, ksc_c, vsc_c = x_carry
+            else:
+                x, kp_c, vp_c = x_carry  # pools ride the scan as aliased carry
+                ksc_c = vsc_c = None
         else:
             x = x_carry
-            kp_c = vp_c = None
+            kp_c = vp_c = ksc_c = vsc_c = None
+        ksl = vsl = None  # per-layer scale slices (non-stream int8 path)
         if stream_pools:
             if burst:
                 lp, li, window, ka, va = layer_in
             else:
                 lp, li, window = layer_in
             kp = vp = None
+        elif quant and burst:
+            lp, kp, vp, ksl, vsl, window, ka, va = layer_in
+        elif quant:
+            lp, kp, vp, ksl, vsl, window = layer_in
         elif burst:
             lp, kp, vp, window, ka, va = layer_in
         else:
@@ -267,7 +288,9 @@ def forward(
         k = (h @ lp["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
         q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-        pool_dt = k_pages.dtype
+        # in-register window / chunk K/V stay fp under int8 pools — they
+        # feed the quantizer (post-scan commit or fused in-kernel write)
+        pool_dt = cfg.dtype if quant else k_pages.dtype
         if burst:
             rows = jnp.arange(B, dtype=jnp.int32)
             cnt = burst_counts
@@ -298,8 +321,12 @@ def forward(
                 cur_kw = dict(k_cur=None, v_cur=None)
             if stream_pools:
                 pool_args, layer_kw = (k_pages, v_pages), {"layer": li}
+                if quant:
+                    layer_kw.update(k_scales=k_scales, v_scales=v_scales)
             else:
                 pool_args, layer_kw = (kp, vp), {}
+                if quant:
+                    layer_kw.update(k_scales=ksl, v_scales=vsl)
             common = dict(
                 window=window, sm_scale=sm_scale,
                 logit_softcap=cfg.attn_logit_softcap,
@@ -331,6 +358,9 @@ def forward(
                 prefetch_pages=cfg.prefill_prefetch_pages or None,
                 layer=li,
             )
+            if quant:
+                kernel_kw["k_scales"] = ksc_c if fused_prefill else k_scales
+                kernel_kw["v_scales"] = vsc_c if fused_prefill else v_scales
             kernel_args = (
                 q,
                 kp_c if fused_prefill else k_pages,
@@ -339,7 +369,11 @@ def forward(
                 k.astype(pool_dt), v.astype(pool_dt),
                 jnp.sum(positions >= 0, axis=1).astype(jnp.int32),
             )
-            if fused_prefill:
+            if fused_prefill and quant:
+                attn, kp_c, vp_c, ksc_c, vsc_c = ragged_paged_attention_prefill(
+                    *kernel_args, fused_write=True, **kernel_kw
+                )
+            elif fused_prefill:
                 attn, kp_c, vp_c = ragged_paged_attention_prefill(
                     *kernel_args, fused_write=True, **kernel_kw
                 )
@@ -348,7 +382,16 @@ def forward(
                     *kernel_args, **kernel_kw
                 )
         elif post_write:
-            kc, vc = gather_kv_pages(kp, vp, page_table)
+            if quant:
+                from production_stack_tpu.ops.quant import (
+                    gather_kv_pages_quant,
+                )
+
+                kc, vc = gather_kv_pages_quant(
+                    kp, vp, ksl, vsl, page_table, dtype=cfg.dtype
+                )
+            else:
+                kc, vc = gather_kv_pages(kp, vp, page_table)
             if burst:
                 kc = jnp.concatenate([kc, kwin.astype(kc.dtype)], axis=1)
                 vc = jnp.concatenate([vc, vwin.astype(vc.dtype)], axis=1)
@@ -375,6 +418,8 @@ def forward(
         x = x + _rms_norm_1p(mlp, lp["post_mlp_norm"], eps)
         if fused_prefill:
             # the kernel already committed this layer's K/V to the pool
+            if quant:
+                return (x, kp_c, vp_c, ksc_c, vsc_c), None
             return (x, kp_c, vp_c), None
         if burst:
             out_kv = (kwin, vwin)
@@ -390,15 +435,36 @@ def forward(
             jnp.arange(cfg.num_layers, dtype=jnp.int32),
             _layer_windows(cfg),
         )
+    elif quant:
+        xs = (
+            params["layers"], k_pages, v_pages, k_scales, v_scales,
+            _layer_windows(cfg),
+        )
     else:
         xs = (params["layers"], k_pages, v_pages, _layer_windows(cfg))
     if burst:
         x, (k_acc, v_acc) = lax.scan(layer, x, xs + (k_acc0, v_acc0))
         # no pool write: the caller commits the burst once (deferred mode)
+    elif fused_prefill and quant:
+        # no post-scan scatter: every layer's kernel wrote its pool + scale
+        # slices in place
+        (x, k_pages, v_pages, k_scales, v_scales), _ = lax.scan(
+            layer, (x, k_pages, v_pages, k_scales, v_scales), xs
+        )
     elif fused_prefill:
         # no post-scan scatter: every layer's kernel wrote its pool slice
         (x, k_pages, v_pages), _ = lax.scan(
             layer, (x, k_pages, v_pages), xs
+        )
+    elif post_write and quant:
+        x, (k_new, v_new) = lax.scan(layer, x, xs)
+        from production_stack_tpu.ops.quant import (
+            write_kv_pages_all_layers_quant,
+        )
+
+        k_pages, v_pages, k_scales, v_scales = write_kv_pages_all_layers_quant(
+            k_pages, v_pages, k_scales, v_scales, k_new, v_new,
+            page_table, positions,
         )
     elif post_write:
         x, (k_new, v_new) = lax.scan(layer, x, xs)
@@ -419,4 +485,6 @@ def forward(
         logits = cap * jnp.tanh(logits / cap)
     if burst:
         return logits, k_acc, v_acc
+    if quant:
+        return logits, k_pages, v_pages, k_scales, v_scales
     return logits, k_pages, v_pages

@@ -93,6 +93,15 @@ class LlamaConfig:
     # layer scan stops stacking per-layer K/V and the post-scan
     # write_kv_pages_all_layers pass disappears from the prefill path
     prefill_fused_kv_write: bool = True
+    # KV cache dtype: "auto" (= cfg.dtype), "bf16"/"fp16" (explicit fp), or
+    # "int8" — quantized pages with per-page per-kv-head scales in a
+    # parallel scales pool (ops/quant.py): HALF the HBM bytes every decode
+    # step streams and double the effective pool capacity. Dequantization
+    # happens inside the kernels' VMEM copy rings (and at the XLA gather on
+    # the fallback path); quantization inside the fused prefill write and
+    # on the decode feedback commit. Requires kv_write_mode="post";
+    # ModelRunner builds the scales pools and threads them as ``kv_scales``.
+    kv_cache_dtype: str = "auto"
 
     @staticmethod
     def from_hf_config(cfg: dict) -> "LlamaConfig":
@@ -423,6 +432,7 @@ def forward(
     all_logits: bool = False,
     mesh=None,
     kv_burst: Optional[tuple] = None,
+    kv_scales: Optional[tuple] = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step (prefill chunk or decode) with paged KV.
 
@@ -448,10 +458,18 @@ def forward(
                   token appended at slot ``counts``. The caller commits once
                   per burst (runner._multi_step_fn) — this is what keeps the
                   burst scan free of pool-sized copies.
+      kv_scales:  (k_scales, v_scales) [L, P, KH] f32 when the pools are
+                  int8 (cfg.kv_cache_dtype="int8", ops/quant.py contract):
+                  reads dequantize in-kernel (or at the XLA gather), writes
+                  quantize (fused prefill write / post-scan commit), and
+                  the return grows to (logits, k_pages, v_pages, k_scales,
+                  v_scales). kv_burst keeps its 3-tuple return (the pools
+                  and scales stay read-only through the burst).
 
     Returns (logits[B, V] for each sequence's last valid token — or [B, T, V]
              when ``all_logits`` — and k_pages, v_pages updated; with
-             ``kv_burst``: (logits, k_acc', v_acc')).
+             ``kv_burst``: (logits, k_acc', v_acc'); with ``kv_scales``:
+             (logits, k_pages, v_pages, k_scales, v_scales)).
     """
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     pp = mesh.shape.get("pp", 1) if mesh is not None else 1
@@ -479,6 +497,19 @@ def forward(
 
     post_write = cfg.kv_write_mode == "post"
     burst = kv_burst is not None
+    quant = kv_scales is not None
+    if quant:
+        k_scales, v_scales = kv_scales
+        if not post_write:
+            raise ValueError("kv_cache_dtype=int8 requires kv_write_mode='post'")
+        if sp > 1 or pp > 1:
+            # the ring's sp sharding and the pipeline's stage relay both
+            # move raw pool slices without their scales
+            raise ValueError(
+                "kv_cache_dtype=int8 does not compose with sp/pp meshes"
+            )
+    else:
+        k_scales = v_scales = None
     if burst:
         if not post_write or T != 1:
             raise ValueError("kv_burst requires kv_write_mode='post' decode")
@@ -548,17 +579,27 @@ def forward(
         if fused_prefill:
             # the pools ride the scan as CARRY: each layer's kernel writes
             # its own slice in place (aliased input->output), so the carry
-            # chain is copy-free and the scan emits no stacked K/V
-            x, aux, kp_c, vp_c = x_aux
+            # chain is copy-free and the scan emits no stacked K/V (under
+            # int8 the scales pools ride the same carry)
+            if quant:
+                x, aux, kp_c, vp_c, ksc_c, vsc_c = x_aux
+            else:
+                x, aux, kp_c, vp_c = x_aux
+                ksc_c = vsc_c = None
         else:
             x, aux = x_aux
-            kp_c = vp_c = None
+            kp_c = vp_c = ksc_c = vsc_c = None
+        ksl = vsl = None  # per-layer scale slices (non-stream int8 path)
         if stream_pools:
             if burst:
                 lp, li, ll, ka, va = layer_in
             else:
                 lp, li, ll = layer_in  # per-layer params + layer index
             kp = vp = None
+        elif quant and burst:
+            lp, kp, vp, ksl, vsl, ll, ka, va = layer_in
+        elif quant:
+            lp, kp, vp, ksl, vsl, ll = layer_in
         elif burst:
             lp, kp, vp, ll, ka, va = layer_in
         else:
@@ -602,7 +643,9 @@ def forward(
                 ragged_paged_attention_decode_sharded,
             )
 
-            pool_dt = k_pages.dtype
+            # the in-register window stays fp under int8 pools — it is the
+            # quantizer's INPUT, committed by the post-scan quant scatter
+            cur_dt = cfg.dtype if quant else k_pages.dtype
             if burst:
                 cur_kw = dict(
                     k_cur=kwin, v_cur=vwin,
@@ -610,8 +653,8 @@ def forward(
                 )
             elif post_write:
                 cur_kw = dict(
-                    k_cur=k[:, 0].astype(pool_dt),
-                    v_cur=v[:, 0].astype(pool_dt),
+                    k_cur=k[:, 0].astype(cur_dt),
+                    v_cur=v[:, 0].astype(cur_dt),
                 )
             else:
                 cur_kw = dict(k_cur=None, v_cur=None)
@@ -625,8 +668,14 @@ def forward(
             if stream_pools:
                 pool_args = (k_pages, v_pages)
                 pallas_kw["layer"] = li
+                if quant:
+                    pallas_kw["k_scales"] = k_scales
+                    pallas_kw["v_scales"] = v_scales
             else:
                 pool_args = (kp, vp)
+                if quant:
+                    pallas_kw["k_scales"] = ksl
+                    pallas_kw["v_scales"] = vsl
             # under pp the kernel runs INSIDE the pipeline's manual region.
             # With partial-manual shard_map that nests (the sharded call maps
             # the remaining axes); without it (old jax) the pipeline region
@@ -660,7 +709,7 @@ def forward(
                 ragged_paged_attention_prefill,
             )
 
-            pool_dt = k_pages.dtype
+            chunk_dt = cfg.dtype if quant else k_pages.dtype
             kernel_kw = dict(
                 window=cfg.sliding_window,
                 interpret=cfg.attn_impl == "pallas_interpret",
@@ -670,15 +719,22 @@ def forward(
                 or None,
                 layer=li,
             )
+            if quant:
+                kernel_kw["k_scales"] = ksc_c if fused_prefill else k_scales
+                kernel_kw["v_scales"] = vsc_c if fused_prefill else v_scales
             kernel_args = (
                 q,
                 kp_c if fused_prefill else k_pages,
                 vp_c if fused_prefill else v_pages,
                 aux["page_table"], aux["positions"], aux["kv_lens"],
-                k.astype(pool_dt), v.astype(pool_dt),
+                k.astype(chunk_dt), v.astype(chunk_dt),
                 jnp.sum(aux["positions"] >= 0, axis=1).astype(jnp.int32),
             )
-            if fused_prefill:
+            if fused_prefill and quant:
+                attn, kp_c, vp_c, ksc_c, vsc_c = ragged_paged_attention_prefill(
+                    *kernel_args, fused_write=True, **kernel_kw
+                )
+            elif fused_prefill:
                 attn, kp_c, vp_c = ragged_paged_attention_prefill(
                     *kernel_args, fused_write=True, **kernel_kw
                 )
@@ -687,7 +743,16 @@ def forward(
                     *kernel_args, **kernel_kw
                 )
         else:
-            kc, vc = gather_kv_pages(kp, vp, aux["page_table"])
+            if quant:
+                from production_stack_tpu.ops.quant import (
+                    gather_kv_pages_quant,
+                )
+
+                kc, vc = gather_kv_pages_quant(
+                    kp, vp, ksl, vsl, aux["page_table"], dtype=cfg.dtype
+                )
+            else:
+                kc, vc = gather_kv_pages(kp, vp, aux["page_table"])
             if burst:
                 kc = jnp.concatenate([kc, kwin.astype(kc.dtype)], axis=1)
                 vc = jnp.concatenate([vc, vwin.astype(vc.dtype)], axis=1)
@@ -741,13 +806,15 @@ def forward(
         x = _mlp_residual(x, lp, cfg, proj)
         if fused_prefill:
             # the kernel already committed this layer's K/V to the pool
+            if quant:
+                return (x, aux, kp_c, vp_c, ksc_c, vsc_c), None
             return (x, aux, kp_c, vp_c), None
         if burst:
             out_kv = (kwin, vwin)  # stacked by the scan -> [L, B, C, KH, D]
         elif post_write:
-            out_kv = (
-                k.astype(k_pages.dtype), v.astype(v_pages.dtype)
-            )
+            # int8 pools: stack fp — the post-scan commit is the quantizer
+            store_dt = cfg.dtype if quant else k_pages.dtype
+            out_kv = (k.astype(store_dt), v.astype(store_dt))
         else:
             out_kv = (kp, vp)
         return (x, aux), out_kv
@@ -757,6 +824,12 @@ def forward(
         scan_xs = (
             params["layers"],
             jnp.arange(cfg.num_layers, dtype=jnp.int32),
+            lora_layers,
+        )
+    elif quant:
+        # per-layer scale slices ride the scan next to the pool slices
+        scan_xs = (
+            params["layers"], k_pages, v_pages, k_scales, v_scales,
             lora_layers,
         )
     else:
@@ -778,10 +851,26 @@ def forward(
         k_pages, v_pages = write_kv_pages_all_layers(
             k_pages, v_pages, k_new, v_new, page_table, positions
         )
+    elif fused_prefill and quant:
+        # no post-scan scatter: every layer's kernel wrote its pool + scale
+        # slices in place
+        (x, _, k_pages, v_pages, k_scales, v_scales), _ = lax.scan(
+            layer, (x, aux, k_pages, v_pages, k_scales, v_scales), scan_xs
+        )
     elif fused_prefill:
         # no post-scan scatter: every layer's kernel wrote its pool slice
         (x, _, k_pages, v_pages), _ = lax.scan(
             layer, (x, aux, k_pages, v_pages), scan_xs
+        )
+    elif post_write and quant:
+        (x, _), (k_new, v_new) = lax.scan(layer, (x, aux), scan_xs)
+        from production_stack_tpu.ops.quant import (
+            write_kv_pages_all_layers_quant,
+        )
+
+        k_pages, v_pages, k_scales, v_scales = write_kv_pages_all_layers_quant(
+            k_pages, v_pages, k_scales, v_scales, k_new, v_new,
+            page_table, positions,
         )
     elif post_write:
         (x, _), (k_new, v_new) = lax.scan(layer, (x, aux), scan_xs)
@@ -795,6 +884,11 @@ def forward(
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     if all_logits:
         # speculative verify: T is small (1 + draft length), so [B, T, V] fits
+        if quant:
+            return (
+                (x @ head).astype(jnp.float32),
+                k_pages, v_pages, k_scales, v_scales,
+            )
         return (x @ head).astype(jnp.float32), k_pages, v_pages
     # Select each sequence's last valid token before the vocab projection so the
     # logits tensor is [B, V], not [B, T, V] (a 2 GB save at V=128k, T=1k).
@@ -803,4 +897,6 @@ def forward(
     logits = (x_last @ head).astype(jnp.float32)
     if burst:
         return logits, k_acc, v_acc
+    if quant:
+        return logits, k_pages, v_pages, k_scales, v_scales
     return logits, k_pages, v_pages

@@ -282,6 +282,8 @@ def paged_attention_decode(
     k_cur: jnp.ndarray | None = None,   # [B, C, KH, D] in-register burst K/V
     v_cur: jnp.ndarray | None = None,
     cur_lens: jnp.ndarray | None = None,  # [B] valid window entries (1..C)
+    k_scales: jnp.ndarray | None = None,  # [P, KH] f32 (int8 pools, ops/quant.py)
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Decode-step attention: one query token per sequence against its pages.
 
@@ -294,8 +296,19 @@ def paged_attention_decode(
     A fused decode burst defers ALL its KV scatters this way: the pool stays
     read-only through the burst and the accumulated burst tokens ride in the
     window (runner._multi_step_fn).
+
+    With ``k_scales/v_scales`` the pools are int8 and the gather
+    dequantizes (ops/quant.py contract) — the oracle for the kernel's
+    in-ring dequant; ``k_cur/v_cur`` stay fp.
     """
-    k, v = gather_kv_pages(k_pages, v_pages, page_table)
+    if k_scales is not None:
+        from production_stack_tpu.ops.quant import gather_kv_pages_quant
+
+        k, v = gather_kv_pages_quant(
+            k_pages, v_pages, k_scales, v_scales, page_table, dtype=q.dtype
+        )
+    else:
+        k, v = gather_kv_pages(k_pages, v_pages, page_table)
     if k_cur is not None:
         B, C = k_cur.shape[0], k_cur.shape[1]
         if cur_lens is None:

@@ -81,7 +81,8 @@ def _decode_kernel(
     q_ref,       # [1, NH, D] (current cell's row)
     kp_hbm,      # [L, P, page_size, KH, D], memory_space=ANY (stays in HBM)
     vp_hbm,
-    *refs,       # [k_cur_ref, v_cur_ref ([1, C, KH, D]),] o_ref,
+    *refs,       # [ks_ref, vs_ref ([1, P, KH] f32 scale slabs, quantized),]
+                 # [k_cur_ref, v_cur_ref ([1, C, KH, D]),] o_ref,
                  # k_buf/v_buf ([R, page, KH, D] VMEM ring), ksem/vsem,
                  # m/l/acc scratch
     sm_scale: float,
@@ -90,15 +91,25 @@ def _decode_kernel(
     has_cur: bool,
     pages_per_block: int,
     prefetch: int,
+    quantized: bool = False,
 ):
+    i0 = 0
+    if quantized:
+        # int8 pools: the current layer's [P, KH] scale slabs ride as
+        # whole VMEM blocks (constant index map — fetched once), and each
+        # page dequantizes right after its DMA lands in the ring. The fp
+        # values never exist in HBM — only the halved int8 byte stream does.
+        ks_ref, vs_ref = refs[0], refs[1]
+        i0 = 2
     if has_cur:
         # write-after-attend mode: the last cl_ref[b] tokens' pool slots are
         # stale; their K/V arrive in-register (a fused burst accumulates up
         # to C of them) and fold in on the row's last live cell
         (k_cur_ref, v_cur_ref, o_ref, k_buf, v_buf, ksem, vsem,
-         m_ref, l_ref, acc_ref) = refs
+         m_ref, l_ref, acc_ref) = refs[i0:]
     else:
-        o_ref, k_buf, v_buf, ksem, vsem, m_ref, l_ref, acc_ref = refs
+        (o_ref, k_buf, v_buf, ksem, vsem,
+         m_ref, l_ref, acc_ref) = refs[i0:]
     N = pages_per_block
     R = prefetch
     page_size = k_buf.shape[1]
@@ -120,7 +131,8 @@ def _decode_kernel(
         page-stream index g = cell*N + i. A page is fetched iff its cell is
         live and it lies inside its row's live page range (livepg_ref, the
         same array the host packed the grid from) — the SAME predicate
-        gates start and wait, so semaphore counts always pair."""
+        gates start and wait, so semaphore counts always pair. Also returns
+        the page id so the quantized path can look up its scale row."""
         cc = jnp.minimum(g // N, n_cells - 1)
         bb = seq_ref[cc]
         pi = blk_ref[cc] * N + g % N  # page offset within the live range
@@ -130,10 +142,10 @@ def _decode_kernel(
         s = g % R
         kcp = pltpu.make_async_copy(kp_hbm.at[lyr, pid], k_buf.at[s], ksem.at[s])
         vcp = pltpu.make_async_copy(vp_hbm.at[lyr, pid], v_buf.at[s], vsem.at[s])
-        return ok, kcp, vcp
+        return ok, pid, kcp, vcp
 
     def _start(g):
-        ok, kcp, vcp = _copies(g)
+        ok, _, kcp, vcp = _copies(g)
 
         @pl.when(ok)
         def _():
@@ -166,7 +178,7 @@ def _decode_kernel(
         def _(i=i):
             g = c * N + i
             _start(g + R - 1)
-            ok, kcp, vcp = _copies(g)
+            ok, pid, kcp, vcp = _copies(g)
 
             @pl.when(ok)
             def _():
@@ -176,6 +188,11 @@ def _decode_kernel(
                 q = (q_ref[0].astype(jnp.float32) * sm_scale).reshape(KH, G, D)
                 k = k_buf[s].astype(jnp.float32).transpose(1, 0, 2)  # [KH, page, D]
                 v = v_buf[s].astype(jnp.float32).transpose(1, 0, 2)
+                if quantized:
+                    # dequant at the VMEM ring exit: per-page per-kv-head
+                    # scale rows looked up from the resident slab
+                    k = k * ks_ref[0, pid][:, None, None]
+                    v = v * vs_ref[0, pid][:, None, None]
                 # batched over KH: [KH, G, D] x [KH, page, D] -> [KH, G, page]
                 scores = lax.dot_general(
                     q, k, (((2,), (2,)), ((0,), (0,))),
@@ -263,8 +280,17 @@ def ragged_paged_attention_decode(
     pages_per_block: int | None = None,
     prefetch_pages: int | None = None,
     layer: jnp.ndarray | int | None = None,  # index into stacked pools
+    k_scales: jnp.ndarray | None = None,  # [P, KH] or [L, P, KH] f32 (int8 pools)
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Decode attention over paged KV, streaming pages HBM->VMEM.
+
+    With ``k_scales/v_scales`` (int8 pools, ops/quant.py contract) each
+    page dequantizes right after its DMA lands in the VMEM ring — HBM
+    streams HALF the bytes and fp values never round-trip through it. The
+    current layer's [P, KH] scale slabs stay VMEM-resident (fetched once,
+    constant index map; P*KH*4 bytes each — ~256 KB at 8k pages x 8 heads).
+    ``k_cur/v_cur`` stay fp: the in-register window never quantizes.
 
     With ``k_cur/v_cur`` (write-after-attend mode), pool slots at positions
     >= ``seq_lens - cur_lens`` are treated as stale and the in-register
@@ -300,11 +326,15 @@ def ragged_paged_attention_decode(
     mixed-length batch costs the sum of its REAL contexts.
     """
     B, NH, D = q.shape
+    quantized = k_scales is not None
     if k_pages.ndim == 4:  # single-layer pools: free leading-axis view
         k_pages = k_pages[None]
         v_pages = v_pages[None]
+        if quantized and k_scales.ndim == 2:
+            k_scales = k_scales[None]
+            v_scales = v_scales[None]
         layer = 0
-    _, _, page_size, KH, _ = k_pages.shape
+    _, P_pool, page_size, KH, _ = k_pages.shape
     max_pages = page_table.shape[1]
     G = NH // KH
     scale = sm_scale if sm_scale is not None else D**-0.5
@@ -316,8 +346,13 @@ def ragged_paged_attention_decode(
         # ~128 KV slots of bookkeeping per cell for short-context buckets;
         # long-context buckets (>=128 pages) use ~512 — with the DMA ring
         # the cell size no longer bounds fetch depth, it only amortizes the
-        # per-cell grid/index-map overhead
+        # per-cell grid/index-map overhead. int8 pools double the slot
+        # target: each slot costs half the bytes, so the same VMEM/DMA
+        # budget amortizes twice the bookkeeping (re-sweep with
+        # scripts/profile_decode.py --impl pallas_int8 when retuning)
         target = 512 if max_pages >= 128 else 128
+        if jnp.dtype(k_pages.dtype).itemsize == 1:
+            target *= 2
         pages_per_block = max(1, min(target // page_size, max_pages))
     N = max(1, min(pages_per_block, max_pages))
     n_blocks = -(-max_pages // N)
@@ -368,12 +403,23 @@ def ragged_paged_attention_decode(
     def row4(c, pt, lens, w, _cl, l, so, bo, ce, lp, tot):
         return (so[c], 0, 0, 0)
 
+    def srow(c, pt, lens, w, _cl, l, so, bo, ce, lp, tot):
+        # scale slabs: the whole [P, KH] slice of the CURRENT layer; the
+        # constant block index means the pipeline fetches it once
+        return (l[0], 0, 0)
+
     in_specs = [
         pl.BlockSpec((1, NH, D), row3),
         pl.BlockSpec(memory_space=pltpu.ANY),
         pl.BlockSpec(memory_space=pltpu.ANY),
     ]
     operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, P_pool, KH), srow),
+            pl.BlockSpec((1, P_pool, KH), srow),
+        ]
+        operands += [k_scales, v_scales]
     if has_cur:
         C = k_cur.shape[1]
         in_specs += [
@@ -400,8 +446,9 @@ def ragged_paged_attention_decode(
     kernel = functools.partial(
         _decode_kernel, sm_scale=scale, kv_heads=KH,
         logit_softcap=logit_softcap, has_cur=has_cur, pages_per_block=N,
-        prefetch=R,
+        prefetch=R, quantized=quantized,
     )
+    kv_itemsize = jnp.dtype(k_pages.dtype).itemsize  # 1 for int8 pools
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -410,7 +457,8 @@ def ragged_paged_attention_decode(
         cost_estimate=pl.CostEstimate(
             flops=4 * B * NH * D * max_pages * page_size,
             bytes_accessed=(
-                2 * max_pages * page_size * KH * D * 2 * B + B * NH * D * 4
+                2 * max_pages * page_size * KH * D * kv_itemsize * B
+                + B * NH * D * 4
             ),
             transcendentals=B * NH * max_pages * page_size,
         ),
@@ -439,6 +487,8 @@ def ragged_paged_attention_decode_sharded(
     pages_per_block: int | None = None,
     prefetch_pages: int | None = None,
     layer: jnp.ndarray | int | None = None,
+    k_scales: jnp.ndarray | None = None,  # [P, KH]/[L, P, KH], KH over tp
+    v_scales: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """The decode kernel on a multi-device mesh via manual shard_map.
 
@@ -472,26 +522,39 @@ def ragged_paged_attention_decode_sharded(
         v_cur = v_cur[:, None]
     if has_cur and cur_lens is None:
         cur_lens = jnp.ones(q.shape[:1], jnp.int32)
+    quantized = k_scales is not None
     if k_pages.ndim == 4:  # single-layer pools
         k_pages = k_pages[None]
         v_pages = v_pages[None]
+        if quantized and k_scales.ndim == 2:
+            k_scales = k_scales[None]
+            v_scales = v_scales[None]
         layer = 0
     lyr = jnp.asarray(layer, jnp.int32).reshape(1)
 
-    def body(q, kp, vp, pt, lens, l, *cur):
-        kc, vc, cl = cur if has_cur else (None, None, None)
+    def body(q, kp, vp, pt, lens, l, *rest):
+        rest = list(rest)
+        ks = vs = None
+        if quantized:
+            ks, vs = rest[0], rest[1]
+            rest = rest[2:]
+        kc, vc, cl = rest if has_cur else (None, None, None)
         return ragged_paged_attention_decode(
             q, kp, vp, pt, lens, window,
             sm_scale=scale, logit_softcap=logit_softcap, interpret=interpret,
             k_cur=kc, v_cur=vc, cur_lens=cl,
             pages_per_block=pages_per_block, prefetch_pages=prefetch_pages,
-            layer=l[0],
+            layer=l[0], k_scales=ks, v_scales=vs,
         )
 
     head = P("dp", "tp", None)
     pool = P(None, None, None, "tp", None)
     in_specs = [head, pool, pool, P("dp", None), P("dp"), P()]
     operands = [q, k_pages, v_pages, page_table, seq_lens, lyr]
+    if quantized:
+        # scale slabs shard their KH axis over tp exactly like the pools'
+        in_specs += [P(None, None, "tp"), P(None, None, "tp")]
+        operands += [k_scales, v_scales]
     if has_cur:
         # the window's KH axis shards over tp like the pool's
         in_specs += [P("dp", None, "tp", None), P("dp", None, "tp", None), P("dp")]

@@ -92,7 +92,9 @@ def _prefill_kernel(
     kc_ref,      # [1, Cw, KH, D] chunk K/V, front-padded by fp_pad slots
     vc_ref,
     cpos_ref,    # [1, Cw] chunk entry positions (-1 pad)
-    *refs,       # o_ref [, kp_out, vp_out], then scratch (see wrapper)
+    *refs,       # [ks_ref, vs_ref (quantized: [1, P, KH] f32 scale slabs),]
+                 # o_ref [, kp_out, vp_out [, o_ksc, o_vsc]], then scratch
+                 # (see wrapper)
     sm_scale: float,
     kv_heads: int,
     logit_softcap: float | None,
@@ -102,17 +104,33 @@ def _prefill_kernel(
     fused_write: bool,
     fp_pad: int,
     max_write_pages: int,
+    quantized: bool = False,
 ):
     N = pages_per_block
     RB = ring_blocks
-    if fused_write:
+    i0 = 0
+    if quantized:
+        # int8 pools (ops/quant.py contract): the current layer's [P, KH]
+        # scale slabs are VMEM-resident (constant index map, fetched once);
+        # each cell's N ring pages dequantize right before the wide fold,
+        # and the fused write QUANTIZES the chunk in-kernel so fp chunk KV
+        # never crosses HBM either
+        ks_ref, vs_ref = refs[0], refs[1]
+        i0 = 2
+    o_ksc = o_vsc = None
+    if fused_write and quantized:
+        (o_ref, kp_out, vp_out, o_ksc, o_vsc, k_buf, v_buf, ksem, vsem,
+         wk_sem, wv_sem, rk_sem, rv_sem, wbuf_k, wbuf_v,
+         qg_ref, m_ref, l_ref, acc_ref) = refs[i0:]
+        kp_src, vp_src = kp_out, vp_out  # aliased with kp_hbm/vp_hbm
+    elif fused_write:
         (o_ref, kp_out, vp_out, k_buf, v_buf, ksem, vsem,
          wk_sem, wv_sem, rk_sem, rv_sem, wbuf_k, wbuf_v,
-         qg_ref, m_ref, l_ref, acc_ref) = refs
+         qg_ref, m_ref, l_ref, acc_ref) = refs[i0:]
         kp_src, vp_src = kp_out, vp_out  # aliased with kp_hbm/vp_hbm
     else:
         (o_ref, k_buf, v_buf, ksem, vsem,
-         qg_ref, m_ref, l_ref, acc_ref) = refs
+         qg_ref, m_ref, l_ref, acc_ref) = refs[i0:]
         kp_src, vp_src = kp_hbm, vp_hbm
     KB = k_buf.shape[1]
     page_size = KB // N
@@ -131,6 +149,15 @@ def _prefill_kernel(
     p = blk_ref[c]
     r = b * n_qb + qb
 
+    def _pid_of(g):
+        """Pool page id for global page stream index g (clamped for dead
+        cells) — the quantized fold uses it to look up each page's scale."""
+        cc = jnp.minimum(g // N, n_cells - 1)
+        bb = seq_ref[cc]
+        rr = bb * n_qb + qb_ref[cc]
+        pi = blk_ref[cc] * N + g % N
+        return pt_ref[bb, jnp.minimum(lopg_ref[rr] + pi, max_pages - 1)]
+
     def _copies(g):
         """DMA descriptors + go/no-go predicate for global page stream index
         g = cell*N + i. A page is fetched iff its cell is live and the page
@@ -143,7 +170,7 @@ def _prefill_kernel(
         rr = bb * n_qb + qb_ref[cc]
         pi = blk_ref[cc] * N + g % N
         ok = (g < total * N) & (pi < livepg_ref[rr])
-        pid = pt_ref[bb, jnp.minimum(lopg_ref[rr] + pi, max_pages - 1)]
+        pid = _pid_of(g)
         slot = cc % RB
         off = (g % N) * page_size
         s = g % (RB * N)
@@ -246,8 +273,23 @@ def _prefill_kernel(
         @pl.when(p * N < livepg_ref[r])
         def _():
             slot = c % RB
-            k = k_buf[slot].transpose(1, 0, 2)  # [KH, KB, D]
-            v = v_buf[slot].transpose(1, 0, 2)
+            kb = k_buf[slot]
+            vb = v_buf[slot]
+            if quantized:
+                # dequant at the ring exit: one [N, KH] scale block gathered
+                # from the resident slab, broadcast over each page's slots
+                sk = jnp.stack([ks_ref[0, _pid_of(c * N + i)] for i in range(N)])
+                sv = jnp.stack([vs_ref[0, _pid_of(c * N + i)] for i in range(N)])
+                kb = (
+                    kb.astype(jnp.float32).reshape(N, page_size, KH, D)
+                    * sk[:, None, :, None]
+                ).reshape(KB, KH, D)
+                vb = (
+                    vb.astype(jnp.float32).reshape(N, page_size, KH, D)
+                    * sv[:, None, :, None]
+                ).reshape(KB, KH, D)
+            k = kb.transpose(1, 0, 2)  # [KH, KB, D]
+            v = vb.transpose(1, 0, 2)
             start = (lopg_ref[r] + p * N) * page_size
             idx = start + lax.iota(jnp.int32, KB)
             # slots of pages beyond the live range hold stale ring bytes;
@@ -259,7 +301,99 @@ def _prefill_kernel(
             fold(k, v, idx, valid)
 
     # ---- fused paged-KV write: once per row, at its first cell ----------
-    if fused_write:
+    if fused_write and quantized:
+        ps = page_size
+
+        @pl.when(live & (qb == 0) & (p == 0) & (cl_ref[b] > 0))
+        def _():
+            s0 = paged_end              # chunk start (contiguous contract)
+            e0 = s0 + cl_ref[b]
+            lp0 = s0 // ps
+            # quantize-in-kernel (ops/quant.py contract): FRESH pages
+            # (page_start >= s0 — slot 0 is this chunk's) get scale =
+            # amax/127 and fully-defined content (zeros beyond the chunk
+            # end); the rare non-aligned HEAD page (page_start < s0, holds
+            # this row's earlier tokens) keeps its OLD scale and clips new
+            # tokens into it — rescaling it here would rewrite bytes the
+            # SAME invocation's ring reads race against (scheduler chunks
+            # are page-aligned in practice: prefill_chunk % page_size == 0,
+            # so this path only runs for odd configs). New scales land in
+            # the o_ksc/o_vsc output blocks; the wrapper scatters them into
+            # the scales pool (a few KB — the page BYTES still cross HBM
+            # exactly once, in int8).
+            for j in range(max_write_pages):
+                page_start = (lp0 + j) * ps
+                pid = pt_ref[b, jnp.minimum(lp0 + j, max_pages - 1)]
+                any_w = (page_start < e0) & (page_start + ps > s0)
+                fresh = page_start >= s0
+                src = page_start - s0 + fp_pad
+
+                @pl.when(any_w)
+                def _(j=j, page_start=page_start, pid=pid, src=src,
+                      fresh=fresh):
+                    gidx = page_start + lax.broadcasted_iota(
+                        jnp.int32, (ps, 1, 1), 0
+                    )
+                    keep = (gidx >= s0) & (gidx < e0)
+                    xk = jnp.where(
+                        keep, kc_ref[0, pl.ds(src, ps)].astype(jnp.float32), 0.0
+                    )
+                    xv = jnp.where(
+                        keep, vc_ref[0, pl.ds(src, ps)].astype(jnp.float32), 0.0
+                    )
+                    want_k = jnp.maximum(
+                        jnp.max(jnp.abs(xk), axis=(0, 2)) / 127.0, 1e-8
+                    )
+                    want_v = jnp.maximum(
+                        jnp.max(jnp.abs(xv), axis=(0, 2)) / 127.0, 1e-8
+                    )
+                    ns_k = jnp.where(fresh, want_k, ks_ref[0, pid])
+                    ns_v = jnp.where(fresh, want_v, vs_ref[0, pid])
+                    o_ksc[0, j] = ns_k
+                    o_vsc[0, j] = ns_v
+                    qk = jnp.clip(
+                        jnp.round(xk / ns_k[None, :, None]), -127, 127
+                    ).astype(wbuf_k.dtype)
+                    qv = jnp.clip(
+                        jnp.round(xv / ns_v[None, :, None]), -127, 127
+                    ).astype(wbuf_v.dtype)
+
+                    @pl.when(fresh)
+                    def _():
+                        wbuf_k[...] = qk
+                        wbuf_v[...] = qv
+
+                    @pl.when(~fresh)
+                    def _():
+                        # head page: read-modify-write; untouched slots keep
+                        # their exact old bytes (old scale unchanged)
+                        rk = pltpu.make_async_copy(
+                            kp_out.at[lyr, pid], wbuf_k, rk_sem
+                        )
+                        rv = pltpu.make_async_copy(
+                            vp_out.at[lyr, pid], wbuf_v, rv_sem
+                        )
+                        rk.start()
+                        rv.start()
+                        rk.wait()
+                        rv.wait()
+                        wbuf_k[...] = jnp.where(keep, qk, wbuf_k[...])
+                        wbuf_v[...] = jnp.where(keep, qv, wbuf_v[...])
+
+                    # single staging buffer: the write must land before the
+                    # next page's quantization reuses it
+                    wk = pltpu.make_async_copy(
+                        wbuf_k, kp_out.at[lyr, pid], wk_sem.at[j]
+                    )
+                    wv = pltpu.make_async_copy(
+                        wbuf_v, vp_out.at[lyr, pid], wv_sem.at[j]
+                    )
+                    wk.start()
+                    wv.start()
+                    wk.wait()
+                    wv.wait()
+
+    elif fused_write:
         ps = page_size
 
         @pl.when(live & (qb == 0) & (p == 0) & (cl_ref[b] > 0))
@@ -402,8 +536,18 @@ def ragged_paged_attention_prefill(
     q_block: int = 128,
     layer: jnp.ndarray | int | None = None,
     fused_write: bool = False,
+    k_scales: jnp.ndarray | None = None,  # [P, KH] or [L, P, KH] f32 (int8)
+    v_scales: jnp.ndarray | None = None,
 ):
     """Chunked-prefill attention over paged KV + in-register chunk K/V (v2).
+
+    With ``k_scales/v_scales`` (int8 pools, ops/quant.py contract) the ring
+    pages dequantize right before each cell's wide fold — half the HBM
+    bytes per chunk — and ``fused_write=True`` quantizes the chunk's K/V
+    in-kernel (fresh pages get amax/127 scales; a non-page-aligned head
+    page clips into its existing scale), returning
+    ``(out, k_pages, v_pages, k_scales, v_scales)``. ``k_cur/v_cur`` must
+    arrive fp (they are the quantizer's input).
 
     Write-after-attend contract (ops/attention.stale_kv_positions): pool
     slots at positions >= kv_lens - cur_lens are stale — the chunk's K/V
@@ -438,10 +582,14 @@ def ragged_paged_attention_prefill(
     so a 4k-window chunk at 128k context streams ~window bytes.
     """
     B, T, NH, D = q.shape
+    quantized = k_scales is not None
     squeeze = k_pages.ndim == 4
     if squeeze:
         k_pages = k_pages[None]
         v_pages = v_pages[None]
+        if quantized and k_scales.ndim == 2:
+            k_scales = k_scales[None]
+            v_scales = v_scales[None]
         layer = 0
     L, P, page_size, KH, _ = k_pages.shape
     max_pages = page_table.shape[1]
@@ -450,8 +598,12 @@ def ragged_paged_attention_prefill(
     if pages_per_block is None:
         # ~512 contiguous KV slots per cell: wide enough to keep the MXU's
         # 128-lane S dim busy, small enough that the f32 score temporaries
-        # ([KH, TQ, KB]) stay a few MB
-        pages_per_block = max(1, min(512 // page_size, max_pages))
+        # ([KH, TQ, KB]) stay a few MB. int8 pools double the target —
+        # half the ring bytes per slot buys a wider fold for the same VMEM
+        # (the f32 score temporaries grow, hence x2 not x4; re-sweep with
+        # scripts/profile_prefill.py --impl pallas_int8 when retuning)
+        target = 1024 if quantized else 512
+        pages_per_block = max(1, min(target // page_size, max_pages))
     N = max(1, min(pages_per_block, max_pages))
     KB = N * page_size
     n_blocks = -(-max_pages // N)
@@ -475,13 +627,16 @@ def ragged_paged_attention_prefill(
     # tail must cover both the fold's whole-CB sub-block slices (from FP)
     # and the fused write's last-page overhang (T + page_size from FP)
     Cw = FP + -(-(T + page_size) // CB) * CB
-    kc = jnp.zeros((B, Cw, KH, D), k_pages.dtype)
-    vc = jnp.zeros((B, Cw, KH, D), v_pages.dtype)
+    # the chunk buffer stays fp under int8 pools: it is both the fold's
+    # in-register operand and the fused quantizer's input
+    chunk_dt = q.dtype if quantized else k_pages.dtype
+    kc = jnp.zeros((B, Cw, KH, D), chunk_dt)
+    vc = jnp.zeros((B, Cw, KH, D), chunk_dt)
     kc = lax.dynamic_update_slice(
-        kc, k_cur.astype(k_pages.dtype), (0, FP, 0, 0)
+        kc, k_cur.astype(chunk_dt), (0, FP, 0, 0)
     )
     vc = lax.dynamic_update_slice(
-        vc, v_cur.astype(v_pages.dtype), (0, FP, 0, 0)
+        vc, v_cur.astype(chunk_dt), (0, FP, 0, 0)
     )
     cl = jnp.asarray(cur_lens, jnp.int32)
     cpos = jnp.full((B, Cw), -1, jnp.int32)
@@ -555,6 +710,14 @@ def ragged_paged_attention_prefill(
     def crow2(c, *refs):
         return (refs[5][c], 0)
 
+    def scrow(c, *refs):
+        # scale slabs: the CURRENT layer's whole [P, KH] slice — constant
+        # block index, so the pipeline fetches it once
+        return (refs[4][0], 0, 0)
+
+    def oscrow(c, *refs):
+        return (refs[5][c], 0, 0)
+
     in_specs = [
         pl.BlockSpec((1, TQ, NH, D), qrow),
         pl.BlockSpec((1, TQ), prow),
@@ -565,6 +728,12 @@ def ragged_paged_attention_prefill(
         pl.BlockSpec((1, Cw), crow2),
     ]
     operands = [q, positions, k_pages, v_pages, kc, vc, cpos]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, P, KH), scrow),
+            pl.BlockSpec((1, P, KH), scrow),
+        ]
+        operands += [k_scales, v_scales]
     out_shapes = [jax.ShapeDtypeStruct((B, n_qb * TQ, NH, D), q.dtype)]
     out_specs = [pl.BlockSpec((1, TQ, NH, D), qrow)]
     scratch = [
@@ -585,6 +754,17 @@ def ragged_paged_attention_prefill(
         ]
         # operand index counts scalar prefetch: pools sit at NS+2 / NS+3
         io_aliases = {NS + 2: 1, NS + 3: 2}
+        if quantized:
+            # per-row new scales for the (<= MAXW) written pages; the
+            # wrapper scatters them into the scales pool after the call
+            out_shapes += [
+                jax.ShapeDtypeStruct((B, MAXW, KH), jnp.float32),
+                jax.ShapeDtypeStruct((B, MAXW, KH), jnp.float32),
+            ]
+            out_specs += [
+                pl.BlockSpec((1, MAXW, KH), oscrow),
+                pl.BlockSpec((1, MAXW, KH), oscrow),
+            ]
         scratch += [
             pltpu.SemaphoreType.DMA((MAXW,)),
             pltpu.SemaphoreType.DMA((MAXW,)),
@@ -611,8 +791,33 @@ def ragged_paged_attention_prefill(
         _prefill_kernel, sm_scale=scale, kv_heads=KH,
         logit_softcap=logit_softcap, pages_per_block=N, ring_blocks=RB,
         n_qb=n_qb, fused_write=fused_write, fp_pad=FP,
-        max_write_pages=MAXW,
+        max_write_pages=MAXW, quantized=quantized,
     )
+    if fused_write and quantized:
+        # scale-scatter targets for the written pages, computed BEFORE the
+        # aliased pallas_call (its operands are dead afterwards). The
+        # validity mask mirrors the kernel's write predicate (any_w); a
+        # non-fresh head page kept its old scale, so rewriting it is a
+        # no-op, but masking dead rows keeps the scatter honest when the
+        # o_* output blocks hold stale VMEM garbage (cl == 0 rows).
+        s0_w = pe
+        e0_w = lens32
+        lp0_w = jnp.maximum(s0_w, 0) // page_size
+        jw = jnp.arange(MAXW, dtype=jnp.int32)[None, :]
+        logical_w = lp0_w[:, None] + jw
+        pstart_w = logical_w * page_size
+        any_w = (
+            (pstart_w < e0_w[:, None])
+            & (pstart_w + page_size > s0_w[:, None])
+            & (cl[:, None] > 0)
+            & (logical_w < max_pages)
+        )
+        pid_w = jnp.take_along_axis(
+            page_table.astype(jnp.int32),
+            jnp.clip(logical_w, 0, max_pages - 1), axis=1,
+        )
+        sc_target = jnp.where(any_w, pid_w, P).reshape(-1)  # P = dropped
+        sc_layer = lyr[0]
     outs = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -639,6 +844,20 @@ def ragged_paged_attention_prefill(
         live_pg.reshape(-1).astype(jnp.int32), total_arr,
         *operands,
     )
+    if fused_write and quantized:
+        out, kp_new, vp_new, o_ksc, o_vsc = outs
+        # scatter the written pages' new scales into the scales pool: page
+        # bytes crossed HBM once, in-kernel; the scales are a few KB
+        ks_new = k_scales.at[sc_layer, sc_target].set(
+            o_ksc.reshape(-1, KH), mode="drop"
+        )
+        vs_new = v_scales.at[sc_layer, sc_target].set(
+            o_vsc.reshape(-1, KH), mode="drop"
+        )
+        if squeeze:
+            kp_new, vp_new = kp_new[0], vp_new[0]
+            ks_new, vs_new = ks_new[0], vs_new[0]
+        return out[:, :T], kp_new, vp_new, ks_new, vs_new
     if fused_write:
         out, kp_new, vp_new = outs
         if squeeze:

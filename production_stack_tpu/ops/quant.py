@@ -1,0 +1,232 @@
+"""Quantized paged-KV math: int8 pages + per-page (per-kv-head) scales.
+
+The long-context decode step is HBM-bandwidth-bound (docs/benchmarking.md
+"Hardware ceilings": page-scattered reads measured 14-30 GB/s vs ~200 GB/s
+contiguous), so the only way past the byte wall is fewer bytes per step.
+With ``kv_cache_dtype=int8`` the pools store int8 values and a parallel
+scales pool holds one fp32 scale per (layer, page, kv-head):
+
+    k_pages, v_pages: [L, P, page_size, KH, D] int8
+    k_scales, v_scales: [L, P, KH] float32      (value = q * scale)
+
+This module owns the quantization CONTRACT every consumer must agree on —
+the Pallas kernels' in-ring dequant (ops/pallas/*.py), the XLA
+fallback/oracle paths (gather_kv_pages_quant here + ops/attention.py), the
+decode feedback write (write_kv_pages_all_layers_quant), and the host serde
+boundary (kvoffload/serde.py v3 blobs carry the exact pool bytes):
+
+- **Symmetric int8**: ``q = round(x / scale)`` clipped to [-127, 127];
+  ``scale = amax / 127`` with an epsilon floor. No zero point — KV
+  magnitudes are symmetric and a zero point would cost an add per element
+  in the kernels' hot fold.
+- **Scale lifecycle (per page, per kv head)**: a page's scale RESETS when
+  its slot 0 is written (pages fill front-to-back, so a slot-0 write means
+  the slot was reallocated and everything before is garbage — without the
+  reset a reused page would inherit the previous owner's amax forever and
+  precision would ratchet away). Later appends into a partially-filled
+  page may only GROW the scale: ``new = max(old, amax(new_tokens)/127)``,
+  and existing int8 content re-quantizes by ``round(q * old/new)`` — a
+  no-op when the scale did not grow (ratio 1), and at most 0.5 LSB of
+  added error per actual growth event. Growth events are rare in practice
+  (KV amax stabilizes within a few tokens), which is what keeps the
+  decode-append path's cumulative error bounded.
+- **Stale/garbage slots** (beyond ``kv_lens``, or beyond a chunk's end)
+  are never dequantized into anything visible: attention masks them the
+  same way it masks them for fp pools, and int8 garbage is always finite
+  (no NaN*0 hazard, unlike fp garbage).
+
+Everything here is shape-static and scatter-based (``mode='drop'`` on
+sentinel indices), so it jits into the existing bucketed programs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from production_stack_tpu.ops.attention import _kv_flat_indices
+
+# scale floor: an all-zero page still needs a valid (positive) scale so the
+# dequant multiply is a no-op rather than a 0*q = 0-with-NaN-risk special case
+SCALE_EPS = 1e-8
+QMAX = 127.0
+
+
+# -- device (jnp) ------------------------------------------------------------
+
+
+def init_kv_scales(num_layers: int, num_pages: int, num_kv_heads: int):
+    """Fresh scales pool (ones: garbage pages dequant to small finite noise
+    that attention masks anyway; real pages reset their scale on first
+    write)."""
+    return jnp.ones((num_layers, num_pages, num_kv_heads), jnp.float32)
+
+
+def dequant(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """q [..., page, KH, D] int8 * scale [..., KH] -> fp."""
+    return (q.astype(jnp.float32) * scale[..., None, :, None]).astype(dtype)
+
+
+def gather_kv_pages_quant(
+    k_pages: jnp.ndarray,   # [P, page, KH, D] int8
+    v_pages: jnp.ndarray,
+    k_scales: jnp.ndarray,  # [P, KH] f32
+    v_scales: jnp.ndarray,
+    page_table: jnp.ndarray,
+    dtype=jnp.float32,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantized twin of ops.attention.gather_kv_pages: gather each
+    sequence's pages AND their scales, dequantize to contiguous fp
+    [B, S, KH, D] views. The XLA fallback/oracle read path."""
+    P, page_size, KH, D = k_pages.shape
+    B, max_pages = page_table.shape
+    S = max_pages * page_size
+    k = dequant(k_pages[page_table], k_scales[page_table], dtype)
+    v = dequant(v_pages[page_table], v_scales[page_table], dtype)
+    return k.reshape(B, S, KH, D), v.reshape(B, S, KH, D)
+
+
+def _scatter_max(target_shape, idx, vals):
+    """zeros(target_shape).at[:, idx].max(vals) — per-page reductions."""
+    return jnp.zeros(target_shape, jnp.float32).at[:, idx].max(vals)
+
+
+def write_kv_pages_all_layers_quant(
+    k_pages: jnp.ndarray,   # [L, P, page, KH, D] int8
+    v_pages: jnp.ndarray,
+    k_scales: jnp.ndarray,  # [L, P, KH] f32
+    v_scales: jnp.ndarray,
+    k_new: jnp.ndarray,     # [L, B, T, KH, D] fp
+    v_new: jnp.ndarray,
+    page_table: jnp.ndarray,
+    positions: jnp.ndarray,  # [B, T] absolute positions; -1 dropped.
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Quantizing twin of ops.attention.write_kv_pages_all_layers — the
+    decode feedback write (burst commits, non-fused prefill commits).
+
+    Per the module contract: pages whose slot 0 is written get a fresh
+    scale (amax of the new tokens / 127); pages appended mid-page keep
+    ``max(old, new)`` and their existing int8 content re-quantizes by the
+    scale ratio. Valid positions must be CONTIGUOUS and ascending per row
+    (how the scheduler builds every chunk and every burst commit) — the
+    re-quant pass gathers each row's touched page window from that
+    contract, so it scatters only uniquely-owned pages.
+    """
+    L, P, page_size, KH, D = k_pages.shape
+    B, T = positions.shape
+    sentinel = P * page_size
+    flat = _kv_flat_indices(page_table, positions, page_size, P)  # [B*T]
+    pg = jnp.where(flat < sentinel, flat // page_size, P)         # P = dropped
+    slot = flat % page_size
+    valid = flat < sentinel
+
+    def per_page_state(x_new, scales):
+        x_tok = x_new.reshape(L, B * T, KH, D).astype(jnp.float32)
+        amax_tok = jnp.abs(x_tok).max(axis=-1)                    # [L, B*T, KH]
+        amax_pg = _scatter_max((L, P + 1, KH), pg, amax_tok)[:, :P]
+        want = jnp.maximum(amax_pg / QMAX, SCALE_EPS)
+        fresh = (
+            jnp.zeros((P + 1,), jnp.float32)
+            .at[pg].max((valid & (slot == 0)).astype(jnp.float32))[:P]
+            > 0
+        )
+        touched = (
+            jnp.zeros((P + 1,), jnp.float32)
+            .at[pg].max(valid.astype(jnp.float32))[:P]
+            > 0
+        )
+        new_scales = jnp.where(
+            touched[None, :, None],
+            jnp.where(fresh[None, :, None], want, jnp.maximum(scales, want)),
+            scales,
+        )
+        return x_tok, new_scales, touched
+
+    k_tok, k_scales_new, touched = per_page_state(k_new, k_scales)
+    v_tok, v_scales_new, _ = per_page_state(v_new, v_scales)
+
+    # touched page windows, per row: positions are contiguous, so row b
+    # touches pages [min_pos//page .. max_pos//page] — at most W of them
+    W = -(-T // page_size) + 1
+    max_pages = page_table.shape[1]
+    big = jnp.int32(2**30)
+    p0 = jnp.min(jnp.where(positions >= 0, positions, big), axis=1)
+    p_last = jnp.max(positions, axis=1)                           # -1 = dead row
+    start_pg = jnp.where(p_last >= 0, jnp.minimum(p0, p_last) // page_size, 0)
+    jj = jnp.arange(W, dtype=jnp.int32)[None, :]
+    logical = start_pg[:, None] + jj                              # [B, W]
+    in_range = (
+        (p_last >= 0)[:, None]
+        & (logical * page_size <= p_last[:, None])
+        & (logical < max_pages)
+    )
+    gids = jnp.take_along_axis(
+        page_table, jnp.clip(logical, 0, max_pages - 1), axis=1
+    )
+    gids_clip = jnp.where(in_range, gids, 0).reshape(-1)          # gather-safe
+    gids_scatter = jnp.where(in_range, gids, P).reshape(-1)       # P = dropped
+
+    def requant(pool, old_s, new_s):
+        ratio = jnp.where(new_s > 0, old_s / new_s, 1.0)          # [L, P, KH]
+        r = ratio[:, gids_clip]                                   # [L, B*W, KH]
+        q = pool[:, gids_clip].astype(jnp.float32)                # [L, B*W, pg, KH, D]
+        q = jnp.round(q * r[:, :, None, :, None])
+        q = jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
+        return pool.at[:, gids_scatter].set(q, mode="drop")
+
+    k_pages = requant(k_pages, k_scales, k_scales_new)
+    v_pages = requant(v_pages, v_scales, v_scales_new)
+
+    def scatter_tokens(pool, tok, new_s):
+        s_pad = jnp.concatenate(
+            [new_s, jnp.full((L, 1, KH), 1.0, jnp.float32)], axis=1
+        )
+        s_tok = s_pad[:, pg]                                      # [L, B*T, KH]
+        q = jnp.round(tok / s_tok[..., None])
+        q = jnp.clip(q, -QMAX, QMAX).astype(jnp.int8)
+        flat_pool = pool.reshape(L, P * page_size, KH, D)
+        flat_pool = flat_pool.at[:, flat].set(q, mode="drop")
+        return flat_pool.reshape(pool.shape)
+
+    k_pages = scatter_tokens(k_pages, k_tok, k_scales_new)
+    v_pages = scatter_tokens(v_pages, v_tok, v_scales_new)
+    return k_pages, v_pages, k_scales_new, v_scales_new
+
+
+# -- host (numpy): the serde / restore boundary ------------------------------
+
+
+def quantize_page_host(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """One logical page [L, page, KH, D] fp -> (q int8, scales [L, KH] f32).
+    Used when an fp blob restores into a quantized pool (cross-dtype
+    warm start / directory pull) and by the v3 serde's generic
+    ``serialize``. The page is complete at this point, so the scale is the
+    plain amax rule — no growth bookkeeping."""
+    xf = np.asarray(x, np.float32)
+    amax = np.abs(xf).max(axis=(1, 3))                            # [L, KH]
+    scale = np.maximum(amax / QMAX, SCALE_EPS).astype(np.float32)
+    q = np.clip(np.round(xf / scale[:, None, :, None]), -QMAX, QMAX)
+    return q.astype(np.int8), scale
+
+
+def dequantize_page_host(
+    q: np.ndarray, scale: np.ndarray, dtype=np.float32
+) -> np.ndarray:
+    """(q [L, page, KH, D] int8, scales [L, KH]) -> fp page."""
+    return (
+        np.asarray(q, np.float32) * np.asarray(scale, np.float32)[:, None, :, None]
+    ).astype(dtype)
+
+
+def kv_bytes_per_token(
+    num_layers: int, num_kv_heads: int, head_dim: int, page_size: int,
+    quantized: bool, fp_itemsize: int = 2,
+) -> float:
+    """KV bytes one token costs the pool (k+v, scales amortized per page) —
+    the number the decode byte wall is made of, exported as
+    ``vllm:kv_cache_dtype_bytes_per_token``."""
+    itemsize = 1 if quantized else fp_itemsize
+    per_tok = 2 * num_layers * num_kv_heads * head_dim * itemsize
+    if quantized:
+        per_tok += 2 * num_layers * num_kv_heads * 4 / max(page_size, 1)
+    return float(per_tok)

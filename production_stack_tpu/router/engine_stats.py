@@ -42,6 +42,11 @@ class EngineStats:
     # engine is ONE replica on 4 chips, not 4x the seats; the fleet
     # controller and dashboards read it through the router's scrape
     tensor_parallel: float = 1.0
+    # KV pool bytes per token (ops/quant.py): an int8-KV engine streams
+    # half the bytes AND holds ~2x the tokens per GB — capacity-aware
+    # consumers (dashboards, the fleet controller) read the real number
+    # instead of assuming the fp16 footprint (0 = not exported)
+    kv_cache_dtype_bytes_per_token: float = 0.0
 
     _FIELDS = {
         "vllm:num_requests_running": "num_running_requests",
@@ -56,6 +61,7 @@ class EngineStats:
             "kv_offload_link_bandwidth_bytes_per_sec"
         ),
         "vllm:tensor_parallel_degree": "tensor_parallel",
+        "vllm:kv_cache_dtype_bytes_per_token": "kv_cache_dtype_bytes_per_token",
     }
 
     @staticmethod

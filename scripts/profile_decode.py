@@ -67,6 +67,21 @@ def _scattered_case(rng, B, max_pages, page_size, lens, dtype):
     return q, kp, vp, jnp.asarray(pt), jnp.asarray(lens, jnp.int32)
 
 
+def _quantize_pools(kp, vp):
+    """int8 twin of a pool pair + per-page per-kv-head scales
+    (ops/quant.py contract), for the kv_cache_dtype=int8 sweep."""
+    from production_stack_tpu.ops.quant import quantize_page_host
+
+    # pool [P, page, KH, D]: the helper's leading axis is per-entry, so it
+    # yields exactly one [KH] scale row per page
+    qk, sk = quantize_page_host(np.asarray(kp, np.float32))
+    qv, sv = quantize_page_host(np.asarray(vp, np.float32))
+    return (
+        jnp.asarray(qk), jnp.asarray(qv),
+        jnp.asarray(sk), jnp.asarray(sv),
+    )
+
+
 def _time(fn, reps):
     fn()  # compile
     np.asarray(fn())  # post-donation/relayout settle + sync
@@ -77,26 +92,39 @@ def _time(fn, reps):
     return (time.perf_counter() - t0) / reps
 
 
-def _visible_bytes(lens, page_size, dtype):
+def _visible_bytes(lens, page_size, dtype, quant=False):
     pages = -(-np.maximum(np.asarray(lens), 0) // page_size)
-    return int(pages.sum()) * page_size * KH * D * np.dtype(dtype).itemsize * 2
+    itemsize = 1 if quant else np.dtype(dtype).itemsize
+    per_page = page_size * KH * D * itemsize + (KH * 4 if quant else 0)
+    return int(pages.sum()) * per_page * 2  # k + v
 
 
 def bench_bucket(rng, B, ctx, page_size, dtype, reps, impl, interpret,
                  lens=None, tag=""):
+    """impl: pallas | xla | pallas_int8 (the kernel streaming int8 pages +
+    dequantizing in its VMEM ring — the kv_cache_dtype=int8 serving path,
+    halved byte stream)."""
     max_pages = -(-ctx // page_size)
     if lens is None:
         lens = np.full((B,), ctx, np.int32)
     q, kp, vp, pt, lens_d = _scattered_case(rng, B, max_pages, page_size,
                                             lens, dtype)
-    if impl == "pallas":
+    quant = impl == "pallas_int8"
+    if quant:
+        qk, qv, sk, sv = _quantize_pools(kp, vp)
+        fn = lambda: ragged_paged_attention_decode(
+            q, qk, qv, pt, lens_d, interpret=interpret,
+            k_scales=sk, v_scales=sv,
+        )
+    elif impl == "pallas":
         fn = lambda: ragged_paged_attention_decode(
             q, kp, vp, pt, lens_d, interpret=interpret
         )
     else:
         fn = lambda: paged_attention_decode(q, kp, vp, pt, lens_d)
     dt = _time(fn, reps)
-    nbytes = _visible_bytes(lens, page_size, dtype)
+    nbytes = _visible_bytes(lens, page_size, dtype, quant)
+    per_tok = 2 * KH * D * (1 if quant else np.dtype(dtype).itemsize)
     return {
         "tag": tag or f"B{B}_ctx{ctx}_page{page_size}",
         "impl": impl,
@@ -108,6 +136,7 @@ def bench_bucket(rng, B, ctx, page_size, dtype, reps, impl, interpret,
         "visible_kv_mb": round(nbytes / 1e6, 1),
         "hbm_gb_s": round(nbytes / dt / 1e9, 2),
         "tok_s": round(B / dt, 1),
+        "kv_bytes_per_token": per_tok,
     }
 
 
@@ -131,7 +160,12 @@ def contiguous_ceiling(dtype, on_tpu):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--impl", choices=["pallas", "xla", "both"], default="both")
+    ap.add_argument(
+        "--impl", choices=["pallas", "xla", "both", "pallas_int8"],
+        default="both",
+        help="'both' sweeps pallas + xla + pallas_int8 (the quantized-KV "
+        "kernel path: achieved GB/s, tok/s, bytes/token vs fp)",
+    )
     ap.add_argument("--reps", type=int, default=0, help="0 = auto per backend")
     ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--contexts", default="", help="comma list, e.g. 1024,16384")
@@ -154,7 +188,10 @@ def main():
         [int(p) for p in args.page_sizes.split(",") if p]
         or ([16, 64, 128] if on_tpu else [8, 16])
     )
-    impls = ["pallas", "xla"] if args.impl == "both" else [args.impl]
+    impls = (
+        ["pallas", "pallas_int8", "xla"] if args.impl == "both"
+        else [args.impl]
+    )
     rng = np.random.RandomState(0)
 
     results = {"platform": jax.default_backend(), "interpret": interpret,
@@ -204,6 +241,57 @@ def main():
         atol=tol, rtol=tol,
     )
     print("mixed_case_numerics OK")
+
+    # quantized-path summary + numerics: int8-vs-fp kernel tok/s per bucket
+    # (the retuned decode_pages_per_block defaults are recorded from this
+    # evidence), plus an interpret-safe oracle check — the quantized kernel
+    # must match the XLA gather over the DEQUANTIZED pools to fp rounding
+    if any(b["impl"] == "pallas_int8" for b in results["buckets"]):
+        by_key = {}
+        for b in results["buckets"]:
+            by_key.setdefault((b["batch"], b["context"], b["page_size"]), {})[
+                b["impl"]
+            ] = b
+        speedups = {}
+        for key, d in sorted(by_key.items()):
+            if "pallas" in d and "pallas_int8" in d:
+                tag = d["pallas"]["tag"]
+                speedups[tag] = {
+                    "tok_s_fp": d["pallas"]["tok_s"],
+                    "tok_s_int8": d["pallas_int8"]["tok_s"],
+                    "speedup": round(
+                        d["pallas_int8"]["tok_s"]
+                        / max(d["pallas"]["tok_s"], 1e-9), 3,
+                    ),
+                    "bytes_per_token_fp": d["pallas"]["kv_bytes_per_token"],
+                    "bytes_per_token_int8": d["pallas_int8"][
+                        "kv_bytes_per_token"
+                    ],
+                }
+        results["int8_speedup"] = speedups
+        print(json.dumps({"int8_speedup": speedups}))
+        qk, qv, sk, sv = _quantize_pools(kp, vp)
+        ref_q = paged_attention_decode(
+            q,
+            jnp.asarray(
+                np.asarray(qk, np.float32)
+                * np.asarray(sk)[:, None, :, None], dtype,
+            ),
+            jnp.asarray(
+                np.asarray(qv, np.float32)
+                * np.asarray(sv)[:, None, :, None], dtype,
+            ),
+            pt, lens_d,
+        )
+        out_q = ragged_paged_attention_decode(
+            q, qk, qv, pt, lens_d, interpret=interpret,
+            k_scales=sk, v_scales=sv,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_q, np.float32), np.asarray(ref_q, np.float32),
+            atol=tol, rtol=tol,
+        )
+        print("int8_dequant_numerics OK")
 
     ok = True
     if on_tpu and not args.interpret:

@@ -61,6 +61,16 @@ enable_persistent_cache(
 NH, KH, D = 32, 8, 64
 
 
+def _quantize_pools(kp, vp):
+    """int8 twin of a pool pair + per-page per-kv-head scales
+    (ops/quant.py contract), for the kv_cache_dtype=int8 sweep."""
+    from production_stack_tpu.ops.quant import quantize_page_host
+
+    qk, sk = quantize_page_host(np.asarray(kp, np.float32))
+    qv, sv = quantize_page_host(np.asarray(vp, np.float32))
+    return jnp.asarray(qk), jnp.asarray(qv), jnp.asarray(sk), jnp.asarray(sv)
+
+
 def _case(rng, B, T, page_size, computed, dtype):
     """Chunk of T fresh tokens per row over ``computed[b]`` paged history.
     Pages are deliberately scattered across the pool (worst-case DMA
@@ -109,13 +119,14 @@ def _time(fn, reps):
     return (time.perf_counter() - t0) / reps
 
 
-def _streamed_bytes(computed, T, page_size, q_block, dtype):
+def _streamed_bytes(computed, T, page_size, q_block, dtype, quant=False):
     """Paged KV bytes the kernel's ring moves per call: each of the chunk's
     query blocks sweeps its row's real history once (k+v)."""
     n_qb = -(-T // q_block)
     pages = -(-np.maximum(np.asarray(computed), 0) // page_size)
-    return int(pages.sum()) * page_size * KH * D * np.dtype(dtype).itemsize \
-        * 2 * n_qb
+    itemsize = 1 if quant else np.dtype(dtype).itemsize
+    per_page = page_size * KH * D * itemsize + (KH * 4 if quant else 0)
+    return int(pages.sum()) * per_page * 2 * n_qb
 
 
 def bench_bucket(rng, B, T, ctx, page_size, dtype, reps, impl, interpret,
@@ -125,7 +136,22 @@ def bench_bucket(rng, B, T, ctx, page_size, dtype, reps, impl, interpret,
     q, kp, vp, pt, pos, lens, kc, vc, cl = _case(
         rng, B, T, page_size, computed, dtype
     )
-    if impl == "pallas":
+    quant = impl == "pallas_int8"
+    if quant:
+        # quantized-KV serving path: int8 ring reads (half the bytes) and
+        # the fused write quantizing the chunk in-kernel
+        qk, qv, sk, sv = _quantize_pools(kp, vp)
+        fn = lambda: ragged_paged_attention_prefill(
+            q, qk, qv, pt, pos, lens, kc, vc, cl,
+            interpret=interpret, q_block=q_block,
+            k_scales=sk, v_scales=sv,
+        )
+        fused_fn = lambda: ragged_paged_attention_prefill(
+            q, qk, qv, pt, pos, lens, kc, vc, cl,
+            interpret=interpret, q_block=q_block, fused_write=True,
+            k_scales=sk, v_scales=sv,
+        )
+    elif impl == "pallas":
         fn = lambda: ragged_paged_attention_prefill(
             q, kp, vp, pt, pos, lens, kc, vc, cl,
             interpret=interpret, q_block=q_block,
@@ -138,7 +164,7 @@ def bench_bucket(rng, B, T, ctx, page_size, dtype, reps, impl, interpret,
         fn = lambda: _xla_jit(q, kp, vp, pt, pos, lens, kc, vc)
         fused_fn = None
     dt = _time(fn, reps)
-    nbytes = _streamed_bytes(computed, T, page_size, q_block, dtype)
+    nbytes = _streamed_bytes(computed, T, page_size, q_block, dtype, quant)
     out = {
         "tag": tag or f"B{B}_chunk{T}_ctx{ctx}_page{page_size}",
         "impl": impl,
@@ -151,6 +177,8 @@ def bench_bucket(rng, B, T, ctx, page_size, dtype, reps, impl, interpret,
         "streamed_kv_mb": round(nbytes / 1e6, 1),
         "hbm_gb_s": round(nbytes / dt / 1e9, 2),
         "tok_s": round(B * T / dt, 1),
+        "kv_bytes_per_token": 2 * KH * D
+        * (1 if quant else np.dtype(dtype).itemsize),
     }
     if fused_fn is not None:
         out["fused_ms"] = round(_time(fused_fn, reps) * 1000, 3)
@@ -176,7 +204,12 @@ def contiguous_ceiling(dtype, on_tpu):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--impl", choices=["pallas", "xla", "both"], default="both")
+    ap.add_argument(
+        "--impl", choices=["pallas", "xla", "both", "pallas_int8"],
+        default="both",
+        help="'both' sweeps pallas + xla + pallas_int8 (the quantized-KV "
+        "kernel path: achieved GB/s, tok/s, bytes/token vs fp)",
+    )
     ap.add_argument("--reps", type=int, default=0, help="0 = auto per backend")
     ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--chunk", type=int, default=0, help="chunk length T")
@@ -200,7 +233,10 @@ def main():
         [int(c) for c in args.contexts.split(",") if c]
         or ([4096, 16384, 32768] if on_tpu else [64, 128])
     )
-    impls = ["pallas", "xla"] if args.impl == "both" else [args.impl]
+    impls = (
+        ["pallas", "pallas_int8", "xla"] if args.impl == "both"
+        else [args.impl]
+    )
     rng = np.random.RandomState(0)
 
     results = {"platform": jax.default_backend(), "interpret": interpret,
@@ -263,6 +299,51 @@ def main():
     assert (np.asarray(kp_f) == np.asarray(kp_s)).all(), "fused k write"
     assert (np.asarray(vp_f) == np.asarray(vp_s)).all(), "fused v write"
     print("mixed_case_numerics OK (incl. fused-write pool bit-identity)")
+
+    # quantized-path summary + numerics: int8-vs-fp kernel tok/s per bucket
+    # (evidence for the retuned prefill_pages_per_block defaults), plus the
+    # quantized kernel against the XLA oracle over the DEQUANTIZED pools
+    if any(b["impl"] == "pallas_int8" for b in results["buckets"]):
+        by_key = {}
+        for b in results["buckets"]:
+            by_key.setdefault((b["chunk"], b["context"]), {})[b["impl"]] = b
+        speedups = {}
+        for key, d in sorted(by_key.items()):
+            if "pallas" in d and "pallas_int8" in d:
+                speedups[d["pallas"]["tag"]] = {
+                    "tok_s_fp": d["pallas"]["tok_s"],
+                    "tok_s_int8": d["pallas_int8"]["tok_s"],
+                    "speedup": round(
+                        d["pallas_int8"]["tok_s"]
+                        / max(d["pallas"]["tok_s"], 1e-9), 3,
+                    ),
+                    "bytes_per_token_fp": d["pallas"]["kv_bytes_per_token"],
+                    "bytes_per_token_int8": d["pallas_int8"][
+                        "kv_bytes_per_token"
+                    ],
+                }
+        results["int8_speedup"] = speedups
+        print(json.dumps({"int8_speedup": speedups}))
+        qk, qv, sk, sv = _quantize_pools(kp, vp)
+        kd = jnp.asarray(
+            np.asarray(qk, np.float32)
+            * np.asarray(sk)[:, None, :, None], dtype,
+        )
+        vd = jnp.asarray(
+            np.asarray(qv, np.float32)
+            * np.asarray(sv)[:, None, :, None], dtype,
+        )
+        ref_q = _xla_jit(q, kd, vd, pt, pos, lens, kc, vc)
+        out_q = ragged_paged_attention_prefill(
+            q, qk, qv, pt, pos, lens, kc, vc, cl,
+            interpret=interpret, q_block=q_block,
+            k_scales=sk, v_scales=sv,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_q, np.float32), np.asarray(ref_q, np.float32),
+            atol=tol, rtol=tol,
+        )
+        print("int8_dequant_numerics OK")
 
     ok = True
     if on_tpu and not args.interpret:

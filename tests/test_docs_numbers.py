@@ -84,6 +84,38 @@ def test_compare_blocks_keeps_stable_parts_exact():
     assert mismatches and "stable" in mismatches[0]
 
 
+def _details_with_quant(match=0.995, tps_int8=120.0):
+    d = _details()
+    d["extras"].update({
+        "kv_quant_token_match_rate": match,
+        "kv_quant_decode_speedup": 1.58,
+        "kv_quant_context": 16384,
+        "decode_at_16k_tokens_per_sec_int8": tps_int8,
+        "decode_at_16k_tokens_per_sec_fp_contrast": 76.0,
+    })
+    return d
+
+
+def test_compare_blocks_flags_quality_regression():
+    """ISSUE 14 bugfix: the int8-KV greedy token-match rate is a QUALITY
+    number — a regression must FAIL the guard instead of passing as a perf
+    number within ±20% (0.85 is 'within 20%' of 0.995)."""
+    docs = ubd.render_block(_details_with_quant(match=0.995))
+    fresh = ubd.render_block(_details_with_quant(match=0.85))
+    mismatches = ubd.compare_blocks(docs, fresh)
+    assert mismatches and "quality number" in mismatches[0]
+
+
+def test_compare_blocks_tolerates_quality_jitter_and_quant_perf_drift():
+    """A few near-tie tokens of match-rate jitter (±0.005) and ordinary
+    perf drift on the int8 tok/s pair stay within the band."""
+    docs = ubd.render_block(_details_with_quant(match=0.995, tps_int8=120.0))
+    fresh = ubd.render_block(
+        _details_with_quant(match=0.993, tps_int8=112.0)
+    )
+    assert ubd.compare_blocks(docs, fresh) == []
+
+
 def test_render_block_is_deterministic():
     details = {
         "value": 123.4,

@@ -1,0 +1,816 @@
+"""Quantized paged-KV (ISSUE 14): int8 pools + per-page scales.
+
+Covers the ops/quant.py contract (scale lifecycle, write/requant math), the
+kernels' in-ring dequant against the XLA oracle (interpret mode), the fused
+prefill write's in-kernel quantization, serde v3 round-trips across tp
+shard split/join, corruption -> quarantine, the runner/engine threading,
+and the logit-error bound vs fp pools.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from production_stack_tpu.models import llama  # noqa: E402
+from production_stack_tpu.ops import quant  # noqa: E402
+from production_stack_tpu.ops.attention import (  # noqa: E402
+    paged_attention_decode,
+    write_kv_pages_all_layers,
+)
+from production_stack_tpu.ops.pallas.paged_attention import (  # noqa: E402
+    ragged_paged_attention_decode,
+)
+from production_stack_tpu.ops.pallas.prefill_attention import (  # noqa: E402
+    ragged_paged_attention_prefill,
+)
+
+
+def _quant_pool(rng, P, ps, KH, D, L=1):
+    """fp pool + its quantized twin ([L, P, ps, KH, D] int8, [L, P, KH])."""
+    kp = rng.randn(L, P, ps, KH, D).astype(np.float32)
+    qk = np.zeros((L, P, ps, KH, D), np.int8)
+    sk = np.ones((L, P, KH), np.float32)
+    for p in range(P):
+        q, s = quant.quantize_page_host(kp[:, p])
+        qk[:, p], sk[:, p] = q, s
+    return kp, qk, sk
+
+
+class TestQuantMath:
+    def test_host_roundtrip_error_bound(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 16, 4, 32).astype(np.float32)
+        q, s = quant.quantize_page_host(x)
+        back = quant.dequantize_page_host(q, s)
+        # symmetric int8: error <= 0.5 LSB = 0.5 * amax / 127 per (L, KH)
+        amax = np.abs(x).max(axis=(1, 3), keepdims=False)
+        bound = 0.5 * amax / 127.0 + 1e-7
+        err = np.abs(back - x).max(axis=(1, 3))
+        assert (err <= bound).all()
+
+    def test_sequential_append_matches_fp_reference(self):
+        """Decode-style appends (T=1, page-by-page growth) through
+        write_kv_pages_all_layers_quant track the fp scatter within the
+        quantization bound — including across scale-growth requants."""
+        rng = np.random.RandomState(1)
+        L, P, ps, KH, D = 2, 6, 4, 2, 8
+        kq = jnp.zeros((L, P, ps, KH, D), jnp.int8)
+        vq = jnp.zeros_like(kq)
+        ks = quant.init_kv_scales(L, P, KH)
+        vs = quant.init_kv_scales(L, P, KH)
+        kf = jnp.zeros((L, P, ps, KH, D), jnp.float32)
+        vf = jnp.zeros_like(kf)
+        pt = jnp.asarray([[0, 2, 4]], jnp.int32)
+        T = 10  # spans 3 pages
+        # growing magnitudes force scale growth mid-page
+        toks = [
+            rng.randn(L, 1, 1, KH, D).astype(np.float32) * (1.0 + 0.5 * t)
+            for t in range(T)
+        ]
+        for t, x in enumerate(toks):
+            pos = jnp.asarray([[t]], jnp.int32)
+            kq, vq, ks, vs = quant.write_kv_pages_all_layers_quant(
+                kq, vq, ks, vs, jnp.asarray(x), jnp.asarray(x), pt, pos
+            )
+            kf, vf = write_kv_pages_all_layers(
+                kf, vf, jnp.asarray(x), jnp.asarray(x), pt, pos
+            )
+        deq = np.asarray(kq, np.float32) * np.asarray(ks)[:, :, None, :, None]
+        ref = np.asarray(kf)
+        # only written slots count
+        for t in range(T):
+            pid, slot = int(pt[0, t // ps]), t % ps
+            a, b = deq[:, pid, slot], ref[:, pid, slot]
+            amax = np.abs(b).max() + 1e-9
+            # growth events requant old content: allow ~1.5 LSB cumulative
+            assert np.abs(a - b).max() <= 1.5 * amax / 127.0 + 1e-6
+
+    def test_scale_resets_on_page_reuse(self):
+        """A slot-0 write must RESET the page scale (page reallocation) —
+        without it a reused page inherits the previous owner's amax."""
+        L, P, ps, KH, D = 1, 2, 4, 1, 4
+        kq = jnp.zeros((L, P, ps, KH, D), jnp.int8)
+        vq = jnp.zeros_like(kq)
+        ks = quant.init_kv_scales(L, P, KH) * 100.0  # huge stale scale
+        vs = quant.init_kv_scales(L, P, KH) * 100.0
+        pt = jnp.asarray([[0]], jnp.int32)
+        x = jnp.full((L, 1, 1, KH, D), 0.5, jnp.float32)
+        kq, vq, ks, vs = quant.write_kv_pages_all_layers_quant(
+            kq, vq, ks, vs, x, x, pt, jnp.asarray([[0]], jnp.int32)
+        )
+        assert float(ks[0, 0, 0]) == pytest.approx(0.5 / 127.0, rel=1e-5)
+        deq = float(kq[0, 0, 0, 0, 0]) * float(ks[0, 0, 0])
+        assert deq == pytest.approx(0.5, rel=0.01)
+
+    def test_gather_dequant_matches_manual(self):
+        rng = np.random.RandomState(2)
+        _, qk, sk = _quant_pool(rng, 5, 4, 2, 8)
+        _, qv, sv = _quant_pool(rng, 5, 4, 2, 8)
+        pt = jnp.asarray([[0, 2], [1, 3]], jnp.int32)
+        k, v = quant.gather_kv_pages_quant(
+            jnp.asarray(qk[0]), jnp.asarray(qv[0]),
+            jnp.asarray(sk[0]), jnp.asarray(sv[0]), pt,
+        )
+        man = (
+            qk[0].astype(np.float32) * sk[0][:, None, :, None]
+        )[np.asarray(pt)].reshape(2, 8, 2, 8)
+        np.testing.assert_allclose(np.asarray(k), man, atol=1e-6)
+
+
+class TestDecodeKernelQuant:
+    """In-ring dequant: the kernel over int8 pools must match the XLA
+    oracle over the DEQUANTIZED pools to fp rounding, and sit within the
+    quantization bound of the true-fp result."""
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(0)
+        self.B, NH, KH, D, ps, mp = 3, 8, 4, 32, 8, 6
+        P = self.B * mp + 2
+        self.kp, self.qk, self.sk = _quant_pool(rng, P, ps, KH, D)
+        self.vp, self.qv, self.sv = _quant_pool(rng, P, ps, KH, D)
+        self.pt = rng.permutation(P)[: self.B * mp].reshape(
+            self.B, mp
+        ).astype(np.int32)
+        self.lens = np.array([5, 33, 48], np.int32)
+        self.q = rng.randn(self.B, NH, D).astype(np.float32)
+        self.deq_k = self.kp * 0 + (
+            self.qk.astype(np.float32) * self.sk[:, :, None, :, None]
+        )
+        self.deq_v = (
+            self.qv.astype(np.float32) * self.sv[:, :, None, :, None]
+        )
+
+    def _args(self):
+        return (
+            jnp.asarray(self.q), jnp.asarray(self.qk[0]),
+            jnp.asarray(self.qv[0]), jnp.asarray(self.pt),
+            jnp.asarray(self.lens),
+        )
+
+    def test_matches_dequant_oracle(self):
+        out = ragged_paged_attention_decode(
+            *self._args(), interpret=True,
+            k_scales=jnp.asarray(self.sk[0]), v_scales=jnp.asarray(self.sv[0]),
+        )
+        ref = paged_attention_decode(
+            jnp.asarray(self.q), jnp.asarray(self.deq_k[0]),
+            jnp.asarray(self.deq_v[0]), jnp.asarray(self.pt),
+            jnp.asarray(self.lens),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_oracle_accepts_scales(self):
+        """paged_attention_decode with scales == gather-dequant path."""
+        ref = paged_attention_decode(
+            jnp.asarray(self.q), jnp.asarray(self.deq_k[0]),
+            jnp.asarray(self.deq_v[0]), jnp.asarray(self.pt),
+            jnp.asarray(self.lens),
+        )
+        out = paged_attention_decode(
+            jnp.asarray(self.q), jnp.asarray(self.qk[0]),
+            jnp.asarray(self.qv[0]), jnp.asarray(self.pt),
+            jnp.asarray(self.lens),
+            k_scales=jnp.asarray(self.sk[0]), v_scales=jnp.asarray(self.sv[0]),
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=1e-6
+        )
+
+    def test_error_vs_fp_bounded(self):
+        out = ragged_paged_attention_decode(
+            *self._args(), interpret=True,
+            k_scales=jnp.asarray(self.sk[0]), v_scales=jnp.asarray(self.sv[0]),
+        )
+        ref = paged_attention_decode(
+            jnp.asarray(self.q), jnp.asarray(self.kp[0]),
+            jnp.asarray(self.vp[0]), jnp.asarray(self.pt),
+            jnp.asarray(self.lens),
+        )
+        scale = np.abs(np.asarray(ref)).max()
+        assert np.abs(np.asarray(out) - np.asarray(ref)).max() < 0.05 * scale
+
+    def test_in_register_window_stays_fp(self):
+        rng = np.random.RandomState(3)
+        kc = rng.randn(self.B, 4, 32).astype(np.float32)
+        vc = rng.randn(self.B, 4, 32).astype(np.float32)
+        out = ragged_paged_attention_decode(
+            *self._args(), interpret=True,
+            k_cur=jnp.asarray(kc), v_cur=jnp.asarray(vc),
+            k_scales=jnp.asarray(self.sk[0]), v_scales=jnp.asarray(self.sv[0]),
+        )
+        ref = paged_attention_decode(
+            jnp.asarray(self.q), jnp.asarray(self.deq_k[0]),
+            jnp.asarray(self.deq_v[0]), jnp.asarray(self.pt),
+            jnp.asarray(self.lens),
+            k_cur=jnp.asarray(kc)[:, None], v_cur=jnp.asarray(vc)[:, None],
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+
+class TestPrefillKernelQuant:
+    def setup_method(self, _):
+        rng = np.random.RandomState(0)
+        self.rng = rng
+        self.B, self.T, NH, KH, D, ps = 2, 16, 4, 2, 32, 8
+        mp = 6
+        P = self.B * mp + 2
+        self.ps = ps
+        self.hist = [16, 24]  # page-aligned: this row's paged history
+        kp = np.zeros((1, P, ps, KH, D), np.float32)
+        vp = np.zeros((1, P, ps, KH, D), np.float32)
+        self.pt = rng.permutation(P)[: self.B * mp].reshape(
+            self.B, mp
+        ).astype(np.int32)
+        for b in range(self.B):
+            for t in range(self.hist[b]):
+                kp[0, self.pt[b, t // ps], t % ps] = rng.randn(KH, D)
+                vp[0, self.pt[b, t // ps], t % ps] = rng.randn(KH, D)
+        self.qk = np.zeros((P, ps, KH, D), np.int8)
+        self.sk = np.ones((P, KH), np.float32)
+        self.qv = np.zeros_like(self.qk)
+        self.sv = np.ones_like(self.sk)
+        for p in range(P):
+            q, s = quant.quantize_page_host(kp[:, p])
+            self.qk[p], self.sk[p] = q[0], s[0]
+            q, s = quant.quantize_page_host(vp[:, p])
+            self.qv[p], self.sv[p] = q[0], s[0]
+        self.q = rng.randn(self.B, self.T, NH, D).astype(np.float32)
+        self.kc = rng.randn(self.B, self.T, KH, D).astype(np.float32)
+        self.vc = rng.randn(self.B, self.T, KH, D).astype(np.float32)
+        self.pos = np.stack(
+            [np.arange(h, h + self.T) for h in self.hist]
+        ).astype(np.int32)
+        self.lens = np.asarray([h + self.T for h in self.hist], np.int32)
+        self.cl = np.full((self.B,), self.T, np.int32)
+
+    def _kernel(self, fused=False, q_block=128):
+        return ragged_paged_attention_prefill(
+            jnp.asarray(self.q), jnp.asarray(self.qk), jnp.asarray(self.qv),
+            jnp.asarray(self.pt), jnp.asarray(self.pos),
+            jnp.asarray(self.lens), jnp.asarray(self.kc),
+            jnp.asarray(self.vc), jnp.asarray(self.cl),
+            interpret=True, fused_write=fused, q_block=q_block,
+            k_scales=jnp.asarray(self.sk), v_scales=jnp.asarray(self.sv),
+        )
+
+    def _oracle(self):
+        from production_stack_tpu.ops.attention import (
+            flash_attention,
+            stale_kv_positions,
+        )
+
+        kd = self.qk.astype(np.float32) * self.sk[:, None, :, None]
+        vd = self.qv.astype(np.float32) * self.sv[:, None, :, None]
+        kg = kd[self.pt].reshape(self.B, -1, *kd.shape[2:])
+        vg = vd[self.pt].reshape(self.B, -1, *vd.shape[2:])
+        kvpos = stale_kv_positions(
+            jnp.asarray(self.pt), jnp.asarray(self.pos), self.ps
+        )
+        k = jnp.concatenate([jnp.asarray(kg), jnp.asarray(self.kc)], axis=1)
+        v = jnp.concatenate([jnp.asarray(vg), jnp.asarray(self.vc)], axis=1)
+        return flash_attention(
+            jnp.asarray(self.q), k, v, q_positions=jnp.asarray(self.pos),
+            kv_lens=jnp.asarray(self.lens), kv_positions=kvpos,
+        )
+
+    def test_read_ring_dequant_matches_oracle(self):
+        out = self._kernel()
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._oracle()), atol=2e-5, rtol=2e-5
+        )
+
+    def test_fused_write_bit_identical_to_xla_quant_scatter(self):
+        """Page-aligned chunks: the in-kernel quantizer and the XLA commit
+        compute the same amax over the same f32 values — pool bytes and
+        scales must match EXACTLY."""
+        out, kq2, vq2, sk2, sv2 = self._kernel(fused=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._oracle()), atol=2e-5, rtol=2e-5
+        )
+        kq3, vq3, sk3, sv3 = quant.write_kv_pages_all_layers_quant(
+            jnp.asarray(self.qk)[None], jnp.asarray(self.qv)[None],
+            jnp.asarray(self.sk)[None], jnp.asarray(self.sv)[None],
+            jnp.asarray(self.kc)[None], jnp.asarray(self.vc)[None],
+            jnp.asarray(self.pt), jnp.asarray(self.pos),
+        )
+        assert np.array_equal(np.asarray(kq2), np.asarray(kq3)[0])
+        assert np.array_equal(np.asarray(vq2), np.asarray(vq3)[0])
+        np.testing.assert_allclose(np.asarray(sk2), np.asarray(sk3)[0])
+        np.testing.assert_allclose(np.asarray(sv2), np.asarray(sv3)[0])
+
+    def test_fused_write_unaligned_head_page_clips_into_old_scale(self):
+        """A non-page-aligned chunk start keeps the head page's OLD scale
+        (old bytes untouched — the same invocation's reads race them) and
+        clips new tokens into it; fresh pages still reset."""
+        self.hist = [12, 20]  # NOT page-aligned (ps=8)
+        self.pos = np.stack(
+            [np.arange(h, h + self.T) for h in self.hist]
+        ).astype(np.int32)
+        self.lens = np.asarray([h + self.T for h in self.hist], np.int32)
+        _, kq2, _, sk2, _ = self._kernel(fused=True)
+        for b, h in enumerate(self.hist):
+            head = self.pt[b, h // self.ps]
+            np.testing.assert_allclose(  # head page scale unchanged
+                np.asarray(sk2)[head], self.sk[head]
+            )
+            # old bytes of the head page byte-identical
+            assert np.array_equal(
+                np.asarray(kq2)[head, : h % self.ps],
+                self.qk[head, : h % self.ps],
+            )
+            # a FRESH page of the same row got a real (reset) scale
+            fresh = self.pt[b, h // self.ps + 1]
+            assert not np.allclose(np.asarray(sk2)[fresh], self.sk[fresh])
+
+
+class TestSerdeV3:
+    def _page(self, seed=0, L=2, ps=8, KH=4, D=16):
+        rng = np.random.RandomState(seed)
+        k = rng.randn(L, ps, KH, D).astype(np.float32)
+        v = rng.randn(L, ps, KH, D).astype(np.float32)
+        qk, sk = quant.quantize_page_host(k)
+        qv, sv = quant.quantize_page_host(v)
+        return k, v, qk, sk, qv, sv
+
+    def test_quant_roundtrip_bit_exact(self):
+        from production_stack_tpu.kvoffload.serde import get_serde
+
+        _, _, qk, sk, qv, sv = self._page()
+        s = get_serde("int8page")
+        blob = s.serialize_quant(qk, sk, qv, sv)
+        qk2, sk2, qv2, sv2 = s.deserialize_quant(blob)
+        assert np.array_equal(qk, qk2) and np.array_equal(qv, qv2)
+        assert np.array_equal(sk, sk2) and np.array_equal(sv, sv2)
+
+    def test_v3_blob_dequantizes_for_fp_reader(self):
+        from production_stack_tpu.kvoffload import serde as serde_mod
+
+        k, v, qk, sk, qv, sv = self._page()
+        blob = serde_mod.get_serde("int8page").serialize_quant(
+            qk, sk, qv, sv, orig_dtype=np.dtype(np.float32)
+        )
+        k2, v2 = serde_mod.deserialize(blob)  # generic fp entry point
+        assert k2.dtype == np.float32
+        amax = np.abs(k).max()
+        assert np.abs(k2 - k).max() <= 0.5 * amax / 127.0 + 1e-6
+
+    def test_fp_blob_quantizes_for_int8_reader(self):
+        from production_stack_tpu.kvoffload.serde import get_serde
+
+        k, v, *_ = self._page()
+        blob = get_serde("naive").serialize(k, v)
+        qk, sk, qv, sv = get_serde("int8page").deserialize_quant(blob)
+        back = quant.dequantize_page_host(qk, sk)
+        amax = np.abs(k).max()
+        assert np.abs(back - k).max() <= 0.5 * amax / 127.0 + 1e-6
+
+    def test_v3_version_stamping(self):
+        """Quantized blobs claim v3 (old readers refuse, never misparse);
+        fp blobs keep stamping v2 so a mixed-version fleet's old readers
+        still accept them during a rolling upgrade."""
+        from production_stack_tpu.kvoffload.serde import (
+            NaiveSerde,
+            get_serde,
+            verify_blob,
+        )
+
+        k, v, qk, sk, qv, sv = self._page()
+        q_blob = get_serde("int8page").serialize_quant(qk, sk, qv, sv)
+        assert verify_blob(q_blob)["v"] == 3
+        assert verify_blob(NaiveSerde().serialize(k, v))["v"] == 2
+        assert verify_blob(get_serde("int8").serialize(k, v))["v"] == 2
+
+    def test_bit_flip_rejected(self):
+        from production_stack_tpu.kvoffload.serde import (
+            KVIntegrityError,
+            get_serde,
+            verify_blob,
+        )
+
+        _, _, qk, sk, qv, sv = self._page()
+        blob = bytearray(get_serde("int8page").serialize_quant(qk, sk, qv, sv))
+        blob[len(blob) // 2] ^= 0x40
+        with pytest.raises(KVIntegrityError):
+            verify_blob(bytes(blob))
+
+    def test_truncation_rejected(self):
+        from production_stack_tpu.kvoffload.serde import (
+            KVIntegrityError,
+            get_serde,
+            verify_blob,
+        )
+
+        _, _, qk, sk, qv, sv = self._page()
+        blob = get_serde("int8page").serialize_quant(qk, sk, qv, sv)
+        with pytest.raises(KVIntegrityError):
+            verify_blob(blob[:-9])
+
+    @pytest.mark.parametrize("tp", [1, 2, 4])
+    def test_tp_split_join_roundtrip(self, tp):
+        from production_stack_tpu.kvoffload.serde import (
+            join_kv_heads_quant,
+            split_kv_heads_quant,
+        )
+
+        _, _, qk, sk, qv, sv = self._page(KH=4)
+        parts = split_kv_heads_quant(qk, sk, qv, sv, tp)
+        assert len(parts) == tp
+        for pk, psk, pv, psv in parts:
+            assert pk.shape[2] == 4 // tp and psk.shape[1] == 4 // tp
+        qk2, sk2, qv2, sv2 = join_kv_heads_quant(parts)
+        assert np.array_equal(qk, qk2) and np.array_equal(sk, sk2)
+        assert np.array_equal(qv, qv2) and np.array_equal(sv, sv2)
+
+    def test_tp_shard_scales_align_with_heads(self):
+        """Shard i's scales must be exactly heads [i*KH/N, (i+1)*KH/N) —
+        a tp=2 restore into tp=1 must dequantize every head correctly."""
+        from production_stack_tpu.kvoffload.serde import split_kv_heads_quant
+
+        k, v, qk, sk, qv, sv = self._page(KH=4)
+        full = quant.dequantize_page_host(qk, sk)
+        parts = split_kv_heads_quant(qk, sk, qv, sv, 2)
+        for i, (pk, psk, _, _) in enumerate(parts):
+            np.testing.assert_allclose(
+                quant.dequantize_page_host(pk, psk),
+                full[:, :, i * 2 : (i + 1) * 2],
+            )
+
+    def test_split_refuses_uneven_heads(self):
+        from production_stack_tpu.kvoffload.serde import split_kv_heads_quant
+
+        _, _, qk, sk, qv, sv = self._page(KH=4)
+        with pytest.raises(ValueError):
+            split_kv_heads_quant(qk, sk, qv, sv, 3)
+
+
+@pytest.fixture(scope="module")
+def quant_runner():
+    from production_stack_tpu.engine.runner import ModelRunner
+
+    cfg = dataclasses.replace(
+        llama.PRESETS["llama-debug"], dtype=jnp.float32, attn_impl="xla",
+        kv_cache_dtype="int8",
+    )
+    return ModelRunner(cfg, num_pages=32, page_size=8, seed=0)
+
+
+class TestRunnerQuant:
+    def _io(self, cfg, rng_seed=1):
+        from production_stack_tpu.engine.runner import StepInput
+
+        rng = np.random.RandomState(rng_seed)
+        T = 16
+        pt = np.arange(8).reshape(2, 4)
+        return (
+            StepInput(
+                input_ids=rng.randint(0, cfg.vocab_size, (2, T)),
+                positions=np.tile(np.arange(T), (2, 1)),
+                page_table=pt,
+                kv_lens=np.full((2,), T),
+                temperature=np.zeros(2), top_k=np.zeros(2, int),
+                top_p=np.ones(2),
+            ),
+            StepInput(
+                input_ids=rng.randint(0, cfg.vocab_size, (2, 1)),
+                positions=np.full((2, 1), T),
+                page_table=pt,
+                kv_lens=np.full((2,), T + 1),
+                temperature=np.zeros(2), top_k=np.zeros(2, int),
+                top_p=np.ones(2),
+                kv_limits=np.full((2,), 30),
+            ),
+        )
+
+    def test_pools_are_int8_with_scales(self, quant_runner):
+        assert quant_runner.kv_quant
+        assert quant_runner.k_pages.dtype == jnp.int8
+        assert quant_runner.k_scales.shape == (2, 32, 2)
+        assert quant_runner.kv_pool_dtype == jnp.int8
+
+    def test_logit_error_bounded_vs_fp(self, quant_runner):
+        from production_stack_tpu.engine.runner import ModelRunner
+
+        cfg_fp = dataclasses.replace(quant_runner.cfg, kv_cache_dtype="auto")
+        fp = ModelRunner(cfg_fp, num_pages=32, page_size=8, seed=0)
+        prefill, dec = self._io(quant_runner.cfg)
+        fp.step(prefill)
+        quant_runner.step(prefill)
+        _, lf = fp.step(dec)
+        _, lq = quant_runner.step(dec)
+        scale = np.abs(np.asarray(lf)).max()
+        assert 0 < np.abs(np.asarray(lq) - np.asarray(lf)).max() < 0.05 * scale
+
+    def test_burst_decode_and_accessor_roundtrip(self, quant_runner):
+        prefill, dec = self._io(quant_runner.cfg, rng_seed=2)
+        quant_runner.step(prefill)
+        toks = quant_runner.step_multi(dec, 4)
+        assert np.asarray(toks).shape == (2, 4)
+        ks, vs, sks, svs = quant_runner.get_pages_quant([0, 1, 2])
+        assert ks[0].dtype == np.int8 and sks[0].shape == (2, 2)
+        quant_runner.set_pages_quant([0, 1, 2], ks, vs, sks, svs)
+        ks2, _, sks2, _ = quant_runner.get_pages_quant([0, 1, 2])
+        assert all(np.array_equal(a, b) for a, b in zip(ks, ks2))
+        assert all(np.array_equal(a, b) for a, b in zip(sks, sks2))
+
+    def test_shard_layout_counts_int8_and_scales(self, quant_runner):
+        per = dict(quant_runner.kv_pool_shard_layout())
+        L, P, ps, KH, D = 2, 32, 8, 2, 32
+        expect = 2 * L * P * ps * KH * D * 1 + 2 * 4 * L * P * KH
+        assert list(per.values())[0] == expect
+
+    def test_spec_decode_refused(self, quant_runner):
+        from production_stack_tpu.engine.runner import StepInput
+
+        prefill, dec = self._io(quant_runner.cfg)
+        with pytest.raises(ValueError, match="speculative"):
+            quant_runner.step_spec(dec, np.zeros((2, 32), np.int32), 1, 2, 2)
+
+    def test_pre_write_mode_refused(self):
+        from production_stack_tpu.engine.runner import ModelRunner
+
+        cfg = dataclasses.replace(
+            llama.PRESETS["llama-debug"], kv_write_mode="pre",
+            kv_cache_dtype="int8",
+        )
+        with pytest.raises(ValueError, match="post"):
+            ModelRunner(cfg, num_pages=16, page_size=8)
+
+    def test_unknown_dtype_refused(self):
+        from production_stack_tpu.engine.runner import ModelRunner
+
+        cfg = dataclasses.replace(
+            llama.PRESETS["llama-debug"], kv_cache_dtype="int4"
+        )
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            ModelRunner(cfg, num_pages=16, page_size=8)
+
+    def test_reset_kv_rebuilds_scales(self, quant_runner):
+        quant_runner.reset_kv()
+        assert quant_runner.k_pages.dtype == jnp.int8
+        assert float(np.asarray(quant_runner.k_scales).min()) == 1.0
+
+
+class TestTensorParallelQuant:
+    """int8 pools on a tp-sharded mesh (virtual CPU devices): the scales
+    pool shards its KH axis with the pages', serving logits stay equal
+    across tp shapes, and quantized blobs cross tp shapes bit-faithfully
+    (the PR 12 tp-invariance contract, now for int8)."""
+
+    def _io(self, cfg):
+        from production_stack_tpu.engine.runner import StepInput
+
+        rng = np.random.RandomState(0)
+        B, T = 2, 8
+        mk = lambda **kw: StepInput(
+            page_table=np.arange(B * 2).reshape(B, 2),
+            temperature=np.zeros(B), top_k=np.zeros(B, int),
+            top_p=np.ones(B), **kw,
+        )
+        return (
+            mk(input_ids=rng.randint(0, cfg.vocab_size, (B, T)),
+               positions=np.broadcast_to(np.arange(T), (B, T)).copy(),
+               kv_lens=np.full((B,), T)),
+            mk(input_ids=rng.randint(0, cfg.vocab_size, (B, 1)),
+               positions=np.full((B, 1), T),
+               kv_lens=np.full((B,), T + 1)),
+        )
+
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_tp_serving_matches_single_device(self, tp):
+        from production_stack_tpu.engine.runner import ModelRunner
+        from production_stack_tpu.parallel.mesh import make_mesh
+
+        if len(jax.devices()) < tp:
+            pytest.skip("needs the 8-virtual-device CPU mesh")
+        cfg = dataclasses.replace(
+            llama.PRESETS["llama-debug-4kv-f32"], kv_cache_dtype="int8"
+        )
+        prefill, dec = self._io(cfg)
+
+        def run(mesh):
+            r = ModelRunner(cfg, mesh=mesh, num_pages=16, page_size=8, seed=0)
+            r.step(prefill)
+            _, logits = r.step(dec)
+            return np.asarray(logits), r
+
+        l1, _ = run(make_mesh())
+        ln, rn = run(make_mesh(tp=tp))
+        assert rn.k_scales.sharding.spec[2] == "tp"
+        np.testing.assert_allclose(ln, l1, atol=1e-4, rtol=1e-4)
+
+    def test_tp_blob_roundtrip_into_single_device_pool(self):
+        """A tp=2 engine's quantized spill restores into a tp=1 quantized
+        pool with identical dequantized content (blob = whole gathered
+        page + scales; the scatter re-shards device-side)."""
+        from production_stack_tpu.engine.runner import ModelRunner
+        from production_stack_tpu.kvoffload.serde import get_serde
+        from production_stack_tpu.parallel.mesh import make_mesh
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs the 8-virtual-device CPU mesh")
+        cfg = dataclasses.replace(
+            llama.PRESETS["llama-debug-4kv-f32"], kv_cache_dtype="int8"
+        )
+        prefill, _ = self._io(cfg)
+        r2 = ModelRunner(cfg, mesh=make_mesh(tp=2), num_pages=16,
+                         page_size=8, seed=0)
+        r2.step(prefill)
+        ks, vs, sks, svs = r2.get_pages_quant([0, 1])
+        s = get_serde("int8page")
+        blobs = [
+            s.serialize_quant(k, sk, v, sv)
+            for k, v, sk, sv in zip(ks, vs, sks, svs)
+        ]
+        r1 = ModelRunner(cfg, mesh=make_mesh(), num_pages=16, page_size=8,
+                         seed=1)
+        payloads = [s.deserialize_quant(b) for b in blobs]
+        r1.set_pages_quant(
+            [0, 1],
+            [p[0] for p in payloads], [p[2] for p in payloads],
+            [p[1] for p in payloads], [p[3] for p in payloads],
+        )
+        ks1, vs1, sks1, svs1 = r1.get_pages_quant([0, 1])
+        for a, b in zip(ks + vs + sks + svs, ks1 + vs1 + sks1 + svs1):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestGemma2Quant:
+    def test_gemma2_quant_logits_close_to_fp(self):
+        from production_stack_tpu.engine.runner import ModelRunner, StepInput
+        from production_stack_tpu.models import gemma2
+
+        base = dataclasses.replace(
+            gemma2.PRESETS["gemma2-debug"], dtype=jnp.float32, attn_impl="xla"
+        )
+        rng = np.random.RandomState(0)
+        T = 16
+        pt = np.arange(8).reshape(2, 4)
+        ids = rng.randint(0, base.vocab_size, (2, T))
+        dec_ids = rng.randint(0, base.vocab_size, (2, 1))
+
+        def run(cfg):
+            r = ModelRunner(cfg, num_pages=32, page_size=8, seed=0)
+            r.step(StepInput(
+                input_ids=ids, positions=np.tile(np.arange(T), (2, 1)),
+                page_table=pt, kv_lens=np.full((2,), T),
+                temperature=np.zeros(2), top_k=np.zeros(2, int),
+                top_p=np.ones(2),
+            ))
+            _, logits = r.step(StepInput(
+                input_ids=dec_ids, positions=np.full((2, 1), T),
+                page_table=pt, kv_lens=np.full((2,), T + 1),
+                temperature=np.zeros(2), top_k=np.zeros(2, int),
+                top_p=np.ones(2),
+            ))
+            return np.asarray(logits)
+
+        lf = run(base)
+        lq = run(dataclasses.replace(base, kv_cache_dtype="int8"))
+        scale = np.abs(lf).max()
+        assert 0 < np.abs(lq - lf).max() < 0.05 * scale
+
+
+class TestEngineQuant:
+    @pytest.fixture(scope="class")
+    def engine(self, tmp_path_factory):
+        from production_stack_tpu.engine.config import EngineConfig
+        from production_stack_tpu.engine.engine import LLMEngine
+
+        cfg = EngineConfig(
+            model="llama-debug", max_model_len=256, max_num_seqs=8,
+            num_pages=64, page_size=8, prefill_chunk=32,
+            kv_cache_memory_gb=0.01, kv_cache_dtype="int8",
+            kv_offload_dir=str(tmp_path_factory.mktemp("kvq")),
+            kv_offload_disk_gb=1.0, kv_offload_max_io_pages=0,
+        )
+        eng = LLMEngine(cfg)
+        eng.start()
+        yield eng
+        eng.stop()
+
+    def _collect(self, engine, prompt, **params):
+        import asyncio
+
+        from production_stack_tpu.engine.scheduler import SamplingParams
+
+        async def run():
+            outs = []
+            async for out in engine.generate(
+                f"q-{np.random.randint(1 << 30)}", prompt=prompt,
+                params=SamplingParams(**params),
+            ):
+                outs.append(out)
+            return outs
+
+        return asyncio.run(run())
+
+    def test_greedy_generation_reproducible(self, engine):
+        outs = self._collect(
+            engine, "the quantized cache serves tokens", max_tokens=8,
+            temperature=0.0, ignore_eos=True,
+        )
+        assert outs[-1].finished and outs[-1].completion_tokens == 8
+        t1 = [t for o in outs for t in o.token_ids]
+        outs2 = self._collect(
+            engine, "the quantized cache serves tokens", max_tokens=8,
+            temperature=0.0, ignore_eos=True,
+        )
+        assert t1 == [t for o in outs2 for t in o.token_ids]
+
+    def test_stats_surface(self, engine):
+        s = engine.stats()
+        assert s["cache_dtype"] == "int8"
+        assert s["kv_quant_pages"] == 64
+        assert 0 < s["kv_quant_dequant_err_max"] < 0.01
+        # int8 + amortized scales: well under half the bf16 footprint's
+        # 2*L*KH*D*2 bytes
+        fp16 = 2 * 2 * 2 * 32 * 2
+        assert 0 < s["kv_cache_dtype_bytes_per_token"] < fp16 * 0.6
+
+    def test_offload_roundtrip_bit_exact(self, engine):
+        """Spill -> wipe -> restore through the real tier reproduces the
+        exact pool bytes + scales (serde v3 passthrough, no requant)."""
+        r = engine.runner
+        pids = [0, 1]
+        hashes = [b"qq0" * 6, b"qq1" * 6]
+        ks, vs, sks, svs = r.get_pages_quant(pids)
+        ok = engine._offload.save_pages(list(zip(pids, hashes)))
+        assert set(ok) == set(hashes)
+        z = [np.zeros_like(ks[0])] * 2
+        zs = [np.zeros_like(sks[0])] * 2
+        r.set_pages_quant(pids, z, z, zs, zs)
+        assert engine._offload.load_pages(list(zip(pids, hashes))) == 2
+        ks2, vs2, sks2, svs2 = r.get_pages_quant(pids)
+        for a, b in zip(ks + vs + sks + svs, ks2 + vs2 + sks2 + svs2):
+            assert np.array_equal(a, b)
+
+    def test_warm_style_sparse_restore_roundtrip(self, engine):
+        """load_pages_sparse (the warm-start/migration restore path) moves
+        quantized blobs bit-exactly too, and skips corrupt ones."""
+        r = engine.runner
+        ks, vs, sks, svs = r.get_pages_quant([2])
+        assert engine._offload.save_pages([(2, b"warm" * 5)])
+        # corrupt a second entry IN the store: quarantined, not served
+        store = engine._offload.store
+        good = store.get((b"warm" * 5).hex())
+        bad = bytearray(good)
+        bad[-3] ^= 0x20
+        store.put((b"dead" * 5).hex(), bytes(bad))
+        z = [np.zeros_like(ks[0])]
+        r.set_pages_quant([2], z, z, [np.zeros_like(sks[0])],
+                          [np.zeros_like(svs[0])])
+        ok = engine._offload.load_pages_sparse(
+            [(2, b"warm" * 5), (3, b"dead" * 5)]
+        )
+        assert ok == [True, False]
+        ks2, _, sks2, _ = r.get_pages_quant([2])
+        assert np.array_equal(ks[0], ks2[0])
+        assert np.array_equal(sks[0], sks2[0])
+
+    def test_connector_uses_v3_serde(self, engine):
+        assert engine._offload.serde.name == "int8page"
+
+    def test_int8_with_spec_refused(self):
+        from production_stack_tpu.engine.config import EngineConfig
+        from production_stack_tpu.engine.engine import LLMEngine
+
+        with pytest.raises(ValueError, match="speculative"):
+            LLMEngine(EngineConfig(
+                model="llama-debug", num_pages=16, page_size=8,
+                kv_cache_dtype="int8", speculative_k=4,
+            ))
+
+    def test_int8_with_opt_family_refused(self):
+        from production_stack_tpu.engine.config import EngineConfig
+        from production_stack_tpu.engine.engine import LLMEngine
+
+        with pytest.raises(ValueError, match="not supported"):
+            LLMEngine(EngineConfig(
+                model="opt-debug", num_pages=16, page_size=8,
+                kv_cache_dtype="int8",
+            ))
+
+    def test_int8_doubles_auto_pool_pages(self):
+        """Same kv_cache_memory_gb, ~2x the pages: the capacity half of
+        the win (num_pages sized from the int8 page bytes)."""
+        from production_stack_tpu.engine.config import EngineConfig
+        from production_stack_tpu.engine.engine import LLMEngine
+
+        common = dict(
+            model="llama-debug", max_model_len=128, page_size=8,
+            kv_cache_memory_gb=0.001,
+        )
+        fp = LLMEngine(EngineConfig(**common))
+        q = LLMEngine(EngineConfig(**common, kv_cache_dtype="int8"))
+        try:
+            assert q.kv.num_pages >= 1.8 * fp.kv.num_pages
+        finally:
+            pass
