@@ -262,6 +262,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - record, keep benching
         lc_metrics["kv_quant_error"] = repr(e)
 
+    # --- KV fabric loopback (ISSUE 16): push/pull throughput of the
+    # engine-to-engine transfer plane over a real listener — host-side
+    # only (no device), so it measures the wire + framing cost the disagg
+    # stream and migration ship pay per page. Fail-soft like the rest.
+    try:
+        lc_metrics.update(kv_fabric_metrics(page_size))
+    except Exception as e:  # noqa: BLE001 - record, keep benching
+        lc_metrics["kv_fabric_error"] = repr(e)
+
     extras = {
         # pool dtype of the phase-1/serving engines (the quantized contrast
         # rides its own kv_quant_* / *_int8 keys)
@@ -389,6 +398,75 @@ def kv_quant_metrics(
         float((toks_by["fp"] == toks_by["int8"]).mean()), 4
     )
     out["kv_quant_context"] = target
+    return out
+
+
+def kv_fabric_metrics(page_size: int) -> dict:
+    """KV fabric loopback phase (ISSUE 16): stand up a real fabric
+    listener, then push and pull batches of synthetic llama-debug-shaped
+    pages through the versioned CRC'd wire path (docs/kv-fabric.md) and
+    record pages/s + MB/s for both directions plus the probed loopback
+    bandwidth the peer-selection score would see. Keys:
+    ``kv_fabric_push_pages_per_sec``, ``kv_fabric_pull_pages_per_sec``,
+    ``kv_fabric_push_mb_per_sec``, ``kv_fabric_probe_mb_per_sec``,
+    ``kv_fabric_page_kb``."""
+    import numpy as np
+
+    from production_stack_tpu.kvfabric.client import KVFabricClient
+    from production_stack_tpu.kvfabric.server import KVFabricServer
+    from production_stack_tpu.kvfabric.wire import decode_frame, encode_frame
+
+    L, KH, D = 2, 4, 16  # llama-debug pool geometry
+    n_pages, rounds = 64, 8
+    rng = np.random.RandomState(3)
+    keys = [bytes([i, 0xFA] + [0] * 30).hex() for i in range(n_pages)]
+    ks = [rng.randn(L, page_size, KH, D).astype(np.float32)
+          for _ in range(n_pages)]
+    vs = [rng.randn(L, page_size, KH, D).astype(np.float32)
+          for _ in range(n_pages)]
+    frame = encode_frame(keys, ks, vs)
+    resident = {"keys": keys, "frame": frame}
+
+    def pages_fn(want):
+        return resident["keys"], resident["frame"]
+
+    sunk = [0]
+
+    def sink_fn(decoded):
+        sunk[0] += len(decoded["keys"])
+        return len(decoded["keys"])
+
+    srv = KVFabricServer("127.0.0.1", 0, generation=1, page_size=page_size,
+                         nlayers=L, pages_fn=pages_fn, sink_fn=sink_fn)
+    srv.start()
+    cli = KVFabricClient(retries=0, timeout=30.0)
+    out = {}
+    try:
+        addr = srv.address
+        assert cli.push(addr, frame), "warm-up push failed"  # connect+frame
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            assert cli.push(addr, frame)
+        dt = time.perf_counter() - t0
+        out["kv_fabric_push_pages_per_sec"] = round(rounds * n_pages / dt, 1)
+        out["kv_fabric_push_mb_per_sec"] = round(
+            rounds * len(frame) / dt / 2**20, 1
+        )
+        assert cli.pull(addr, keys) is not None, "warm-up pull failed"
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            got = cli.pull(addr, keys)
+            assert got is not None and len(got["keys"]) == n_pages
+        dt = time.perf_counter() - t0
+        out["kv_fabric_pull_pages_per_sec"] = round(rounds * n_pages / dt, 1)
+        link = cli.probe(addr)
+        out["kv_fabric_probe_mb_per_sec"] = round(link.bandwidth / 2**20, 1)
+        out["kv_fabric_page_kb"] = round(
+            decode_frame(frame)["pages"][0][0].nbytes * 2 / 1024, 2
+        )
+    finally:
+        cli.close()
+        srv.stop()
     return out
 
 

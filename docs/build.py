@@ -183,7 +183,8 @@ def main() -> None:
 
     order = ["index", "getting-started", "user-manual", "deployment",
              "multichip-serving", "benchmarking", "tracing", "observability",
-             "kv-directory", "static-analysis", "developer-guide"]
+             "kv-directory", "kv-fabric", "static-analysis",
+             "developer-guide"]
     handbook = sorted(
         DOCS.glob("*.md"),
         key=lambda p: (order.index(p.stem) if p.stem in order else 99, p.stem),

@@ -347,6 +347,21 @@ class EngineServer:
                 })
         return web.json_response({"requests": out})
 
+    async def kv_fabric_info(self, request: web.Request) -> web.Response:
+        """Fabric discovery: disagg producers, directory pullers, and
+        migration sources resolve this engine's fabric listener address (and
+        its generation/dtype handshake facts) from here."""
+        srv = getattr(self.engine, "_fabric_server", None)
+        if srv is None:
+            return web.json_response({"enabled": False})
+        return web.json_response({
+            "enabled": True,
+            "addr": srv.address,
+            "generation": srv.generation,
+            "quant": srv.quant,
+            "page_size": srv.page_size,
+        })
+
     async def migrate_out(self, request: web.Request) -> web.Response:
         """Freeze a running stream, ship its snapshot to the target engine's
         /migrate_in, then commit (the stream ends with the handoff control
@@ -387,10 +402,28 @@ class EngineServer:
 
         loop = asyncio.get_running_loop()
         snap_meta = {**meta, "request_id": rid}
+        # fabric handoff: resolve the target's fabric listener FIRST so the
+        # freeze can ship the page chain engine-to-engine (zero shared-tier
+        # I/O); an unresolvable/disabled fabric degrades to the tier save
+        # inside _freeze
+        fabric_addr = None
+        if getattr(self.engine, "_fabric_client", None) is not None:
+            try:
+                session = await self._mig_client()
+                async with session.get(f"{target}/kv_fabric") as resp:
+                    if resp.status == 200:
+                        info = await resp.json()
+                        if info.get("enabled"):
+                            fabric_addr = info.get("addr")
+            except Exception as e:  # noqa: BLE001 - tier path covers it
+                logger.debug("fabric resolve for %s failed: %s", target, e)
         try:
             # device-thread work off the event loop (GC001 discipline)
             snap = await loop.run_in_executor(
-                None, mig.freeze_and_snapshot, sub_ids[0], snap_meta
+                None,
+                lambda: mig.freeze_and_snapshot(
+                    sub_ids[0], snap_meta, fabric_addr
+                ),
             )
         except MigrationError as e:
             return web.json_response(
@@ -849,6 +882,35 @@ class EngineServer:
             for k in sorted(ms):
                 emit(k, "counter", ms[k])
             lines.extend(mig.duration_hist.render(f'model_name="{m}"'))
+        # KV fabric surface (docs/kv-fabric.md): stream/pull latency
+        # histograms + the per-peer probed-bandwidth gauge the disagg router
+        # and fleet controller scrape for transfer-cost-aware placement.
+        # Counters (kv_fabric_*_total) already rendered via engine.stats()
+        fab = getattr(self.engine, "_fabric_client", None)
+        if fab is not None:
+            lines.extend(fab.push_hist.render(f'model_name="{m}"'))
+            lines.extend(fab.pull_hist.render(f'model_name="{m}"'))
+            peers = fab.probe_cache.snapshot()
+            lines.append(
+                "# HELP vllm:kv_fabric_peer_bandwidth_bytes_per_sec "
+                "probed engine-to-engine fabric bandwidth per peer"
+            )
+            lines.append(
+                "# TYPE vllm:kv_fabric_peer_bandwidth_bytes_per_sec gauge"
+            )
+            if not peers:
+                # zero-valued placeholder keeps the name scrapeable (and the
+                # dashboard panel non-empty) before the first probe completes
+                lines.append(
+                    f"vllm:kv_fabric_peer_bandwidth_bytes_per_sec"
+                    f'{{model_name="{m}",peer="none"}} 0'
+                )
+            for addr, link in sorted(peers.items()):
+                lines.append(
+                    f"vllm:kv_fabric_peer_bandwidth_bytes_per_sec"
+                    f'{{model_name="{m}",peer="{addr}"}} '
+                    f"{round(link.bandwidth, 1)}"
+                )
         lines.extend(render_phase_histograms(f'model_name="{m}"'))
         # span-loss + flight-recorder health (trace debugging is only
         # trustworthy when its own drops are measurable)
@@ -1801,6 +1863,12 @@ class EngineServer:
         # --no-migration (handlers answer 501) so the wire surface — and the
         # GC005 fake-engine parity contract — stays stable
         r.add_get("/migratable", self.migratable)
+        # KV fabric discovery (docs/kv-fabric.md): peers resolve this
+        # engine's fabric listener here (--kv-fabric-port 0 binds an
+        # ephemeral port, so config alone cannot name it). Registered even
+        # when the fabric is off (answers enabled:false) so the surface —
+        # and the fake-engine parity contract — stays stable.
+        r.add_get("/kv_fabric", self.kv_fabric_info)
         r.add_post("/migrate_out", self.migrate_out)
         r.add_post("/migrate_in", self.migrate_in)
         r.add_post("/migrate_attach", self.migrate_attach)

@@ -272,6 +272,26 @@ class EngineConfig:
     kv_transfer_device_host: str = "127.0.0.1"
     # staging budget for device-pulled pages awaiting admission (consumer)
     kv_transfer_stage_mb: int = 1024
+    # peer-to-peer KV fabric (production_stack_tpu/kvfabric, docs/kv-fabric.md):
+    # one engine-to-engine transfer plane for streamed disagg prefill,
+    # directory resident-page pulls, and migration page-chain ships. Frames
+    # are versioned + CRC'd (pages, scales) pairs, so int8 engines transfer
+    # with exact scales — this is what lifts the PR 14 int8 disagg gate.
+    # Every fabric path falls back to the tier path on failure (counted as
+    # vllm:kv_fabric_fallbacks_total).
+    kv_fabric: bool = False
+    # fabric listener port; 0 binds an ephemeral port (advertised via
+    # GET /kv_fabric and the directory's resident claims)
+    kv_fabric_port: int = 0
+    # bounded per-request retries below the per-peer breaker
+    kv_fabric_retries: int = 2
+    # disagg producer: the decode peer's fabric listener ("host:port") or
+    # its HTTP URL (GET /kv_fabric then resolves the advertised listener —
+    # needed when the peer binds an ephemeral --kv-fabric-port 0)
+    kv_fabric_peer: Optional[str] = None
+    # streamed disagg prefill: layers shipped per frame (the consumer
+    # assembles windows into whole pages); 0 ships whole pages in one frame
+    kv_fabric_stream_layers: int = 0
     # distributed tracing (production_stack_tpu/tracing, docs/tracing.md):
     # head-based sampling rate for traces ROOTED at this engine (requests
     # arriving with a traceparent header keep the router's decision); 0.0
@@ -365,6 +385,26 @@ _FLAG_HELP = {
     ),
     "kv_directory_pull_max_pages": (
         "cap on pages one admission may prefetch from the shared tier"
+    ),
+    "kv_fabric": (
+        "peer-to-peer KV fabric: engine-to-engine (pages, scales) frames "
+        "for streamed disagg prefill, directory resident pulls, and "
+        "migration ships, with tier fallback on any failure "
+        "(docs/kv-fabric.md)"
+    ),
+    "kv_fabric_port": (
+        "fabric listener port (0 = ephemeral; advertised on GET /kv_fabric)"
+    ),
+    "kv_fabric_retries": (
+        "bounded fabric retries per request, below the per-peer breaker"
+    ),
+    "kv_fabric_peer": (
+        "disagg producer: decode peer's fabric listener (host:port) or its "
+        "HTTP URL (resolved via GET /kv_fabric)"
+    ),
+    "kv_fabric_stream_layers": (
+        "streamed disagg prefill: layers per fabric frame so decode starts "
+        "before the last layer lands (0 = whole pages per frame)"
     ),
     "migration": (
         "serve the live-sequence-migration endpoints (/migrate_out, "

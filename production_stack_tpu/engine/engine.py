@@ -129,11 +129,16 @@ class LLMEngine:
                 raise ValueError(
                     "--kv-cache-dtype int8 does not compose with sp/pp meshes"
                 )
-            if cfg.kv_role != "none" or cfg.kv_transfer_device:
+            if (cfg.kv_role != "none" or cfg.kv_transfer_device) and not cfg.kv_fabric:
+                # gate lifted by the KV fabric (docs/kv-fabric.md): fabric
+                # frames are (pages, scales) pairs, so quantized pages ship
+                # with their exact scales. Without the fabric, the transfer
+                # paths still move raw pool bytes — keep the PR 14 gate.
                 raise ValueError(
-                    "--kv-cache-dtype int8 is not compatible with "
-                    "disaggregated-prefill KV transfer yet (raw device pages "
-                    "would ship without their scales)"
+                    "--kv-cache-dtype int8 with disaggregated-prefill or "
+                    "device KV transfer requires --kv-fabric (fabric frames "
+                    "carry the per-page scales; the raw page paths would "
+                    "ship quantized bytes without them)"
                 )
         self.model_cfg = model_cfg
         self.tokenizer = load_tokenizer(
@@ -434,6 +439,55 @@ class LLMEngine:
                 device_endpoint=endpoint, staging=staging,
             )
             self._kv_receiver.start()
+        # peer-to-peer KV fabric (ISSUE 16, docs/kv-fabric.md): one
+        # engine-to-engine transfer plane for streamed disagg prefill,
+        # directory resident-page pulls, and migration page-chain ships.
+        # The listener serves resident pages straight off the device pool
+        # (gathers run on the device thread); pushed frames land as tier
+        # blobs in the LOCAL store, where the ordinary admission/restore
+        # path finds them. Every fabric consumer falls back to the tier
+        # path on failure (client breaker + counted fallbacks).
+        self._fabric_server = None
+        self._fabric_client = None
+        self._fabric_peer_addr: Optional[str] = None
+        if cfg.kv_fabric:
+            from production_stack_tpu.kvfabric import (
+                FrameAssembler,
+                KVFabricClient,
+                KVFabricServer,
+            )
+
+            self._fabric_asm = FrameAssembler()
+            self._fabric_client = KVFabricClient(retries=cfg.kv_fabric_retries)
+            self._fabric_server = KVFabricServer(
+                host=cfg.host,
+                port=cfg.kv_fabric_port,
+                generation=(
+                    self._kvdir_pub.generation
+                    if self._kvdir_pub is not None
+                    else (
+                        self.warm.generation if self.warm is not None
+                        else int(time.time())
+                    )
+                ),
+                quant=self.kv_quant,
+                page_size=cfg.page_size,
+                nlayers=model_cfg.num_layers,
+                pages_fn=self._fabric_pages,
+                sink_fn=self._fabric_sink,
+                advertise_host=cfg.advertise_host or None,
+            )
+            self._fabric_server.start()
+            if self._kvdir_pull is not None:
+                # resident-page pulls go engine-to-engine: the puller gets
+                # the fabric client plus this engine's advertised URL (so
+                # it never "pulls" from itself) — tier fetch stays the
+                # fallback inside the puller
+                self._kvdir_pull.enable_fabric(
+                    self._fabric_client,
+                    self._advertised_url(cfg),
+                    serde=self._offload.serde,
+                )
         self.scheduler = Scheduler(
             self.kv,
             max_num_seqs=cfg.max_num_seqs,
@@ -777,6 +831,10 @@ class LLMEngine:
                 self._kv_receiver.device_endpoint.close()
             if self._kv_receiver.staging is not None:
                 self._kv_receiver.staging.clear()
+        if self._fabric_server is not None:
+            self._fabric_server.stop()
+        if self._fabric_client is not None:
+            self._fabric_client.close()
 
     def _run_on_device_thread(self, fn, timeout: float = 120.0):
         """Run ``fn`` on the engine device thread (serialized with steps via
@@ -1454,12 +1512,18 @@ class LLMEngine:
         from production_stack_tpu.engine.kv_manager import prefix_hashes
 
         tokens = seq.prompt_ids + seq.output_ids
-        for h in prefix_hashes(tokens, self.kv.page_size, seq.cache_salt):
+        hashes = list(prefix_hashes(tokens, self.kv.page_size, seq.cache_salt))
+        if self._fabric_client is not None:
+            # fabric-first: stream the whole chain as (pages, scales)
+            # frames; anything the fabric could not cover falls through to
+            # the per-page TCP-blob / device paths below (counted fallback)
+            hashes = self._fabric_stream_push(hashes)
+        for h in hashes:
             pid = self.kv.hash_to_page.get(h)
             if pid is None:
                 continue
             key = h.hex()
-            if self._kv_sender._mh_addrs is not None:
+            if self._kv_sender._mh_addrs is not None and not self.kv_quant:
                 # device path (assignment protocol, single- or multi-host):
                 # REPLICATED offer on every producer process, one pull
                 # assignment per consumer process; nbytes from pool metadata
@@ -1474,19 +1538,222 @@ class LLMEngine:
             if self._offload is not None:
                 blob = self._offload.store.get(key)
             if blob is None:
-                k, v = self.runner.get_page(pid)
-                serde = (
-                    self._offload.serde
-                    if self._offload is not None
-                    else self._default_serde()
-                )
-                blob = serde.serialize(np.asarray(k), np.asarray(v))
+                if self.kv_quant:
+                    # quantized pool: ship the exact pool bytes + scales
+                    # (serde v3); the raw get_page path has no scales
+                    from production_stack_tpu.kvoffload.serde import Int8PageSerde
+
+                    ks, vs, sks, svs = self.runner.get_pages_quant([pid])
+                    blob = Int8PageSerde().serialize_quant(
+                        np.asarray(ks[0]), np.asarray(sks[0]),
+                        np.asarray(vs[0]), np.asarray(svs[0]),
+                    )
+                else:
+                    k, v = self.runner.get_page(pid)
+                    serde = (
+                        self._offload.serde
+                        if self._offload is not None
+                        else self._default_serde()
+                    )
+                    blob = serde.serialize(np.asarray(k), np.asarray(v))
             self._kv_sender.push(key, blob)
 
     def _default_serde(self):
         from production_stack_tpu.kvoffload.serde import get_serde
 
         return get_serde(self.cfg.kv_serde)
+
+    # -- KV fabric plumbing ---------------------------------------------------
+
+    def _fabric_gather(self, keys: "list[str]"):
+        """Gather resident pages for hex ``keys`` off the device pool.
+        Returns (found_keys, ks, vs, sks, svs) with host arrays; sks/svs are
+        None on fp engines. MUST run on the device thread (replicated
+        runner-dispatch discipline)."""
+        found, pids = [], []
+        for key in keys:
+            try:
+                pid = self.kv.hash_to_page.get(bytes.fromhex(key))
+            except ValueError:
+                pid = None
+            if pid is not None:
+                found.append(key)
+                pids.append(pid)
+        if not pids:
+            return [], [], [], None, None
+        if self.kv_quant:
+            ks, vs, sks, svs = self.runner.get_pages_quant(pids)
+            sks = [np.asarray(s) for s in sks]
+            svs = [np.asarray(s) for s in svs]
+        else:
+            ks, vs = self.runner.get_pages(pids)
+            sks = svs = None
+        return (
+            found,
+            [np.asarray(k) for k in ks],
+            [np.asarray(v) for v in vs],
+            sks,
+            svs,
+        )
+
+    def _fabric_pages(self, keys: "list[str]"):
+        """Fabric listener pull handler: resident pages for ``keys`` as one
+        encoded wire frame. Called on the listener's worker thread; the pool
+        gather is marshalled onto the device thread."""
+        from production_stack_tpu.kvfabric import wire as fabric_wire
+
+        found, ks, vs, sks, svs = self._run_on_device_thread(
+            lambda: self._fabric_gather(keys)
+        )
+        if not found:
+            return [], b""
+        frame = fabric_wire.encode_frame(
+            found, ks, vs, sks, svs, nlayers=int(ks[0].shape[0])
+        )
+        return found, frame
+
+    def _fabric_sink(self, frame: dict) -> int:
+        """Fabric push handler: assemble layer windows into whole pages and
+        land them as LOCAL tier blobs, where the ordinary admission/restore
+        path (and migration's prefetch walk) finds them — zero shared-tier
+        I/O. Quant frames keep their scales verbatim (serde v3 blob); the
+        serde cross-dtype contract covers fp<->int8 engine pairs at restore
+        time."""
+        if self._offload is None:
+            return 0
+        from production_stack_tpu.kvoffload.serde import Int8PageSerde
+
+        stored = 0
+        for key, (k, v, sk, sv) in self._fabric_asm.add(frame):
+            if sk is not None:
+                blob = Int8PageSerde().serialize_quant(k, sk, v, sv)
+            else:
+                blob = self._offload.serde.serialize(k, v)
+            self._offload.store.put_local(key, blob)
+            stored += 1
+        return stored
+
+    def _resolve_fabric_peer(self) -> Optional[str]:
+        """Fabric listener address of the disagg decode peer.
+        ``--kv-fabric-peer`` is either the address itself ("host:port") or
+        the peer's HTTP URL — then GET /kv_fabric resolves the advertised
+        listener (the peer may bind an ephemeral port). Cached; cleared
+        after a fabric failure so the next push re-resolves."""
+        if self._fabric_peer_addr is not None:
+            return self._fabric_peer_addr
+        target = self.cfg.kv_fabric_peer
+        if not target:
+            return None
+        addr = target
+        if target.startswith("http"):
+            try:
+                import json as json_mod
+                import urllib.request
+
+                with urllib.request.urlopen(
+                    target.rstrip("/") + "/kv_fabric", timeout=5
+                ) as r:
+                    info = json_mod.loads(r.read())
+                addr = info.get("addr") if info.get("enabled", True) else None
+            except Exception as e:  # noqa: BLE001 - fabric is optional
+                logger.warning("fabric peer resolve failed for %s: %s", target, e)
+                addr = None
+        self._fabric_peer_addr = addr
+        return addr
+
+    def _fabric_stream_push(self, hashes: list) -> list:
+        """Streamed disagg prefill: ship a finished prefill's page chain to
+        the decode peer as layer-windowed (pages, scales) frames
+        (``--kv-fabric-stream-layers`` layers per frame), so the consumer
+        starts landing pages before the last layer arrives — this replaces
+        the shared-tier re-acquire of phase 1. Returns the hashes NOT
+        covered (no peer, gather/push failure): the caller's TCP-blob path
+        is the per-page fallback, counted on kv_fabric_fallbacks_total."""
+        addr = self._resolve_fabric_peer()
+        if addr is None:
+            return hashes
+        from production_stack_tpu.kvfabric import wire as fabric_wire
+
+        try:
+            found, ks, vs, sks, svs = self._fabric_gather(
+                [h.hex() for h in hashes]
+            )
+        except Exception as e:  # noqa: BLE001 - fall back to TCP blobs
+            logger.warning("fabric page gather failed: %s", e)
+            self._fabric_client.count_fallback(len(hashes))
+            return hashes
+        if not found:
+            return []
+        nlayers = int(ks[0].shape[0])
+        win = self.cfg.kv_fabric_stream_layers or nlayers
+        ok = True
+        for lo in range(0, nlayers, win):
+            hi = min(lo + win, nlayers)
+            frame = fabric_wire.encode_frame(
+                found,
+                [k[lo:hi] for k in ks],
+                [v[lo:hi] for v in vs],
+                [s[lo:hi] for s in sks] if sks is not None else None,
+                [s[lo:hi] for s in svs] if svs is not None else None,
+                layers=(lo, hi),
+                nlayers=nlayers,
+            )
+            if not self._fabric_client.push(addr, frame):
+                ok = False
+                break
+        if ok:
+            return []
+        # mid-stream failure: drop the cached peer (it may have restarted
+        # on a new port) and let the TCP path re-ship the whole chain; the
+        # consumer's assembler bounds any partial windows we left behind
+        self._fabric_peer_addr = None
+        self._fabric_client.count_fallback(len(found))
+        return hashes
+
+    def fabric_ship_pairs(
+        self, addr: str, pairs: "list[tuple[int, str]]"
+    ) -> "list[str]":
+        """Ship explicit ``(pid, key_hex)`` pages to ``addr`` over the
+        fabric — migration's freeze->ship path, where a frozen sequence's
+        pages are not yet registered in hash_to_page (registration happens
+        at finish). Returns the keys actually shipped. Safe from any
+        thread: the gather marshals onto the device thread, and
+        _run_on_device_thread is re-entrant for callers already on it (the
+        freeze path)."""
+        if self._fabric_client is None or not pairs:
+            return []
+        from production_stack_tpu.kvfabric import wire as fabric_wire
+
+        def gather():
+            pids = [p for p, _ in pairs]
+            if self.kv_quant:
+                ks, vs, sks, svs = self.runner.get_pages_quant(pids)
+                sks = [np.asarray(s) for s in sks]
+                svs = [np.asarray(s) for s in svs]
+            else:
+                ks, vs = self.runner.get_pages(pids)
+                sks = svs = None
+            return (
+                [np.asarray(k) for k in ks],
+                [np.asarray(v) for v in vs],
+                sks,
+                svs,
+            )
+
+        try:
+            ks, vs, sks, svs = self._run_on_device_thread(gather)
+        except Exception as e:  # noqa: BLE001 - tier save is the fallback
+            logger.warning("fabric migration gather failed: %s", e)
+            self._fabric_client.count_fallback(len(pairs))
+            return []
+        keys = [k for _, k in pairs]
+        frame = fabric_wire.encode_frame(
+            keys, ks, vs, sks, svs, nlayers=int(ks[0].shape[0])
+        )
+        if self._fabric_client.push(addr, frame):
+            return keys
+        self._fabric_client.count_fallback(len(pairs))
+        return []
 
     def _process_token(
         self, seq: Sequence, new_tokens: list[int], logprobs: Optional[list] = None
@@ -2096,6 +2363,28 @@ class LLMEngine:
             out["kv_directory_pulled_pages_total"] = (
                 q["kv_directory_pulled_pages_total"]
             )
+        if self._fabric_server is not None or self._fabric_client is not None:
+            # KV fabric surface (docs/kv-fabric.md): push/pull volume, the
+            # tier fallbacks every fabric path is allowed to take, corrupt
+            # frames quarantined on either side, generation-fenced stale
+            # pulls, and the live op depth peers fold into transfer-cost
+            # scores (peers.transfer_cost_score)
+            srv = self._fabric_server.stats() if self._fabric_server else {}
+            cli = self._fabric_client.stats() if self._fabric_client else {}
+            out["kv_fabric_pushed_pages_total"] = cli.get("pushed_pages", 0)
+            out["kv_fabric_pulled_pages_total"] = cli.get("pulled_pages", 0)
+            out["kv_fabric_served_pages_total"] = srv.get("served_pages", 0)
+            out["kv_fabric_received_pages_total"] = srv.get("received_pages", 0)
+            out["kv_fabric_fallbacks_total"] = cli.get("fallbacks", 0)
+            out["kv_fabric_corrupt_frames_total"] = (
+                cli.get("corrupt_frames", 0) + srv.get("corrupt_frames", 0)
+            )
+            out["kv_fabric_stale_generation_pulls_total"] = srv.get(
+                "stale_generation_pulls", 0
+            )
+            out["kv_fabric_breaker_opens_total"] = cli.get("breaker_opens", 0)
+            out["kv_fabric_peer_probes_total"] = cli.get("probes", 0)
+            out["kv_fabric_queue_depth"] = srv.get("queue_depth", 0)
         if self.warm is not None:
             out.update(self.warm.stats())
         return out

@@ -767,10 +767,35 @@ class ModelRunner:
 
     def set_pages_quant(self, pids: "list[int]", ks, vs, sks, svs) -> None:
         """Write N quantized pages + scales in ONE upload + scatter (the
-        restore twin of :meth:`get_pages_quant`)."""
+        restore twin of :meth:`get_pages_quant`).
+
+        Validates the scales before touching the pools: transferred pages
+        (disagg fabric frames, migration ships) arrive from another engine,
+        and an int8 page scattered with missing or misshaped scales would
+        dequantize to garbage silently — reject loudly instead so the
+        transfer path takes its tier/recompute fallback."""
         n = len(pids)
         if n == 0:
             return
+        ks, vs, sks, svs = list(ks), list(vs), list(sks), list(svs)
+        if not (len(ks) == len(vs) == len(sks) == len(svs) == n):
+            raise ValueError(
+                f"set_pages_quant: {n} pids but "
+                f"{len(ks)}/{len(vs)}/{len(sks)}/{len(svs)} pages/scales"
+            )
+        scale_shape = (self.k_scales.shape[0], self.k_scales.shape[2])
+        for sk_i, sv_i in zip(sks, svs):
+            for s in (sk_i, sv_i):
+                a = np.asarray(s)
+                if a.shape != scale_shape or not np.issubdtype(
+                    a.dtype, np.floating
+                ):
+                    raise ValueError(
+                        f"set_pages_quant: scale {a.shape}/{a.dtype} does "
+                        f"not match pool scales {scale_shape}/float32 — a "
+                        "quantized page arrived without usable per-kv-head "
+                        "scales"
+                    )
         bucket = 1
         while bucket < n:
             bucket <<= 1

@@ -316,9 +316,33 @@ class DirectoryPuller:
         self.lookups = 0
         self.lookup_hits = 0
         self.pulled_pages = 0
+        self.fabric_pulled_pages = 0
         self.errors = 0
         self._client: Optional[DirectoryClient] = None
         self._skip_until = 0.0
+        # fabric resident-pull path (docs/kv-fabric.md): fetch RESIDENT-only
+        # pages straight from the owning engine — zero shared-tier I/O — with
+        # the tier walk as fallback. Armed by the engine via enable_fabric.
+        self._fabric = None
+        self._serde = None
+        self.self_url: Optional[str] = None
+        self._fabric_addrs: "dict[str, tuple[Optional[str], float]]" = {}
+
+    FABRIC_ADDR_TTL_S = 60.0
+
+    def enable_fabric(self, fabric_client, self_url: str, serde=None) -> None:
+        """Arm the fabric pull path: ``fabric_client`` is the engine's
+        KVFabricClient (its counters and breaker are shared with the other
+        movers); ``self_url`` keeps this engine from "pulling" from itself;
+        ``serde`` converts pulled frames into this engine's tier blobs
+        (defaults to the engine serde the store was built with)."""
+        self._fabric = fabric_client
+        self.self_url = self_url
+        if serde is None:
+            from production_stack_tpu.kvoffload.serde import get_serde
+
+            serde = get_serde("naive")
+        self._serde = serde
 
     async def maybe_prefetch(self, tokens: Sequence[int], salt: bytes = b"") -> int:
         from production_stack_tpu.engine.kv_manager import prefix_hashes
@@ -356,6 +380,31 @@ class DirectoryPuller:
             if not f or n >= self.max_pages:
                 break
             n += 1
+        if self._fabric is not None:
+            # fabric resident pull: fetch straight from the engine that
+            # holds the deepest contiguous RESIDENT prefix (a resident hit
+            # used to be routing-only — these pages may not exist in the
+            # shared tier at all). Generation-fenced: the pull carries the
+            # claim's generation and a reborn owner rejects it. Any miss
+            # falls through to the shared-tier walk below.
+            resident = res.get("resident") or {}
+            gens = res.get("generations") or {}
+            owner, depth = None, 0
+            for url, d in resident.items():
+                if url != self.self_url and int(d) > depth:
+                    owner, depth = url, int(d)
+            depth = min(depth, self.max_pages, len(missing))
+            if owner is not None and depth > 0:
+                keys = [h.hex() for h in missing[:depth]]
+                loop = asyncio.get_running_loop()
+                got = await loop.run_in_executor(
+                    None, self._fabric_fetch, owner, gens.get(owner), keys
+                )
+                if got:
+                    self.lookup_hits += 1
+                    self.fabric_pulled_pages += got
+                    self.pulled_pages += got
+                    return got
         if n == 0:
             return 0
         self.lookup_hits += 1
@@ -364,6 +413,62 @@ class DirectoryPuller:
         got = await loop.run_in_executor(None, self._fetch, keys)
         self.pulled_pages += got
         return got
+
+    def _owner_fabric_addr(self, owner_url: str) -> Optional[str]:
+        """Resolve (and cache) an owner's fabric listener via its
+        GET /kv_fabric. Negative results are cached too — an owner without
+        the fabric enabled must not cost an HTTP round trip per admission."""
+        addr, until = self._fabric_addrs.get(owner_url, (None, 0.0))
+        if until > time.monotonic():
+            return addr
+        resolved = None
+        try:
+            import json as json_mod
+            import urllib.request
+
+            with urllib.request.urlopen(
+                owner_url.rstrip("/") + "/kv_fabric", timeout=self.timeout
+            ) as r:
+                info = json_mod.loads(r.read())
+            if info.get("enabled", True):
+                resolved = info.get("addr")
+        except Exception as e:  # noqa: BLE001 - fabric optional per owner
+            logger.debug("fabric addr resolve failed for %s: %s", owner_url, e)
+        self._fabric_addrs[owner_url] = (
+            resolved, time.monotonic() + self.FABRIC_ADDR_TTL_S
+        )
+        return resolved
+
+    def _fabric_fetch(
+        self, owner_url: str, generation: Optional[int], keys: "list[str]"
+    ) -> int:
+        """Pull resident pages from the owning engine over the fabric
+        (executor thread) and land them as LOCAL tier blobs. Returns pages
+        landed; 0 sends the caller to the shared-tier fallback (counted as a
+        fabric fallback)."""
+        addr = self._owner_fabric_addr(owner_url)
+        if addr is None:
+            return 0
+        frame = self._fabric.pull(
+            addr, keys,
+            expect_generation=int(generation) if generation is not None else None,
+        )
+        if frame is None:
+            # miss/stale/outage: drop the cached addr (the owner may have
+            # restarted on a new port) and count the tier fallback
+            self._fabric_addrs.pop(owner_url, None)
+            self._fabric.count_fallback(len(keys))
+            return 0
+        from production_stack_tpu.kvfabric.wire import frame_to_blobs
+
+        n = 0
+        try:
+            for key, blob in frame_to_blobs(frame, self._serde):
+                self.store.put_local(key, blob)
+                n += 1
+        except Exception:  # noqa: BLE001 - partial landing is still progress
+            logger.exception("fabric pull landing failed after %d pages", n)
+        return n
 
     def _fetch(self, keys: list[str]) -> int:
         """Pull blobs into the local tiers (executor thread). ``store.get``

@@ -277,11 +277,16 @@ class KVDirectory:
 
     def lookup_hashes(self, hashes: list[str]) -> dict:
         """Engine-side pull lookup: per-hash shared-tier availability plus
-        contiguous per-engine resident depths (both from chain position 0)."""
+        contiguous per-engine resident depths (both from chain position 0).
+        ``generations`` carries each resident owner's claim generation so a
+        fabric pull can be FENCED: the owner rejects a pull tagged with a
+        generation older than its own (a reborn owner must not serve pages
+        the claim's issuer never wrote)."""
         self.lookups_total += 1
         self.expire_dead_engines()
         shared_flags = [self._shared_available(h) for h in hashes]
         resident: dict[str, int] = {}
+        generations: dict[str, int] = {}
         for url, rec in self.engines.items():
             if not self._alive(rec):
                 continue
@@ -293,7 +298,12 @@ class KVDirectory:
                 n += 1
             if n:
                 resident[url] = n
-        return {"shared": shared_flags, "resident": resident}
+                generations[url] = rec.generation
+        return {
+            "shared": shared_flags,
+            "resident": resident,
+            "generations": generations,
+        }
 
     def lookup_tokens(self, tokens: list[int], salt_hex: str = "") -> dict:
         """Router-side lookup: recompute the chunk-hash chain per registered

@@ -1,0 +1,37 @@
+"""Peer-to-peer KV fabric: one engine-to-engine transfer plane shared by
+disaggregated prefill (streamed layer-by-layer push), directory resident-page
+pulls, and live migration. See docs/kv-fabric.md."""
+
+from production_stack_tpu.kvfabric.client import KVFabricClient
+from production_stack_tpu.kvfabric.peers import (
+    PeerLink,
+    PeerProbeCache,
+    pick_best_peer,
+    transfer_cost_score,
+)
+from production_stack_tpu.kvfabric.server import KVFabricServer
+from production_stack_tpu.kvfabric.wire import (
+    FABRIC_WIRE_VERSION,
+    FabricWireError,
+    FrameAssembler,
+    decode_frame,
+    encode_frame,
+    frame_to_blobs,
+    verify_frame,
+)
+
+__all__ = [
+    "FABRIC_WIRE_VERSION",
+    "FabricWireError",
+    "FrameAssembler",
+    "KVFabricClient",
+    "KVFabricServer",
+    "PeerLink",
+    "PeerProbeCache",
+    "decode_frame",
+    "encode_frame",
+    "frame_to_blobs",
+    "pick_best_peer",
+    "transfer_cost_score",
+    "verify_frame",
+]

@@ -62,8 +62,20 @@ class BackendView:
     saturated: bool = False
     waiting: int = 0
     running: int = 0
+    # probed KV-fabric bandwidth summed over the engine's peer links
+    # (vllm:kv_fabric_peer_bandwidth_bytes_per_sec; 0 = fabric off). A
+    # migration target with a live fabric link receives the page chain
+    # device-to-device instead of through the shared tier, so equal-pressure
+    # target picks prefer the higher-bandwidth backend (docs/kv-fabric.md)
+    fabric_bandwidth: float = 0.0
     # [{"request_id": ..., "output_tokens": ...}, ...] — migratable streams
     migratable: list = field(default_factory=list)
+
+    def rank_key(self, queue_ref: int) -> tuple:
+        """Target-selection sort key: pressure first, probed fabric
+        bandwidth as the tiebreak (higher bandwidth sorts earlier among
+        equal-pressure backends — cheaper to ship a page chain to)."""
+        return (self.pressure(queue_ref), -self.fabric_bandwidth)
 
     def pressure(self, queue_ref: int) -> float:
         """[0, 1] pressure score, mirroring the router's fleet-saturation
@@ -146,7 +158,7 @@ class FleetDecider:
             self._engaged = False
             return actions
         scored = sorted(
-            healthy, key=lambda v: v.pressure(p.saturation_queue_ref)
+            healthy, key=lambda v: v.rank_key(p.saturation_queue_ref)
         )
         cold, hot = scored[0], scored[-1]
         delta = hot.pressure(p.saturation_queue_ref) - cold.pressure(
@@ -188,7 +200,7 @@ class FleetDecider:
         victim = next((v for v in views if v.url == victim_url), None)
         survivors = sorted(
             (v for v in views if v.url != victim_url and v.healthy),
-            key=lambda v: v.pressure(self.policy.saturation_queue_ref),
+            key=lambda v: v.rank_key(self.policy.saturation_queue_ref),
         )
         if victim is None or not survivors or not victim.migratable:
             return []
@@ -292,6 +304,9 @@ class FleetController:
             saturated=bool(vals.get("vllm:engine_saturated", 0)),
             waiting=int(vals.get("vllm:num_requests_waiting", 0)),
             running=int(vals.get("vllm:num_requests_running", 0)),
+            fabric_bandwidth=float(
+                vals.get("vllm:kv_fabric_peer_bandwidth_bytes_per_sec", 0.0)
+            ),
         )
         listing = await self._fetch_json(f"{url}/migratable")
         if listing:

@@ -56,6 +56,7 @@ class MigrationManager:
         self.migrations_out = 0
         self.migrations_in = 0
         self.pages_moved = 0
+        self.fabric_pages = 0
         self.failures = 0
         # freeze -> commit wall time on the source (the stream-stall window
         # a client could observe between the last source chunk and the
@@ -69,17 +70,24 @@ class MigrationManager:
 
     # -- source side ---------------------------------------------------------
 
-    def freeze_and_snapshot(self, seq_id: str, meta: dict) -> SequenceSnapshot:
+    def freeze_and_snapshot(
+        self, seq_id: str, meta: dict, fabric_addr=None
+    ) -> SequenceSnapshot:
         """Freeze a running sequence (it stops decoding but keeps its pages)
-        and build its snapshot: full-page KV saved through the offload tiers
-        (confirmed prefix only), token history, params, presentation meta.
-        Runs on the device thread; raises MigrationError when the sequence
-        is gone or semantically unmigratable."""
+        and build its snapshot: full-page KV shipped to the target over the
+        KV fabric when ``fabric_addr`` names its listener (device-to-device
+        handoff, zero shared-tier I/O), else saved through the offload tiers
+        (confirmed prefix only); plus token history, params, presentation
+        meta. Runs on the device thread; raises MigrationError when the
+        sequence is gone or semantically unmigratable."""
         return self.engine._run_on_device_thread(
-            lambda: self._freeze(seq_id, meta), what=f"migrate freeze {seq_id}"
+            lambda: self._freeze(seq_id, meta, fabric_addr),
+            what=f"migrate freeze {seq_id}",
         )
 
-    def _freeze(self, seq_id: str, meta: dict) -> SequenceSnapshot:
+    def _freeze(
+        self, seq_id: str, meta: dict, fabric_addr=None
+    ) -> SequenceSnapshot:
         engine = self.engine
         sched = engine.scheduler
         seq = next(
@@ -101,7 +109,23 @@ class MigrationManager:
         hashes = prefix_hashes(tokens, engine.kv.page_size, seq.cache_salt)[:n_full]
         confirmed = 0
         offload = engine._offload
-        if offload is not None and hashes:
+        if (
+            fabric_addr
+            and hashes
+            and getattr(engine, "_fabric_client", None) is not None
+        ):
+            # fabric handoff (docs/kv-fabric.md): the page chain moves
+            # engine-to-engine as (pages, scales) frames and lands straight
+            # in the TARGET's local tiers — the shared tier never sees the
+            # bytes. The tier save below remains the fallback when the
+            # fabric could not cover the chain (counted on
+            # kv_fabric_fallbacks_total by the client).
+            pairs = [(p, h.hex()) for p, h in zip(seq.pages, hashes)]
+            shipped = set(engine.fabric_ship_pairs(fabric_addr, pairs))
+            while confirmed < len(hashes) and hashes[confirmed].hex() in shipped:
+                confirmed += 1
+            self.fabric_pages += confirmed
+        if confirmed == 0 and offload is not None and hashes:
             pairs = list(zip(seq.pages, hashes))
             saved = offload.save_pages(pairs)
             # the restorable chain must be CONTIGUOUS from the head — the
@@ -238,5 +262,6 @@ class MigrationManager:
             "migrations_out_total": self.migrations_out,
             "migrations_in_total": self.migrations_in,
             "migration_pages_moved_total": self.pages_moved,
+            "migration_fabric_pages_total": self.fabric_pages,
             "migration_failures_total": self.failures,
         }

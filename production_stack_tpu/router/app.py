@@ -405,6 +405,8 @@ class RouterApp:
         lines.extend(render_resilience_metrics())
         # KV-aware v2 route-class mix (docs/kv-directory.md):
         # vllm_router:kvaware_v2_{resident,restorable,cold}_routes_total
+        # plus the disagg decode picks scored by fabric transfer cost
+        # (docs/kv-fabric.md): vllm_router:disagg_fabric_routes_total
         from production_stack_tpu.router.routing_logic import (
             render_kvaware_metrics,
         )

@@ -47,6 +47,13 @@ class EngineStats:
     # consumers (dashboards, the fleet controller) read the real number
     # instead of assuming the fp16 footprint (0 = not exported)
     kv_cache_dtype_bytes_per_token: float = 0.0
+    # KV fabric transfer economics (kvfabric/peers.py, docs/kv-fabric.md):
+    # probed engine-to-engine bandwidth summed over that engine's peer links
+    # (from_scrape sums label sets) and the fabric listener's in-flight op
+    # count — the disagg router and fleet controller combine them into a
+    # transfer-cost score bw/(1+depth) per NetKV (0 = fabric not enabled)
+    kv_fabric_peer_bandwidth_bytes_per_sec: float = 0.0
+    kv_fabric_queue_depth: float = 0.0
 
     _FIELDS = {
         "vllm:num_requests_running": "num_running_requests",
@@ -62,6 +69,10 @@ class EngineStats:
         ),
         "vllm:tensor_parallel_degree": "tensor_parallel",
         "vllm:kv_cache_dtype_bytes_per_token": "kv_cache_dtype_bytes_per_token",
+        "vllm:kv_fabric_peer_bandwidth_bytes_per_sec": (
+            "kv_fabric_peer_bandwidth_bytes_per_sec"
+        ),
+        "vllm:kv_fabric_queue_depth": "kv_fabric_queue_depth",
     }
 
     @staticmethod

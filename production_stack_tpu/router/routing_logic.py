@@ -424,6 +424,9 @@ class DisaggregatedPrefillRouter(RoutingInterface):
         self.prefill_labels = prefill_labels
         self.decode_labels = decode_labels
         self._rr = {"prefill": 0, "decode": 0}
+        # decode picks that were transfer-cost-aware (fabric bandwidth known
+        # for at least one candidate) — vllm_router:disagg_fabric_routes_total
+        self.fabric_routes = 0
 
     def _pick(self, endpoints: list[EndpointInfo], labels: list[str], kind: str) -> str:
         # breaker-aware even for direct route_prefill/route_decode callers —
@@ -433,10 +436,44 @@ class DisaggregatedPrefillRouter(RoutingInterface):
         # traffic onto decode-labeled pods
         role = [ep for ep in endpoints if ep.model_label in labels] or list(endpoints)
         role = self.breaker_filtered(role)
+        if kind == "decode" and len(role) > 1:
+            # transfer-cost-aware decode placement (docs/kv-fabric.md, NetKV):
+            # the prefiller streams the prompt's KV to whichever decoder we
+            # pick, so prefer the one with the best probed fabric bandwidth
+            # per unit of fabric queue depth — scraped off each engine's
+            # /metrics by the stats scraper. Engines without fabric (bw==0)
+            # yield no score and the pool stays round-robin.
+            url = self._fabric_pick(role)
+            if url is not None:
+                self.fabric_routes += 1
+                return url
         pool = sorted(ep.url for ep in role)
         url = pool[self._rr[kind] % len(pool)]
         self._rr[kind] += 1
         return url
+
+    @staticmethod
+    def _fabric_pick(role: list[EndpointInfo]) -> Optional[str]:
+        from production_stack_tpu.kvfabric.peers import pick_best_peer
+        from production_stack_tpu.router.engine_stats import (
+            get_engine_stats_scraper,
+        )
+
+        try:
+            stats = get_engine_stats_scraper().get_engine_stats()
+        except Exception:  # noqa: BLE001 - scraper not running: RR fallback
+            return None
+        candidates = []
+        for ep in role:
+            st = stats.get(ep.url)
+            if st is None:
+                continue
+            candidates.append((
+                ep.url,
+                st.kv_fabric_peer_bandwidth_bytes_per_sec,
+                st.kv_fabric_queue_depth,
+            ))
+        return pick_best_peer(candidates)
 
     async def route_request(self, endpoints, engine_stats, request_stats, request,
                             request_json=None) -> str:
@@ -471,6 +508,15 @@ def render_kvaware_metrics() -> list[str]:
     ):
         lines.append(f"# TYPE {name} counter")
         lines.append(f"{name} {counts.get(key, 0)}")
+    # disagg decode picks that used fabric transfer-cost scoring instead of
+    # round-robin (docs/kv-fabric.md; zero-valued outside disagg mode)
+    fabric_routes = (
+        _router.fabric_routes
+        if isinstance(_router, DisaggregatedPrefillRouter)
+        else 0
+    )
+    lines.append("# TYPE vllm_router:disagg_fabric_routes_total counter")
+    lines.append(f"vllm_router:disagg_fabric_routes_total {fabric_routes}")
     return lines
 
 

@@ -75,6 +75,24 @@ scripts/chaos_check.py):
                          (``dir_top_prefixes``) and count a warm prefix hit
                          for every later request whose prompt chain starts in
                          that set.
+- ``--fabric``           peer-to-peer KV fabric emulation (docs/kv-fabric.md)
+                         in the REAL wire shapes: an asyncio TCP listener
+                         speaking the four fabric ops (``fabric_hello`` /
+                         ``fabric_probe`` / ``fabric_pull`` / ``fabric_push``)
+                         with versioned CRC-framed ``kvfabric.wire`` frames
+                         of deterministic synthetic pages, advertised on
+                         ``GET /kv_fabric`` like the real engine. With
+                         ``--kv-directory-url`` each generation first looks
+                         its prompt chain up in the directory and PULLS
+                         missing pages from the resident owner's fabric
+                         (generation-fenced), so cross-engine resident pulls
+                         and their tier fallback are chaos-testable sans TPU.
+- ``--fabric-fail-rate P``  each fabric op replies with an error with
+                         probability P (peers count fallbacks)
+- ``--fabric-hang``      fabric ops stall forever (peer deadlines + breaker)
+- ``POST /fabric_down``  chaos hook: close the fabric listener mid-load
+                         (the fabric-outage scenario's victim switch) while
+                         the HTTP plane keeps serving
 
 Observability used by chaos assertions: ``fake:running_peak`` (bounded-queue
 proof), ``fake:served_total`` (generation requests accepted by THIS process —
@@ -150,6 +168,13 @@ STATE = {  # owned-by: event-loop
     # scale-up warm-up modelling (--warm-prefetch-on-boot)
     "prefetched": set(),    # dir_top_prefixes hashes pulled at boot
     "warm_prefix_hits": 0,  # requests whose prompt chain hit that set
+    # KV fabric emulation (--fabric; docs/kv-fabric.md, all event-loop-owned)
+    "fabric_pulled": 0,     # pages pulled from peer fakes over the fabric
+    "fabric_served": 0,     # pages this fake's listener served to peers
+    "fabric_received": 0,   # pages landed here via fabric_push
+    "fabric_fallbacks": 0,  # fabric fetches that failed over to the tier path
+    "fabric_resident": set(),  # key hexes "resident" on this fake
+    "fabric_down": False,   # POST /fabric_down chaos hook fired
 }
 
 
@@ -341,6 +366,199 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         t = asyncio.ensure_future(dirpub.publish_prompt(prompt))
         dir_tasks.add(t)
         t.add_done_callback(dir_tasks.discard)
+        if fabric_srv[0] is not None:
+            # the published chain is now "resident" on this fake — its
+            # fabric listener will serve these keys to pulling peers
+            from production_stack_tpu.engine.kv_manager import prefix_hashes
+            from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+            STATE["fabric_resident"].update(
+                h.hex()
+                for h in prefix_hashes(ByteTokenizer().encode(prompt), 16)
+            )
+
+    # -- KV fabric emulation (--fabric; real wire shapes, docs/kv-fabric.md) --
+    fabric_enabled = bool(faults.get("fabric", False))
+    fabric_fail_rate = float(faults.get("fabric_fail_rate", 0.0))
+    fabric_hang = bool(faults.get("fabric_hang", False))
+    # boot-epoch generation fences stale pulls, same scheme as the directory
+    # publisher (a reborn fake's listener rejects claims on the old epoch)
+    fabric_generation = int(time.time() * 1000)
+    fabric_srv: list = [None]   # asyncio.Server once started
+    fabric_port: list = [0]
+    # tiny but structurally real page geometry: frames carry actual
+    # [layers, page, kv_heads, head_dim] arrays through encode/decode_frame
+    FAB_NLAYERS, FAB_PAGE, FAB_KH, FAB_D = 2, 16, 1, 8
+
+    def _fabric_page(key: str):
+        """Deterministic synthetic (k, v) page from the key hex — identical
+        bytes on every fake, so cross-engine pull assertions can compare."""
+        import hashlib
+
+        import numpy as np
+
+        def arr(tag: str):
+            seed = hashlib.blake2b(
+                f"{tag}:{key}".encode(), digest_size=8
+            ).digest()
+            rng = np.random.default_rng(int.from_bytes(seed, "big"))
+            return rng.standard_normal(
+                (FAB_NLAYERS, FAB_PAGE, FAB_KH, FAB_D), dtype=np.float32
+            )
+
+        return arr("k"), arr("v")
+
+    async def _fabric_handle(reader, writer):
+        """One fabric peer connection: the same four-op dispatch as the real
+        KVFabricServer (kvfabric/server.py), frames via kvoffload.protocol."""
+        from production_stack_tpu.kvfabric.wire import (
+            FabricWireError,
+            decode_frame,
+            encode_frame,
+        )
+        from production_stack_tpu.kvoffload.protocol import (
+            read_frame,
+            write_frame,
+        )
+
+        try:
+            while True:
+                try:
+                    hdr, payload = await read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                if fabric_hang:
+                    # stalled fabric: peers must hit their deadline/breaker
+                    await asyncio.Event().wait()
+                if fabric_fail_rate and random.random() < fabric_fail_rate:
+                    await write_frame(writer, {
+                        "ok": False, "error": "injected fabric failure",
+                    })
+                    continue
+                op = hdr.get("op")
+                rhdr, rpayload = {"ok": False, "error": f"bad op {op!r}"}, b""
+                if op == "fabric_hello":
+                    rhdr = {
+                        "ok": True, "generation": fabric_generation,
+                        "quant": False, "page_size": FAB_PAGE,
+                        "nlayers": FAB_NLAYERS,
+                    }
+                elif op == "fabric_probe":
+                    rhdr, rpayload = {"ok": True, "echo": len(payload)}, payload
+                elif op == "fabric_pull":
+                    expect = hdr.get("expect_generation")
+                    if expect is not None and int(expect) != fabric_generation:
+                        rhdr = {"ok": False, "error": "stale_generation",
+                                "generation": fabric_generation}
+                    else:
+                        keys = [
+                            k for k in (hdr.get("keys") or [])
+                            if k in STATE["fabric_resident"]
+                        ]
+                        if keys:
+                            pages = [_fabric_page(k) for k in keys]
+                            rpayload = encode_frame(
+                                keys,
+                                [p[0] for p in pages],
+                                [p[1] for p in pages],
+                            )
+                            STATE["fabric_served"] += len(keys)
+                        rhdr = {"ok": True, "found": keys}
+                elif op == "fabric_push":
+                    try:
+                        frame = decode_frame(payload)
+                        for k in frame["keys"]:
+                            STATE["fabric_resident"].add(k)
+                        STATE["fabric_received"] += len(frame["keys"])
+                        rhdr = {"ok": True, "stored": len(frame["keys"])}
+                    except FabricWireError:
+                        rhdr = {"ok": False, "error": "integrity"}
+                await write_frame(writer, rhdr, rpayload)
+        except Exception:  # noqa: BLE001 - one bad peer must not kill the app
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _fabric_fetch(owner: str, gen, keys: list) -> int:
+        """Pull ``keys`` from ``owner``'s fabric listener (async, on the
+        fake's own loop — no BlockingClient off-thread here)."""
+        from production_stack_tpu.kvfabric.wire import decode_frame
+        from production_stack_tpu.kvoffload.protocol import (
+            read_frame,
+            write_frame,
+        )
+
+        sess = await _mig_client()
+        async with sess.get(f"{owner}/kv_fabric") as r:
+            if r.status != 200:
+                return 0
+            info = await r.json()
+        if not info.get("enabled"):
+            return 0
+        host, _, port = str(info["addr"]).rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            hdr = {"op": "fabric_pull", "keys": list(keys)}
+            if gen is not None:
+                hdr["expect_generation"] = int(gen)
+            await write_frame(writer, hdr)
+            rhdr, payload = await read_frame(reader)
+            if not rhdr.get("ok") or not rhdr.get("found"):
+                return 0
+            frame = decode_frame(payload)
+            for k in frame["keys"]:
+                STATE["fabric_resident"].add(k)
+            return len(frame["keys"])
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _fabric_pull_for_prompt(prompt: str) -> None:
+        """Cross-engine resident pull, the fake's twin of the engine's
+        DirectoryPuller fabric path: look the prompt chain up in the
+        directory and fetch missing pages from the owning peer's fabric
+        (generation-fenced). Any failure counts a tier fallback — the blobs
+        are in the shared cache server anyway."""
+        if dirpub is None or fabric_srv[0] is None:
+            return
+        from production_stack_tpu.engine.kv_manager import prefix_hashes
+        from production_stack_tpu.engine.tokenizer import ByteTokenizer
+
+        hashes = [
+            h.hex()
+            for h in prefix_hashes(ByteTokenizer().encode(prompt), FAB_PAGE)
+        ]
+        keys = [h for h in hashes if h not in STATE["fabric_resident"]]
+        if not keys:
+            return
+        try:
+            res = await dirpub._request(
+                {"op": "dir_lookup_hashes", "hashes": keys}
+            )
+        except Exception:  # noqa: BLE001 - directory outage: nothing to pull
+            return
+        resident = res.get("resident") or {}
+        gens = res.get("generations") or {}
+        owners = [(u, n) for u, n in resident.items() if u != self_url]
+        if not owners:
+            return
+        owner, depth = max(owners, key=lambda kv: kv[1])
+        want = keys[:depth]
+        try:
+            got = await asyncio.wait_for(
+                _fabric_fetch(owner, gens.get(owner), want), 5.0
+            )
+        except Exception:  # noqa: BLE001 - dead/hung peer fabric
+            got = 0
+        if got:
+            STATE["fabric_pulled"] += got
+        else:
+            STATE["fabric_fallbacks"] += len(want)
 
     # -- live migration (--migration; real wire shapes, docs/migration.md) --
     migration_enabled = bool(faults.get("migration", True))
@@ -709,6 +927,23 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             f'fake:warm_prefetch_chunks{{model_name="{model}"}} {len(STATE["prefetched"])}\n'
             f'fake:warm_prefix_hits_total{{model_name="{model}"}} {STATE["warm_prefix_hits"]}\n'
         )
+        if fabric_enabled:
+            # KV fabric surface, same vllm: names as the real engine so the
+            # router scraper, fleet controller, and chaos assertions read
+            # the fake identically (docs/kv-fabric.md)
+            fabric_up = fabric_srv[0] is not None and not STATE["fabric_down"]
+            text += (
+                f'vllm:kv_fabric_pushed_pages_total{{model_name="{model}"}} 0\n'
+                f'vllm:kv_fabric_pulled_pages_total{{model_name="{model}"}} {STATE["fabric_pulled"]}\n'
+                f'vllm:kv_fabric_served_pages_total{{model_name="{model}"}} {STATE["fabric_served"]}\n'
+                f'vllm:kv_fabric_received_pages_total{{model_name="{model}"}} {STATE["fabric_received"]}\n'
+                f'vllm:kv_fabric_fallbacks_total{{model_name="{model}"}} {STATE["fabric_fallbacks"]}\n'
+                f'vllm:kv_fabric_queue_depth{{model_name="{model}"}} 0\n'
+                # synthetic probed-bandwidth gauge: up = a fast deterministic
+                # link, down = 0 — drives the router's transfer-cost pick
+                f'vllm:kv_fabric_peer_bandwidth_bytes_per_sec{{model_name="{model}",peer="self"}} '
+                f"{1000000000 if fabric_up else 0}\n"
+            )
         if restore_pages:
             # warm-restart modelling (--restart-restore-pages): the same
             # surface a real --warm-start engine exports after restore
@@ -840,6 +1075,11 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             "prompt_tokens": 10, "max_tokens": max_tokens,
         }
         _prompt_warm_hit(prompt_text)
+        if fabric_srv[0] is not None and dirpub is not None:
+            # fabric-first KV acquisition before "prefill" (the real
+            # engine's DirectoryPuller fabric path): pull the prompt chain
+            # from the resident owner, count a fallback on any failure
+            await _fabric_pull_for_prompt(prompt_text)
 
         def _phase(name, start, dur, **attrs):
             collector.record(
@@ -1116,6 +1356,29 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             "usage": {"prompt_tokens": len(left) + len(right)},
         })
 
+    async def kv_fabric_info(request):
+        """Same advert contract as the real engine's GET /kv_fabric:
+        answers enabled:false when the fabric is off or downed."""
+        if fabric_srv[0] is None or STATE["fabric_down"]:
+            return web.json_response({"enabled": False})
+        return web.json_response({
+            "enabled": True,
+            "addr": f"127.0.0.1:{fabric_port[0]}",
+            "generation": fabric_generation,
+            "quant": False,
+            "page_size": FAB_PAGE,
+        })
+
+    async def fabric_down(request):
+        """Chaos hook (fake-only): close the fabric listener mid-load while
+        the HTTP plane keeps serving — peers' pulls must fall back to the
+        tier path with zero client-visible errors."""
+        STATE["fabric_down"] = True
+        if fabric_srv[0] is not None:
+            fabric_srv[0].close()
+        print("fake-engine: fabric listener downed (/fabric_down)", flush=True)
+        return web.json_response({"fabric": "down"})
+
     async def version(request):
         return web.json_response({"version": "fake-engine"})
 
@@ -1151,6 +1414,24 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
 
         app.on_startup.append(_dir_register)
 
+    if fabric_enabled:
+        async def _fabric_start(app):
+            fabric_srv[0] = await asyncio.start_server(
+                _fabric_handle, "127.0.0.1", 0
+            )
+            fabric_port[0] = fabric_srv[0].sockets[0].getsockname()[1]
+            print(
+                f"fake-engine: kv fabric listening on "
+                f"127.0.0.1:{fabric_port[0]}", flush=True,
+            )
+
+        async def _fabric_stop(app):
+            if fabric_srv[0] is not None:
+                fabric_srv[0].close()
+
+        app.on_startup.append(_fabric_start)
+        app.on_cleanup.append(_fabric_stop)
+
     async def _close_mig_session(app):
         if mig_session[0] is not None and not mig_session[0].closed:
             await mig_session[0].close()
@@ -1165,6 +1446,8 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat)
     app.router.add_post("/abort", abort)
+    app.router.add_get("/kv_fabric", kv_fabric_info)
+    app.router.add_post("/fabric_down", fabric_down)
     app.router.add_get("/migratable", migratable)
     app.router.add_post("/migrate_out", migrate_out)
     app.router.add_post("/migrate_in", migrate_in)
@@ -1266,6 +1549,19 @@ def main():
                    help="pull this many top fleet-warm chunk hashes "
                         "(dir_top_prefixes) at startup and count warm "
                         "prefix hits against them; needs --kv-directory-url")
+    p.add_argument("--fabric", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="run the peer-to-peer KV fabric emulation "
+                        "(docs/kv-fabric.md): a real-wire-shape fabric "
+                        "listener, GET /kv_fabric advert, and directory-"
+                        "driven cross-engine pulls when --kv-directory-url "
+                        "is set")
+    p.add_argument("--fabric-fail-rate", type=float, default=0.0,
+                   help="probability each fabric op replies with an error "
+                        "(peers count fallbacks)")
+    p.add_argument("--fabric-hang", action="store_true",
+                   help="fabric ops stall forever (peer deadline/breaker "
+                        "testing)")
     p.add_argument("--tensor-parallel", type=int, default=1,
                    help="advertised serving-mesh tp degree "
                         "(vllm:tensor_parallel_degree on /metrics), so "
@@ -1291,6 +1587,9 @@ def main():
             "kv_directory_url": args.kv_directory_url,
             "migration": args.migration,
             "warm_prefetch_on_boot": args.warm_prefetch_on_boot,
+            "fabric": args.fabric,
+            "fabric_fail_rate": args.fabric_fail_rate,
+            "fabric_hang": args.fabric_hang,
             "tensor_parallel": args.tensor_parallel,
             "self_url": f"http://127.0.0.1:{args.port}",
         },

@@ -38,14 +38,23 @@ non-429 errors, resident-class routing actually happened, and the reborn
 engine re-registered under a higher generation (its stale claims expired)
 and republished.
 
+A sixth scenario, ``run_fabric_outage()`` (``--scenario fabric-outage``),
+exercises the peer-to-peer KV fabric (ISSUE 16, docs/kv-fabric.md): three
+fabric-enabled fakes cross-pull published chains from each other; one
+fabric listener is killed mid-load (``POST /fabric_down``) and the run
+asserts zero client non-429 errors, real cross-engine pulls, and counted
+tier fallbacks (``vllm:kv_fabric_fallbacks_total`` > 0).
+
 Importable as ``run_chaos()`` / ``run_overload()`` /
-``run_rolling_restart()`` / ``run_directory_restart()`` (tests/test_chaos.py
-wires them into tier-1) or runnable standalone:
+``run_rolling_restart()`` / ``run_directory_restart()`` /
+``run_fabric_outage()`` (tests/test_chaos.py wires them into tier-1) or
+runnable standalone:
 
     python scripts/chaos_check.py --num-requests 200
     python scripts/chaos_check.py --scenario overload
     python scripts/chaos_check.py --scenario rolling-restart
     python scripts/chaos_check.py --scenario directory-restart
+    python scripts/chaos_check.py --scenario fabric-outage
 """
 
 from __future__ import annotations
@@ -711,6 +720,173 @@ def run_directory_restart(
         stop_proc(cache)
 
 
+def run_fabric_outage(
+    engines: int = 3,
+    workers: int = 4,
+    prefixes: int = 4,
+    settle_s: float = 3.0,
+    outage_window: float = 12.0,
+    max_tokens: int = 4,
+) -> dict:
+    """KV fabric outage scenario (ISSUE 16, docs/kv-fabric.md).
+
+    Three fake engines with the peer-to-peer KV fabric enabled
+    (``--fabric --kv-directory-url``) behind a round-robin router: shared
+    session prefixes rotate across engines, so each engine's first request
+    for a prefix PULLS the published chain from the owning peer's fabric
+    listener (generation-fenced, real wire frames). Mid-load the victim's
+    fabric listener is killed via ``POST /fabric_down`` — its HTTP plane
+    keeps serving — while NEW prefixes keep entering the rotation. Asserted
+    by the caller:
+
+    - zero client non-429 errors for the whole run (a fabric outage is
+      invisible to clients — pulls degrade to the tier path),
+    - cross-engine fabric pulls actually happened
+      (``vllm:kv_fabric_pulled_pages_total`` > 0 fleet-wide),
+    - the outage produced counted tier fallbacks
+      (``vllm:kv_fabric_fallbacks_total`` > 0 fleet-wide).
+    """
+    import time
+
+    cache_port = free_port()
+    cache = start_proc([
+        "-m", "production_stack_tpu.kvoffload.cache_server",
+        "--port", str(cache_port), "--host", "127.0.0.1",
+        "--directory",
+    ])
+    dir_url = f"127.0.0.1:{cache_port}"
+    ports = [free_port() for _ in range(engines)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    fakes = [
+        start_proc([
+            "-m", "production_stack_tpu.testing.fake_engine",
+            "--port", str(p), "--model", "fake/model", "--speed", "300",
+            "--kv-directory-url", dir_url, "--fabric",
+        ])
+        for p in ports
+    ]
+    router = None
+    stop_load = threading.Event()
+    statuses: collections.Counter = collections.Counter()
+    errors: list = []
+    lock = threading.Lock()
+
+    def fab_counter(url: str, name: str) -> float:
+        try:
+            text = requests.get(f"{url}/metrics", timeout=10).text
+        except requests.RequestException:
+            return 0.0
+        m = re.search(
+            rf"^{re.escape(name)}\{{[^}}]*\}} ([0-9.]+)$", text, re.M
+        )
+        return float(m.group(1)) if m else 0.0
+
+    def fleet_counter(name: str) -> float:
+        return sum(fab_counter(u, name) for u in urls)
+
+    try:
+        router_port = free_port()
+        router = start_proc([
+            "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["fake/model"] * len(urls)),
+            # round-robin deliberately: every prefix visits every engine, so
+            # cross-engine fabric pulls are guaranteed (kvaware would
+            # concentrate each prefix on its owner and never pull)
+            "--routing-logic", "roundrobin",
+            "--engine-stats-interval", "1",
+            "--retry-max-attempts", "3",
+            "--retry-backoff-base", "0.01",
+        ])
+        base = f"http://127.0.0.1:{router_port}"
+        for proc, url in zip(fakes, urls):
+            wait_healthy(f"{url}/health", proc, timeout=30)
+        wait_healthy(f"{base}/health", router, timeout=30)
+        threading.Thread(
+            target=lambda: router.stdout.read() if router.stdout else None,
+            daemon=True,
+        ).start()
+
+        prompts = [
+            f"fabric-{i:02d}-" + (chr(ord("a") + i) * 150)
+            for i in range(prefixes)
+        ]
+
+        def load_worker(wid: int):
+            sess = requests.Session()
+            i = 0
+            while not stop_load.is_set():
+                i += 1
+                prompt = prompts[(wid + i) % len(prompts)] + f"::{wid}-{i}"
+                try:
+                    r = sess.post(
+                        f"{base}/v1/completions",
+                        json={"model": "fake/model", "prompt": prompt,
+                              "max_tokens": max_tokens},
+                        timeout=30,
+                    )
+                    with lock:
+                        statuses[r.status_code] += 1
+                        if r.status_code not in (200, 429):
+                            errors.append((r.status_code, r.text[:200]))
+                except requests.RequestException as e:
+                    with lock:
+                        errors.append(("exception", repr(e)))
+                time.sleep(0.03)
+
+        threads = [
+            threading.Thread(target=load_worker, args=(w,))
+            for w in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(settle_s)  # publishes + cross-engine pulls reach steady state
+        pre_pulled = fleet_counter("vllm:kv_fabric_pulled_pages_total")
+
+        # kill the victim's fabric listener mid-load; its HTTP plane (and
+        # its directory publishes) keep running — peers that try to pull
+        # its freshly-published chains must fall back to the tier path
+        victim = urls[0]
+        requests.post(f"{victim}/fabric_down", timeout=10)
+        t0 = time.time()
+        fallbacks = pulled = 0.0
+        k = 0
+        while time.time() - t0 < outage_window:
+            k += 1
+            # new prefixes keep entering the rotation: round-robin lands
+            # some on the victim FIRST, so its (fabric-dead) claims are the
+            # ones peers try to pull
+            prompts.append(f"post-outage-{k:02d}-" + ("z" * 150))
+            pulled = fleet_counter("vllm:kv_fabric_pulled_pages_total")
+            fallbacks = fleet_counter("vllm:kv_fabric_fallbacks_total")
+            if pulled > 0 and fallbacks > 0:
+                break
+            time.sleep(0.25)
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=30)
+        return {
+            "statuses": dict(statuses),
+            "non_429_errors": len(errors),
+            "errors": errors[:10],
+            "victim": victim,
+            "pre_outage_pulled_pages": pre_pulled,
+            "fabric_pulled_pages": pulled,
+            "fabric_fallbacks": fallbacks,
+            "fabric_served_pages": fleet_counter(
+                "vllm:kv_fabric_served_pages_total"
+            ),
+        }
+    finally:
+        stop_load.set()
+        for p_ in fakes:
+            stop_proc(p_)
+        if router is not None:
+            stop_proc(router)
+        stop_proc(cache)
+
+
 def run_scale_cycle(
     base_engines: int = 2,
     peak_engines: int = 4,
@@ -1111,7 +1287,8 @@ def main() -> int:
     p = argparse.ArgumentParser("chaos-check")
     p.add_argument("--scenario",
                    choices=["chaos", "overload", "rolling-restart",
-                            "directory-restart", "scale-cycle"],
+                            "directory-restart", "scale-cycle",
+                            "fabric-outage"],
                    default="chaos")
     p.add_argument("--num-requests", type=int, default=None)
     p.add_argument("--retry-budget", type=int, default=3)
@@ -1171,6 +1348,27 @@ def main() -> int:
             print("SCALE-CYCLE CHECK FAILED: " + "; ".join(failures))
             return 1
         print("SCALE-CYCLE CHECK PASSED")
+        return 0
+
+    if args.scenario == "fabric-outage":
+        s = run_fabric_outage()
+        print(json.dumps(s, indent=2))
+        failures = []
+        if s["non_429_errors"]:
+            failures.append(
+                f"{s['non_429_errors']} non-429 client errors/hangs: "
+                f"{s['errors']}"
+            )
+        if s["fabric_pulled_pages"] <= 0:
+            failures.append("no cross-engine fabric pull ever happened")
+        if s["fabric_fallbacks"] <= 0:
+            failures.append(
+                "the fabric outage produced no counted tier fallbacks"
+            )
+        if failures:
+            print("FABRIC-OUTAGE CHECK FAILED: " + "; ".join(failures))
+            return 1
+        print("FABRIC-OUTAGE CHECK PASSED")
         return 0
 
     if args.scenario == "directory-restart":

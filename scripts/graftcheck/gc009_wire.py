@@ -48,6 +48,7 @@ SERVER_FILES = (
     "production_stack_tpu/kvoffload/cache_server.py",
     "production_stack_tpu/kvoffload/transfer.py",
     "production_stack_tpu/kvoffload/controller.py",
+    "production_stack_tpu/kvfabric/server.py",
 )
 # SSE control-event surfaces
 EVENT_PRODUCER_FILES = (

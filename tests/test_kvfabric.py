@@ -1,0 +1,677 @@
+"""KV fabric: the engine-to-engine transfer plane (docs/kv-fabric.md).
+
+Covers the wire format ((pages, scales) frames, integrity quarantine,
+version fencing, tp invariance mirroring test_kv_quant.TestShardBoundary),
+the client/server loopback (breaker, generation fence, server + local
+quarantine), transfer-cost peer scoring, the DirectoryPuller fabric path
+(zero shared-tier I/O on hit, counted tier fallback on miss), and — slow —
+an int8 engine pair completing disagg prefill and a migration-style page
+handoff bit-identically, the paths PR 14 gated off."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")  # noqa: F841 - ops.quant needs jax
+
+from production_stack_tpu.kvfabric.wire import (  # noqa: E402
+    FABRIC_WIRE_VERSION,
+    FabricWireError,
+    FrameAssembler,
+    decode_frame,
+    encode_frame,
+    frame_to_blobs,
+    verify_frame,
+)
+from production_stack_tpu.ops import quant  # noqa: E402
+
+
+def _fp_pages(n=3, seed=0, L=2, ps=8, KH=4, D=16, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    keys = [bytes([i] * 32).hex() for i in range(1, n + 1)]
+    ks = [rng.randn(L, ps, KH, D).astype(dtype) for _ in range(n)]
+    vs = [rng.randn(L, ps, KH, D).astype(dtype) for _ in range(n)]
+    return keys, ks, vs
+
+
+def _quant_pages(n=3, seed=0, L=2, ps=8, KH=4, D=16):
+    keys, ks, vs = _fp_pages(n, seed, L, ps, KH, D)
+    qks, sks, qvs, svs = [], [], [], []
+    for k, v in zip(ks, vs):
+        qk, sk = quant.quantize_page_host(k)
+        qv, sv = quant.quantize_page_host(v)
+        qks.append(qk), sks.append(sk), qvs.append(qv), svs.append(sv)
+    return keys, qks, sks, qvs, svs
+
+
+class TestFabricWire:
+    def test_fp_roundtrip(self):
+        keys, ks, vs = _fp_pages()
+        frame = decode_frame(encode_frame(keys, ks, vs))
+        assert frame["keys"] == keys and not frame["quant"]
+        assert frame["layers"] == (0, 2) and frame["nlayers"] == 2
+        for (k2, v2, sk2, sv2), k, v in zip(frame["pages"], ks, vs):
+            assert np.array_equal(k2, k) and np.array_equal(v2, v)
+            assert sk2 is None and sv2 is None
+
+    def test_bf16_roundtrip(self):
+        ml_dtypes = pytest.importorskip("ml_dtypes")
+        keys, ks, vs = _fp_pages(dtype=ml_dtypes.bfloat16)
+        frame = decode_frame(encode_frame(keys, ks, vs))
+        for (k2, v2, _, _), k, v in zip(frame["pages"], ks, vs):
+            assert k2.dtype == k.dtype and np.array_equal(k2, k)
+            assert np.array_equal(v2, v)
+
+    def test_quant_roundtrip_carries_exact_scales(self):
+        keys, qks, sks, qvs, svs = _quant_pages()
+        frame = decode_frame(encode_frame(keys, qks, qvs, sks, svs))
+        assert frame["quant"]
+        for (k2, v2, sk2, sv2), qk, sk, qv, sv in zip(
+            frame["pages"], qks, sks, qvs, svs
+        ):
+            assert k2.dtype == np.int8 and np.array_equal(k2, qk)
+            assert np.array_equal(v2, qv)
+            assert np.array_equal(sk2, sk) and np.array_equal(sv2, sv)
+
+    def test_bit_flip_quarantined(self):
+        keys, ks, vs = _fp_pages()
+        blob = bytearray(encode_frame(keys, ks, vs))
+        blob[len(blob) // 2] ^= 0x40
+        with pytest.raises(FabricWireError):
+            verify_frame(bytes(blob))
+
+    def test_truncation_quarantined(self):
+        keys, ks, vs = _fp_pages()
+        blob = encode_frame(keys, ks, vs)
+        with pytest.raises(FabricWireError):
+            verify_frame(blob[:-9])
+        with pytest.raises(FabricWireError):
+            verify_frame(blob[:2])
+
+    def test_future_version_refused(self):
+        """A reader must refuse (never misparse) frames from a newer fleet."""
+        import json
+        import struct
+
+        blob = encode_frame(*_fp_pages())
+        (hlen,) = struct.unpack(">I", blob[:4])
+        hdr = json.loads(blob[4 : 4 + hlen])
+        hdr["fv"] = FABRIC_WIRE_VERSION + 1
+        enc = json.dumps(hdr).encode()
+        forged = struct.pack(">I", len(enc)) + enc + blob[4 + hlen :]
+        with pytest.raises(FabricWireError):
+            verify_frame(forged)
+
+    def test_layer_window_must_match_shape(self):
+        keys, ks, vs = _fp_pages(L=4)
+        with pytest.raises(ValueError):
+            encode_frame(keys, ks, vs, layers=(0, 2))
+
+    def test_quant_frames_need_scales_per_page(self):
+        keys, qks, sks, qvs, svs = _quant_pages()
+        with pytest.raises(ValueError):
+            encode_frame(keys, qks, qvs, sks[:-1], svs)
+
+
+class TestFabricTpInvariance:
+    """Frames carry whole logical pages over ALL kv heads — the tp split
+    happens at the runner boundary on either side, so the wire bytes are
+    identical for tp in {1, 2, 4} (mirror of TestShardBoundary)."""
+
+    @pytest.mark.parametrize("tp", [1, 2, 4])
+    def test_quant_frame_tp_invariant(self, tp):
+        from production_stack_tpu.kvoffload.serde import (
+            join_kv_heads_quant,
+            split_kv_heads_quant,
+        )
+
+        keys, qks, sks, qvs, svs = _quant_pages(n=1, KH=4)
+        frame = decode_frame(encode_frame(keys, qks, qvs, sks, svs))
+        k2, v2, sk2, sv2 = frame["pages"][0]
+        parts = split_kv_heads_quant(k2, sk2, v2, sv2, tp)
+        assert len(parts) == tp
+        for pk, psk, _, _ in parts:
+            assert pk.shape[2] == 4 // tp and psk.shape[1] == 4 // tp
+        k3, sk3, v3, sv3 = join_kv_heads_quant(parts)
+        assert np.array_equal(k3, qks[0]) and np.array_equal(sk3, sks[0])
+        assert np.array_equal(v3, qvs[0]) and np.array_equal(sv3, svs[0])
+
+    @pytest.mark.parametrize("tp", [1, 2, 4])
+    def test_fp_frame_tp_invariant(self, tp):
+        from production_stack_tpu.kvoffload.serde import (
+            join_kv_heads,
+            split_kv_heads,
+        )
+
+        keys, ks, vs = _fp_pages(n=1, KH=4)
+        frame = decode_frame(encode_frame(keys, ks, vs))
+        k2, v2, _, _ = frame["pages"][0]
+        parts = split_kv_heads(k2, v2, tp)
+        k3, v3 = join_kv_heads(parts)
+        assert np.array_equal(k3, ks[0]) and np.array_equal(v3, vs[0])
+
+    def test_shard_scales_align_after_wire(self):
+        from production_stack_tpu.kvoffload.serde import split_kv_heads_quant
+
+        keys, qks, sks, qvs, svs = _quant_pages(n=1, KH=4)
+        frame = decode_frame(encode_frame(keys, qks, qvs, sks, svs))
+        k2, _, sk2, _ = frame["pages"][0]
+        full = quant.dequantize_page_host(k2, sk2)
+        for i, (pk, psk, _, _) in enumerate(
+            split_kv_heads_quant(k2, sk2, k2, sk2, 2)
+        ):
+            np.testing.assert_allclose(
+                quant.dequantize_page_host(pk, psk),
+                full[:, :, i * 2 : (i + 1) * 2],
+            )
+
+
+class TestFrameToBlobs:
+    """Fabric-delivered pages land as ordinary tier blobs, so the serde's
+    cross-dtype contract covers fp<->int8 engine pairs at the connector
+    boundary exactly as it does for shared-tier blobs."""
+
+    def test_fp_frame_lands_as_v2_blobs(self):
+        from production_stack_tpu.kvoffload import serde as serde_mod
+
+        keys, ks, vs = _fp_pages()
+        frame = decode_frame(encode_frame(keys, ks, vs))
+        blobs = frame_to_blobs(frame, serde_mod.NaiveSerde())
+        assert [k for k, _ in blobs] == keys
+        for (_, blob), k, v in zip(blobs, ks, vs):
+            assert serde_mod.verify_blob(blob)["v"] == 2
+            k2, v2 = serde_mod.deserialize(blob)
+            assert np.array_equal(k2, k) and np.array_equal(v2, v)
+
+    def test_quant_frame_lands_as_v3_scales_verbatim(self):
+        from production_stack_tpu.kvoffload import serde as serde_mod
+
+        keys, qks, sks, qvs, svs = _quant_pages()
+        frame = decode_frame(encode_frame(keys, qks, qvs, sks, svs))
+        # receiver serde is fp ("naive") — quant frames must STILL land as
+        # v3 blobs with their scales verbatim, never a lossy re-encode
+        blobs = frame_to_blobs(frame, serde_mod.NaiveSerde())
+        for (_, blob), qk, sk, qv, sv in zip(blobs, qks, sks, qvs, svs):
+            assert serde_mod.verify_blob(blob)["v"] == 3
+            qk2, sk2, qv2, sv2 = serde_mod.get_serde(
+                "int8page"
+            ).deserialize_quant(blob)
+            assert np.array_equal(qk2, qk) and np.array_equal(sk2, sk)
+            assert np.array_equal(qv2, qv) and np.array_equal(sv2, sv)
+
+    def test_quant_blob_readable_by_fp_engine(self):
+        """int8 producer -> fp consumer: the landed v3 blob dequantizes
+        through the generic fp entry point (cross-dtype contract)."""
+        from production_stack_tpu.kvoffload import serde as serde_mod
+
+        keys, qks, sks, qvs, svs = _quant_pages(n=1)
+        frame = decode_frame(encode_frame(keys, qks, qvs, sks, svs))
+        (_, blob), = frame_to_blobs(frame, serde_mod.NaiveSerde())
+        k2, _v2 = serde_mod.deserialize(blob)
+        deq = quant.dequantize_page_host(qks[0], sks[0])
+        # v3 blobs restore in the reader's fp dtype (bf16 default): compare
+        # at that precision — the quantized bytes themselves are exact
+        np.testing.assert_allclose(
+            np.asarray(k2, np.float32), deq.astype(k2.dtype).astype(np.float32)
+        )
+
+    def test_layer_partial_frame_refused(self):
+        keys, ks, vs = _fp_pages(L=2)
+        frame = decode_frame(
+            encode_frame(keys, ks, vs, layers=(0, 2), nlayers=4)
+        )
+        with pytest.raises(ValueError):
+            frame_to_blobs(frame, None)
+
+
+class TestFrameAssembler:
+    def _windows(self, L=4, win=2, quant_pages=False):
+        if quant_pages:
+            keys, ks, sks, vs, svs = _quant_pages(n=2, L=L)
+        else:
+            keys, ks, vs = _fp_pages(n=2, L=L)
+            sks = svs = None
+        frames = []
+        for lo in range(0, L, win):
+            hi = lo + win
+            frames.append(decode_frame(encode_frame(
+                keys,
+                [k[lo:hi] for k in ks],
+                [v[lo:hi] for v in vs],
+                [s[lo:hi] for s in sks] if sks else None,
+                [s[lo:hi] for s in svs] if svs else None,
+                layers=(lo, hi), nlayers=L,
+            )))
+        return keys, ks, vs, sks, svs, frames
+
+    def test_whole_frame_passes_through(self):
+        keys, ks, vs = _fp_pages(n=1)
+        asm = FrameAssembler()
+        done = asm.add(decode_frame(encode_frame(keys, ks, vs)))
+        assert [k for k, _ in done] == keys and not asm._pending
+
+    def test_out_of_order_windows_reassemble(self):
+        keys, ks, vs, _, _, frames = self._windows(L=4, win=2)
+        asm = FrameAssembler()
+        assert asm.add(frames[1]) == []  # layers [2:4] first
+        done = dict(asm.add(frames[0]))
+        assert set(done) == set(keys) and not asm._pending
+        for key, k in zip(keys, ks):
+            got_k, got_v, sk, sv = done[key]
+            assert np.array_equal(got_k, k) and sk is None and sv is None
+
+    def test_quant_windows_rejoin_scales(self):
+        keys, qks, _, sks, svs, frames = self._windows(
+            L=4, win=2, quant_pages=True
+        )
+        asm = FrameAssembler()
+        asm.add(frames[0])
+        done = dict(asm.add(frames[1]))
+        for key, qk, sk in zip(keys, qks, sks):
+            got_k, _, got_sk, _ = done[key]
+            assert np.array_equal(got_k, qk) and np.array_equal(got_sk, sk)
+
+    def test_pending_bounded_oldest_dropped(self):
+        """A producer that dies mid-page must not grow receiver memory:
+        beyond max_pending staged keys the oldest partial is dropped
+        (counted) — the tier path covers it."""
+        asm = FrameAssembler(max_pending=2)
+        for i in range(3):
+            keys = [bytes([0x40 + i] * 32).hex()]
+            _, ks, vs = _fp_pages(n=1, L=4, seed=i)
+            asm.add(decode_frame(encode_frame(
+                keys, [k[0:2] for k in ks], [v[0:2] for v in vs],
+                layers=(0, 2), nlayers=4,
+            )))
+        assert len(asm._pending) == 2 and asm.dropped_partials == 1
+
+
+class TestFabricClientServer:
+    """Loopback against a real listener: the 4 fabric ops, generation
+    fencing, quarantine on both ends, and the per-peer breaker."""
+
+    @pytest.fixture()
+    def loop_pair(self):
+        from production_stack_tpu.kvfabric.client import KVFabricClient
+        from production_stack_tpu.kvfabric.server import KVFabricServer
+
+        keys, ks, vs = _fp_pages(n=4, seed=7)
+        resident = {
+            key: (k, v) for key, k, v in zip(keys, ks, vs)
+        }
+        sunk: "dict[str, tuple]" = {}
+
+        def pages_fn(want):
+            found = [k for k in want if k in resident]
+            if not found:
+                return [], b""
+            return found, encode_frame(
+                found,
+                [resident[k][0] for k in found],
+                [resident[k][1] for k in found],
+            )
+
+        def sink_fn(frame):
+            for key, page in zip(frame["keys"], frame["pages"]):
+                sunk[key] = page
+            return len(frame["keys"])
+
+        srv = KVFabricServer(
+            "127.0.0.1", 0, generation=42, quant=False, page_size=8,
+            nlayers=2, pages_fn=pages_fn, sink_fn=sink_fn,
+        )
+        srv.start()
+        cli = KVFabricClient(retries=0, timeout=5.0)
+        yield cli, srv, resident, sunk
+        cli.close()
+        srv.stop()
+
+    def test_hello_and_probe(self, loop_pair):
+        cli, srv, _, _ = loop_pair
+        info = cli.hello(srv.address)
+        assert info["generation"] == 42 and info["page_size"] == 8
+        assert info["quant"] is False and info["nlayers"] == 2
+        link = cli.probe(srv.address)
+        assert link.bandwidth > 0 and link.rtt >= 0
+        # cached: a second probe is free (no new measurement)
+        before = cli.probe_cache.probes
+        assert cli.probe(srv.address) is link
+        assert cli.probe_cache.probes == before
+
+    def test_pull_resident_pages(self, loop_pair):
+        cli, srv, resident, _ = loop_pair
+        keys = sorted(resident)[:2]
+        frame = cli.pull(srv.address, keys, expect_generation=42)
+        assert frame is not None and sorted(frame["keys"]) == keys
+        for key, (k2, v2, _, _) in zip(frame["keys"], frame["pages"]):
+            k, v = resident[key]
+            assert np.array_equal(k2, k) and np.array_equal(v2, v)
+        assert srv.served_pages == 2 and cli.pulled_pages == 2
+        assert cli.pull_hist._total == 1
+
+    def test_pull_miss_returns_none(self, loop_pair):
+        cli, srv, _, _ = loop_pair
+        assert cli.pull(srv.address, ["ff" * 32]) is None
+        assert cli.pulled_pages == 0
+
+    def test_generation_fence(self, loop_pair):
+        """A claim issued by a previous incarnation of the owner must not
+        restore from the reborn owner's (reused) pool."""
+        cli, srv, resident, _ = loop_pair
+        keys = sorted(resident)[:1]
+        assert cli.pull(srv.address, keys, expect_generation=41) is None
+        assert srv.stale_generation_pulls == 1 and srv.served_pages == 0
+
+    def test_push_lands_in_sink(self, loop_pair):
+        cli, srv, _, sunk = loop_pair
+        keys, ks, vs = _fp_pages(n=2, seed=9)
+        assert cli.push(srv.address, encode_frame(keys, ks, vs))
+        assert sorted(sunk) == sorted(keys)
+        assert srv.received_pages == 2 and cli.pushed_pages == 2
+        assert cli.push_hist._total == 1
+
+    def test_push_preflight_quarantines_locally(self, loop_pair):
+        """A frame corrupted before send is refused WITHOUT a network round
+        trip — the peer never sees it."""
+        cli, srv, _, sunk = loop_pair
+        blob = bytearray(encode_frame(*_fp_pages(n=1)))
+        blob[len(blob) // 2] ^= 0x40
+        assert cli.push(srv.address, bytes(blob)) is False
+        assert cli.corrupt_frames == 1
+        assert srv.received_pages == 0 and not sunk
+
+    def test_server_quarantines_corrupt_push(self, loop_pair):
+        """Bypass the client pre-flight (raw request): the listener must
+        CRC-check before the sink ever sees the frame."""
+        cli, srv, _, sunk = loop_pair
+        blob = bytearray(encode_frame(*_fp_pages(n=1)))
+        blob[len(blob) // 2] ^= 0x40
+        hdr, _ = cli._request(
+            srv.address, {"op": "fabric_push"}, bytes(blob)
+        )
+        assert not hdr["ok"] and hdr["error"] == "integrity"
+        assert srv.corrupt_frames == 1 and not sunk
+
+    def test_breaker_opens_and_fails_fast(self):
+        from production_stack_tpu.kvfabric import client as fabric_client
+        from production_stack_tpu.kvfabric.client import KVFabricClient
+
+        cli = KVFabricClient(retries=0, timeout=0.5)
+        dead = "127.0.0.1:1"
+        for _ in range(fabric_client.BREAKER_THRESHOLD):
+            assert cli.hello(dead) is None
+        assert cli.breaker_open(dead) and cli.breaker_opens == 1
+        t0 = time.perf_counter()
+        assert cli.pull(dead, ["aa" * 32]) is None
+        assert time.perf_counter() - t0 < 0.2, "open breaker must fail fast"
+        cli.close()
+
+
+class TestPeerScoring:
+    def test_transfer_cost_score(self):
+        from production_stack_tpu.kvfabric.peers import transfer_cost_score
+
+        assert transfer_cost_score(2e9, 0) > transfer_cost_score(1e9, 0)
+        assert transfer_cost_score(1e9, 0) > transfer_cost_score(1e9, 4)
+        assert transfer_cost_score(1e9, 0, rtt=0.5) < transfer_cost_score(
+            1e9, 0, rtt=0.001
+        )
+
+    def test_pick_best_peer(self):
+        from production_stack_tpu.kvfabric.peers import pick_best_peer
+
+        assert pick_best_peer([]) is None
+        # nothing probed yet -> keep the caller's round-robin default
+        assert pick_best_peer([("a", 0.0, 0), ("b", 0.0, 3)]) is None
+        assert pick_best_peer(
+            [("slow", 1e8, 0), ("fast", 1e9, 0), ("queued", 1e9, 8)]
+        ) == "fast"
+
+    def test_probe_peer_link_stub_echo(self):
+        from production_stack_tpu.kvfabric.peers import probe_peer_link
+
+        def echo(hdr, payload):
+            return {"ok": True, "echo": len(payload)}, payload
+
+        bw, rtt = probe_peer_link("stub:0", echo)
+        assert bw > 0 and rtt >= 0
+
+    def test_probe_cache_failure_scores_last_and_invalidate_reprobes(self):
+        from production_stack_tpu.kvfabric.peers import PeerProbeCache
+
+        calls = []
+
+        def probe(addr):
+            calls.append(addr)
+            if len(calls) == 1:
+                raise ConnectionError("down")
+            return 1e9, 0.001
+
+        cache = PeerProbeCache(probe, ttl_s=300.0)
+        link = cache.get("p:1")
+        assert link.bandwidth == 0.0 and cache.probe_failures == 1
+        # cached (even the failure) until invalidated
+        assert cache.get("p:1").bandwidth == 0.0 and len(calls) == 1
+        cache.invalidate("p:1")
+        assert cache.get("p:1").bandwidth == 1e9 and len(calls) == 2
+
+
+class _StubStore:
+    """Local tier stub that records fabric landings and flags any
+    shared-tier walk (the zero-shared-tier-I/O oracle)."""
+
+    def __init__(self):
+        self.local: "dict[str, bytes]" = {}
+        self.gets = 0
+
+    def put_local(self, key, blob):
+        self.local[key] = blob
+
+    def contains_local(self, key):
+        return key in self.local
+
+    def get(self, key):
+        self.gets += 1
+        return b"tier-blob"
+
+
+class _StubDirClient:
+    def __init__(self, res):
+        self.res = res
+
+    async def lookup_hashes(self, keys):
+        return self.res
+
+
+class _StubFabric:
+    def __init__(self, frame):
+        self.frame = frame
+        self.fallbacks = 0
+        self.pulls = []
+
+    def pull(self, addr, keys, expect_generation=None):
+        self.pulls.append((addr, list(keys), expect_generation))
+        return self.frame
+
+    def count_fallback(self, n=1):
+        self.fallbacks += n
+
+
+class TestDirectoryPullerFabric:
+    def _puller(self, frame, resident, generations, shared=None):
+        from production_stack_tpu.kvdirectory.client import DirectoryPuller
+        from production_stack_tpu.kvoffload.serde import get_serde
+
+        class _KV:
+            hash_to_page = {}
+
+        store = _StubStore()
+        puller = DirectoryPuller("http://dir:9", _KV(), store, page_size=4)
+        fab = _StubFabric(frame)
+        puller.enable_fabric(fab, "http://self:8000", serde=get_serde("naive"))
+        puller._owner_fabric_addr = lambda url: "10.0.0.2:7000"
+        n_keys = 8 // 4  # 8 tokens / page_size 4
+        puller._client = _StubDirClient({
+            "shared": shared if shared is not None else [True] * n_keys,
+            "resident": resident,
+            "generations": generations,
+        })
+        return puller, store, fab
+
+    def _keys(self, tokens):
+        from production_stack_tpu.engine.kv_manager import prefix_hashes
+
+        return [h.hex() for h in prefix_hashes(tokens, 4, b"")]
+
+    def _frame_for(self, keys):
+        _, ks, vs = _fp_pages(n=len(keys))
+        return decode_frame(encode_frame(keys, ks, vs))
+
+    def test_fabric_hit_zero_shared_tier_io(self):
+        tokens = list(range(8))
+        keys = self._keys(tokens)
+        puller, store, fab = self._puller(
+            self._frame_for(keys),
+            resident={"http://peer:8001": len(keys)},
+            generations={"http://peer:8001": 42},
+        )
+        got = asyncio.run(puller.maybe_prefetch(tokens))
+        assert got == len(keys)
+        assert sorted(store.local) == sorted(keys)
+        assert store.gets == 0, "fabric hit must not touch the shared tier"
+        assert puller.fabric_pulled_pages == len(keys)
+        assert fab.pulls[0][0] == "10.0.0.2:7000"
+        assert fab.pulls[0][2] == 42, "pull must carry the claim generation"
+
+    def test_fabric_miss_falls_back_to_tier(self):
+        tokens = list(range(8))
+        puller, store, fab = self._puller(
+            frame=None,  # outage / stale generation
+            resident={"http://peer:8001": 2},
+            generations={"http://peer:8001": 42},
+        )
+        got = asyncio.run(puller.maybe_prefetch(tokens))
+        assert fab.fallbacks == 2, "fabric miss must count a tier fallback"
+        assert got == 2 and store.gets > 0, "tier walk must cover the keys"
+        assert puller.fabric_pulled_pages == 0
+
+    def test_never_pulls_from_self(self):
+        tokens = list(range(8))
+        puller, store, fab = self._puller(
+            self._frame_for(self._keys(tokens)),
+            resident={"http://self:8000": 2},
+            generations={"http://self:8000": 42},
+        )
+        asyncio.run(puller.maybe_prefetch(tokens))
+        assert fab.pulls == [] and store.gets > 0
+
+
+@pytest.mark.slow
+class TestInt8FabricPair:
+    """The PR 14 gates said int8 + disagg/device-transfer must refuse to
+    start; the fabric lifts them because frames are (pages, scales) pairs.
+    Prove the previously-gated paths end-to-end: an int8 producer/consumer
+    pair completes disagg prefill over the fabric and a migration-style
+    explicit-page handoff lands bit-identical pool bytes + scales."""
+
+    def _base(self, **kw):
+        from production_stack_tpu.engine.config import EngineConfig
+
+        base = dict(
+            model="llama-debug", max_model_len=256, max_num_seqs=4,
+            num_pages=64, page_size=8, prefill_chunk=32,
+            kv_cache_dtype="int8", kv_fabric=True, kv_fabric_port=0,
+        )
+        base.update(kw)
+        return EngineConfig(**base)
+
+    def _run(self, engine, prompt, seq_id, n):
+        from production_stack_tpu.engine.scheduler import SamplingParams
+
+        async def go():
+            toks = []
+            async for out in engine.generate(
+                seq_id, prompt=prompt,
+                params=SamplingParams(
+                    max_tokens=n, temperature=0.0, ignore_eos=True
+                ),
+            ):
+                toks.extend(out.token_ids)
+            return toks
+
+        return asyncio.run(go())
+
+    @pytest.fixture(scope="class")
+    def pd(self):
+        from production_stack_tpu.engine.engine import LLMEngine
+
+        consumer = LLMEngine(self._base(
+            kv_role="consumer", kv_transfer_port=0, port=8341,
+        ))
+        consumer.start()
+        fabric_addr = consumer._fabric_server.address
+        producer = LLMEngine(self._base(
+            kv_role="producer", port=8340,
+            kv_peer_url=f"127.0.0.1:{consumer._kv_receiver.bound_port}",
+            kv_fabric_peer=fabric_addr,
+        ))
+        producer.start()
+        yield producer, consumer, fabric_addr
+        producer.stop()
+        consumer.stop()
+
+    def test_int8_disagg_prefill_over_fabric(self, pd):
+        from production_stack_tpu.engine.engine import LLMEngine
+
+        producer, consumer, _ = pd
+        prompt = "quantized kv pages crossing the fabric with scales " * 3
+
+        self._run(producer, prompt, "qpd-1", 1)
+        assert producer._fabric_client.pushed_pages > 0, \
+            "prefill chain must stream over the fabric"
+        assert consumer._fabric_server.received_pages > 0
+        assert producer._fabric_client.corrupt_frames == 0
+
+        toks = self._run(consumer, prompt, "qpd-2", 8)
+        assert consumer.kv.offload_hits > 0, "decode must restore shipped KV"
+
+        mono = LLMEngine(self._base(port=8342))
+        mono.start()
+        try:
+            expected = self._run(mono, prompt, "qpd-mono", 8)
+        finally:
+            mono.stop()
+        assert toks == expected, \
+            "int8 decode from fabric-shipped KV must match monolithic"
+
+    def test_int8_migration_handoff_bit_identical(self, pd):
+        """Migration's freeze->ship path: explicit (pid, key) pages cross
+        the fabric and land with EXACTLY the source's quantized bytes and
+        scales (no dequant/requant round trip)."""
+        from production_stack_tpu.kvoffload.serde import get_serde
+
+        producer, consumer, fabric_addr = pd
+        prompt = "pages to hand off during a live migration " * 3
+        self._run(producer, prompt, "qmig-1", 1)
+
+        items = list(producer.kv.hash_to_page.items())[:3]
+        assert items, "producer must hold resident hashed pages"
+        pairs = [(pid, h.hex()) for h, pid in items]
+        shipped = producer.fabric_ship_pairs(fabric_addr, pairs)
+        assert sorted(shipped) == sorted(k for _, k in pairs)
+
+        pids = [p for p, _ in pairs]
+        qks, qvs, sks, svs = producer._run_on_device_thread(
+            lambda: producer.runner.get_pages_quant(pids)
+        )
+        serde = get_serde("int8page")
+        for i, (_, key) in enumerate(pairs):
+            blob = consumer._offload.store.get(key)
+            assert blob is not None, "handoff page must land as a local blob"
+            qk2, sk2, qv2, sv2 = serde.deserialize_quant(blob)
+            assert np.array_equal(qk2, np.asarray(qks[i]))
+            assert np.array_equal(sk2, np.asarray(sks[i]))
+            assert np.array_equal(qv2, np.asarray(qvs[i]))
+            assert np.array_equal(sv2, np.asarray(svs[i]))
