@@ -215,6 +215,18 @@ def _shed_response(retry_after_s: float, message: str) -> web.Response:
     )
 
 
+def _request_priority(headers, body) -> str:
+    """Per-request SLO class (docs/failure-handling.md priority classes):
+    the X-Priority header wins, then the body's "priority" field; anything
+    outside the closed {interactive, batch} set degrades to interactive so
+    the label cardinality stays bounded."""
+    p = headers.get("X-Priority") or (
+        body.get("priority") if isinstance(body, dict) else None
+    )
+    p = str(p).strip().lower() if p else "interactive"
+    return p if p in ("interactive", "batch") else "interactive"
+
+
 def _usage(out) -> dict:
     return {
         "prompt_tokens": out.prompt_tokens,
@@ -238,9 +250,18 @@ class EngineServer:
             gen_params = inspect.signature(self.engine.generate).parameters
             self._engine_accepts_trace = "trace" in gen_params
             self._engine_accepts_shed_exempt = "shed_exempt" in gen_params
+            self._engine_accepts_priority = "priority" in gen_params
         except (TypeError, ValueError):
             self._engine_accepts_trace = False
             self._engine_accepts_shed_exempt = False
+            self._engine_accepts_priority = False
+        try:
+            sat = getattr(self.engine, "saturated", None)
+            self._saturated_accepts_priority = sat is not None and (
+                "priority" in inspect.signature(sat).parameters
+            )
+        except (TypeError, ValueError):
+            self._saturated_accepts_priority = False
         self.start_time = time.time()
         # device telemetry sampler (engine/devicemon.py): HBM per device,
         # KV pool vs headroom, compile activity, step duty cycle — rendered
@@ -344,6 +365,9 @@ class EngineServer:
                     "age_s": round(time.monotonic() - seq.arrival_time, 3),
                     "migratable": reason is None,
                     "reason": reason,
+                    # SLO class so the controller's latency-protection
+                    # policy can pick batch victims only
+                    "priority": _meta.get("priority") or "interactive",
                 })
         return web.json_response({"requests": out})
 
@@ -566,9 +590,18 @@ class EngineServer:
                 await loop.run_in_executor(
                     None, mig.prefetch_pages, snap.page_hashes
                 )
+            kwargs = {}
+            if self._engine_accepts_priority:
+                # the continuation keeps its SLO class across the hop, so a
+                # migrated batch stream stays a latency-protection victim on
+                # the target too
+                p = snap.meta.get("priority")
+                kwargs["priority"] = (
+                    p if p in ("interactive", "batch") else "interactive"
+                )
             async for out in self.engine.generate(
                 snap.request_id, prompt_token_ids=snap.tokens, params=params,
-                shed_exempt=True,
+                shed_exempt=True, **kwargs,
             ):
                 await q.put(out)
         except asyncio.CancelledError:
@@ -813,6 +846,24 @@ class EngineServer:
         emit("num_requests_shed_total", "counter",
              s.get("num_requests_shed_total", 0),
              "generation requests shed with 429 (queue full or queue deadline)")
+        # per-SLO-class overload surface (docs/failure-handling.md priority
+        # classes): shed order, batch-early saturation, and the interactive
+        # latency signal the fleet controller's latency protection scrapes
+        emit("num_requests_shed_interactive_total", "counter",
+             s.get("num_requests_shed_interactive_total", 0),
+             "interactive-class requests shed with 429")
+        emit("num_requests_shed_batch_total", "counter",
+             s.get("num_requests_shed_batch_total", 0),
+             "batch-class requests shed with 429")
+        emit("engine_saturated_batch", "gauge",
+             s.get("engine_saturated_batch", 0),
+             "1 while batch-class admission is shedding (interactive reserve)")
+        emit("interactive_ttft_p99_ms", "gauge",
+             s.get("interactive_ttft_p99_ms", 0.0),
+             "p99 TTFT over the recent interactive ok-request window")
+        emit("interactive_itl_p99_ms", "gauge",
+             s.get("interactive_itl_p99_ms", 0.0),
+             "p99 inter-token latency over the recent interactive window")
         emit("tensor_parallel_degree", "gauge",
              s.get("tensor_parallel", 1),
              "tp mesh-axis size of the serving mesh (chips per replica)")
@@ -1120,11 +1171,18 @@ class EngineServer:
             )
         if self.engine.is_sleeping:
             return web.json_response({"error": "engine is sleeping"}, status=503)
+        # per-request SLO class, parsed before the saturation check so the
+        # shed watermark is class-aware (batch saturates the interactive
+        # reserve early — see scheduler.saturated)
+        priority = _request_priority(request.headers, body)
         # admission control: a full waiting queue sheds HERE, before any
         # scheduler state exists for the request — a clean 429 + Retry-After
         # the router can fail over on (duck-typed: fakes/tests may lack it)
         saturated = getattr(self.engine, "saturated", None)
-        if saturated is not None and saturated():
+        if saturated is not None and (
+            saturated(priority) if self._saturated_accepts_priority
+            else saturated()
+        ):
             # event-loop-owned counter (the engine thread owns requests_shed;
             # two writers on one dict slot would drop increments)
             if hasattr(self.engine, "api_requests_shed"):
@@ -1133,7 +1191,13 @@ class EngineServer:
             if note_shed is not None:
                 # flight-recorder shed event + burst trigger + SLO terminal
                 # record (no Sequence exists for a fast-path shed)
-                note_shed(request.headers.get("X-Request-Id"))
+                try:
+                    note_shed(
+                        request.headers.get("X-Request-Id"),
+                        priority=priority,
+                    )
+                except TypeError:  # duck-typed engine predating priority
+                    note_shed(request.headers.get("X-Request-Id"))
             retry = getattr(self.engine, "shed_retry_after", lambda: 1.0)()
             return _shed_response(
                 retry,
@@ -1243,9 +1307,11 @@ class EngineServer:
         self._live_requests[req_id] = (
             sub_ids, time.monotonic(), stream,
             # presentation meta a migration target needs to keep emitting
-            # client-shaped chunks (and honest whole-request usage totals)
+            # client-shaped chunks (and honest whole-request usage totals);
+            # priority rides along so /migratable can class-filter victims
+            # and a migrated continuation keeps its SLO class
             {"oid": oid, "chat": chat, "created": created, "model": model,
-             "prompt_tokens": len(prompt_ids)},
+             "prompt_tokens": len(prompt_ids), "priority": priority},
         )
 
         def _gen(sid):
@@ -1266,6 +1332,8 @@ class EngineServer:
             # still 429s the whole request cleanly and aborts them)
             if self._engine_accepts_shed_exempt and sid != sub_ids[0]:
                 kwargs["shed_exempt"] = True
+            if self._engine_accepts_priority:
+                kwargs["priority"] = priority
             return self.engine.generate(sid, **kwargs)
 
         def _shed_whole_request() -> web.Response:

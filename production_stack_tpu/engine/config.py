@@ -32,6 +32,18 @@ class EngineConfig:
     queue_deadline_s: float = 0.0
     # Retry-After seconds advertised on shed responses
     shed_retry_after_s: float = 1.0
+    # SLO classes (docs/failure-handling.md "Priority classes & graceful
+    # degradation"): waiting-queue slots reserved for interactive requests —
+    # batch traffic saturates (sheds) this many slots early, so batch load
+    # can never starve interactive out of a bounded queue
+    interactive_reserve: int = 1
+    # queue deadline applied to batch-class requests only (0 = inherit
+    # queue_deadline_s); a shorter batch deadline makes the engine loop
+    # expire batch out of a congested queue before any interactive request
+    batch_queue_deadline_s: float = 0.0
+    # max share of a prefill dispatch's chunk slots batch may hold while an
+    # interactive prefill is waiting (1.0 = no cap)
+    batch_prefill_share: float = 0.5
     # KV page size (tokens). Larger pages mean fewer (bigger) page DMAs per
     # decode step: measured on v5e (llama-3.2-1b class, B=16, 1k ctx, with
     # deferred-burst KV + stacked-pool streaming) decode runs 1037 tok/s at
@@ -327,6 +339,21 @@ class EngineConfig:
 # --help text for flags whose one-line meaning is not obvious from the name;
 # the dataclass comments stay the authoritative long-form docs
 _FLAG_HELP = {
+    "interactive_reserve": (
+        "waiting-queue slots reserved for interactive-class requests: batch "
+        "traffic sheds (429) this many slots before the queue bound, so "
+        "batch load can never starve interactive admission "
+        "(docs/failure-handling.md priority classes)"
+    ),
+    "batch_queue_deadline_s": (
+        "queue deadline for batch-class requests only (0 = inherit "
+        "--queue-deadline-s); set it shorter so congestion expires batch "
+        "out of the queue before any interactive request"
+    ),
+    "batch_prefill_share": (
+        "max share of one prefill dispatch's chunk slots batch-class rows "
+        "may hold while an interactive prefill is waiting (1.0 = no cap)"
+    ),
     "prefill_pages_per_block": (
         "prefill kernel: KV pages landed contiguously per packed grid cell "
         "and folded as one wide matmul (0 = auto ~512 KV slots; retune with "

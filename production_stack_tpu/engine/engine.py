@@ -502,6 +502,9 @@ class LLMEngine:
             spec_ngram=cfg.speculative_ngram,
             max_waiting_seqs=cfg.max_waiting_seqs,
             queue_deadline_s=cfg.queue_deadline_s,
+            interactive_reserve=cfg.interactive_reserve,
+            batch_queue_deadline_s=cfg.batch_queue_deadline_s,
+            batch_prefill_share=cfg.batch_prefill_share,
         )
         # this loop dispatches run-ahead prefills behind in-flight chains
         # (_runahead_prefills), which is what licenses the scheduler's
@@ -554,6 +557,12 @@ class LLMEngine:
         # (the API-layer fast-path 429); stats() sums them
         self.requests_shed = {"queue_full": 0, "queue_deadline": 0}
         self.api_requests_shed = 0
+        # per-SLO-class shed accounting (docs/failure-handling.md priority
+        # classes), same single-writer split: requests_shed_by_class is
+        # mutated ONLY on the device thread, api_requests_shed_by_class ONLY
+        # on the event loop (note_api_shed); stats() sums the pairs
+        self.requests_shed_by_class = {"interactive": 0, "batch": 0}  # owned-by: device-thread
+        self.api_requests_shed_by_class = {"interactive": 0, "batch": 0}  # owned-by: event-loop
         # admission instrumentation: arrival -> first prefill dispatch, in ms
         # (the piece of TTFT a chained decode dispatch can inflate — an
         # arrival mid-chain waits for the whole chain before its prefill).
@@ -586,6 +595,13 @@ class LLMEngine:
 
         self.slo_records: collections.deque = collections.deque(maxlen=2048)
         self._slo_seq = itertools.count(1)
+        # rolling window of recent interactive ok-request latencies, feeding
+        # the interactive_{ttft,itl}_p99_ms gauges the fleet controller's
+        # latency-protection policy scrapes (docs/failure-handling.md
+        # priority classes); bounded deque appends are atomic, stats()
+        # snapshots with list()
+        self._interactive_ttft_ms: collections.deque = collections.deque(maxlen=64)  # owned-by: device-thread
+        self._interactive_itl_ms: collections.deque = collections.deque(maxlen=64)  # owned-by: device-thread
         # engine step index: every dispatched batch increments it; flight
         # recorder events carry it so a debug window can be cut by step range
         self.step_idx = 0
@@ -595,10 +611,12 @@ class LLMEngine:
 
     # -- admission control / load shedding ----------------------------------
 
-    def saturated(self) -> bool:
-        """Waiting queue at its configured bound — the API layer should shed
-        new generation work with 429 + Retry-After instead of queueing it."""
-        return self.scheduler.saturated()
+    def saturated(self, priority: str = "interactive") -> bool:
+        """Waiting queue at its configured bound for this SLO class — the
+        API layer should shed new generation work with 429 + Retry-After
+        instead of queueing it. Batch saturates ``interactive_reserve``
+        slots early (scheduler.saturated)."""
+        return self.scheduler.saturated(priority)
 
     def shed_retry_after(self) -> float:
         return max(0.0, self.cfg.shed_retry_after_s)
@@ -641,13 +659,20 @@ class LLMEngine:
                 # the device thread — neither may pay the ring serialization
                 fr.dump_async("shed_burst")
 
-    def note_api_shed(self, request_id: Optional[str] = None) -> None:
+    def note_api_shed(
+        self,
+        request_id: Optional[str] = None,
+        priority: str = "interactive",
+    ) -> None:
         """API-layer fast-path shed (api_server owns that counter; the event,
-        burst accounting, AND the SLO terminal record land here so neither
-        the recorder nor the router's availability counters are blind to the
-        most common overload shed — no Sequence ever exists for these).
-        Thread-safe: deque.append and the itertools cursor are atomic, and
-        this is the only writer on the event loop."""
+        burst accounting, the per-class counter, AND the SLO terminal record
+        land here so neither the recorder nor the router's availability
+        counters are blind to the most common overload shed — no Sequence
+        ever exists for these). Thread-safe: deque.append and the itertools
+        cursor are atomic, and this is the only writer on the event loop."""
+        if priority not in self.api_requests_shed_by_class:
+            priority = "interactive"
+        self.api_requests_shed_by_class[priority] += 1
         self._note_shed("api_queue_full")
         self.slo_records.append({
             "seq": next(self._slo_seq),
@@ -655,6 +680,7 @@ class LLMEngine:
             "model": self.cfg.name,
             "outcome": "shed",
             "finish_reason": "shed",
+            "priority": priority,
             "queue_ms": 0.0,
             "ttft_ms": None,
             "e2e_ms": None,
@@ -679,6 +705,10 @@ class LLMEngine:
                 continue
             self.scheduler._finish(s, "shed")
             self.requests_shed["queue_deadline"] += 1
+            self.requests_shed_by_class[
+                s.priority if s.priority in self.requests_shed_by_class
+                else "interactive"
+            ] += 1
             self._note_shed("queue_deadline", s)
             self._emit(s, "")
 
@@ -931,8 +961,11 @@ class LLMEngine:
         lora_name: Optional[str] = None,
         trace: Optional[object] = None,
         shed_exempt: bool = False,
+        priority: str = "interactive",
     ) -> AsyncIterator[RequestOutput]:
         params = params or SamplingParams()
+        if priority not in ("interactive", "batch"):
+            priority = "interactive"  # closed label set, unknown -> default
         if lora_name and self.lora is None:
             raise ValueError("LoRA is not enabled (--enable-lora)")
         if prompt_token_ids is None:
@@ -970,7 +1003,7 @@ class LLMEngine:
         seq = Sequence(
             seq_id=seq_id, prompt_ids=list(prompt_token_ids), params=params,
             lora_slot=lora_slot, cache_salt=cache_salt, trace=trace,
-            shed_exempt=shed_exempt,
+            shed_exempt=shed_exempt, priority=priority,
         )
         self._inbox.put(seq)
         try:
@@ -1051,9 +1084,13 @@ class LLMEngine:
         # shed_exempt sequences (parallel-sampling siblings of an admitted,
         # mid-flight request — see Sequence.shed_exempt) bypass it:
         # admission control gates requests, not choices.
-        if sched.saturated() and not seq.shed_exempt:
+        if sched.saturated(seq.priority) and not seq.shed_exempt:
             sched._finish(seq, "shed")
             self.requests_shed["queue_full"] += 1
+            self.requests_shed_by_class[
+                seq.priority if seq.priority in self.requests_shed_by_class
+                else "interactive"
+            ] += 1
             self._note_shed("queue_full", seq)
             self._emit(seq, "")
             return
@@ -1932,9 +1969,15 @@ class LLMEngine:
             "itl_p99_ms": itl_p99_ms,
             "kv_pages_peak": seq.pages_peak,
             "trace_id": getattr(seq.trace, "trace_id", None),
+            "priority": getattr(seq, "priority", "interactive"),
             "t": time.time(),
         }
         self.slo_records.append(rec)
+        if outcome == "ok" and rec["priority"] == "interactive":
+            if ttft_ms is not None:
+                self._interactive_ttft_ms.append(ttft_ms)
+            if itl_p99_ms is not None:
+                self._interactive_itl_ms.append(itl_p99_ms)
         fr = self._fr
         if fr.enabled:
             fr.record(
@@ -2242,7 +2285,21 @@ class LLMEngine:
             "num_requests_shed_queue_deadline_total": (
                 self.requests_shed["queue_deadline"]
             ),
+            # per-SLO-class shed counters (device-thread + event-loop writer
+            # pairs summed, like num_requests_shed_total above)
+            "num_requests_shed_interactive_total": (
+                self.requests_shed_by_class["interactive"]
+                + self.api_requests_shed_by_class["interactive"]
+            ),
+            "num_requests_shed_batch_total": (
+                self.requests_shed_by_class["batch"]
+                + self.api_requests_shed_by_class["batch"]
+            ),
             "engine_saturated": int(self.saturated()),
+            # batch-class saturation engages interactive_reserve slots early
+            # — 1 here with engine_saturated 0 is the reserve protecting
+            # interactive admission while batch already sheds
+            "engine_saturated_batch": int(self.saturated("batch")),
             # serving-mesh shape: the router's scraper and the fleet
             # controller read these to reason about per-engine capacity (a
             # tp=4 engine is one replica on 4 chips, not 4 replicas)
@@ -2271,6 +2328,18 @@ class LLMEngine:
         }
         for section, secs in self.loop_seconds.items():
             out[f"engine_loop_{section}_seconds_total"] = round(secs, 3)
+        # interactive-SLO degradation signal for the fleet controller's
+        # latency-protection policy (migration/controller.py): p99 over the
+        # recent interactive ok-request window, 0.0 while idle
+        for name, window in (
+            ("interactive_ttft_p99_ms", self._interactive_ttft_ms),
+            ("interactive_itl_p99_ms", self._interactive_itl_ms),
+        ):
+            snap = sorted(window)
+            out[name] = (
+                round(snap[min(len(snap) - 1, int(len(snap) * 0.99))], 3)
+                if snap else 0.0
+            )
         if self.cfg.speculative_k:
             # read accepted before drafts: the engine thread increments drafts
             # first, so this order keeps any unsynchronized snapshot at

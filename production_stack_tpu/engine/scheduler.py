@@ -90,6 +90,11 @@ class Sequence:
     # stays sheddable: its pre-output shed converts the whole request to a
     # clean 429 and the siblings are aborted with it.
     shed_exempt: bool = False
+    # SLO class ("interactive" | "batch", docs/failure-handling.md): batch
+    # saturates earlier, expires earlier, yields prefill chunk slots, and
+    # is preempted first under page pressure — the whole degradation order
+    # under overload keys off this field
+    priority: str = "interactive"
     # distributed-tracing context (tracing.SpanContext of the engine.request
     # span) — phase spans for this sequence parent under it; None = untraced
     trace: Optional[object] = None
@@ -178,6 +183,9 @@ class Scheduler:
         spec_ngram: int = 3,
         max_waiting_seqs: int = 0,
         queue_deadline_s: float = 0.0,
+        interactive_reserve: int = 1,
+        batch_queue_deadline_s: float = 0.0,
+        batch_prefill_share: float = 0.5,
     ):
         self.kv = kv
         self.max_num_seqs = max_num_seqs
@@ -210,6 +218,15 @@ class Scheduler:
         # exactly when to retry.
         self.max_waiting_seqs = max(0, max_waiting_seqs)
         self.queue_deadline_s = max(0.0, queue_deadline_s)
+        # SLO classes (docs/failure-handling.md "Priority classes"): the
+        # last `interactive_reserve` slots of a bounded waiting queue only
+        # admit interactive work, so sustained batch load can never starve
+        # interactive out of admission; batch optionally expires on its own
+        # (shorter) queue deadline, and its share of a prefill dispatch's
+        # chunk slots is capped while interactive prefill work is waiting.
+        self.interactive_reserve = max(0, interactive_reserve)
+        self.batch_queue_deadline_s = max(0.0, batch_queue_deadline_s)
+        self.batch_prefill_share = min(1.0, max(0.0, batch_prefill_share))
         self.waiting: list[Sequence] = []
         self.running: list[Sequence] = []
         self.preemptions_total = 0
@@ -271,7 +288,7 @@ class Scheduler:
     def num_waiting(self) -> int:
         return len(self.waiting)
 
-    def saturated(self) -> bool:
+    def saturated(self, priority: str = "interactive") -> bool:
         """Waiting queue at (or past) its bound — new work should shed.
 
         Free seats project forward: sequences about to be admitted straight
@@ -281,26 +298,48 @@ class Scheduler:
         engine_saturated gauge the router honors for a whole scrape
         interval. This projection is the single saturation definition: the
         API fast path, the engine-side authoritative bound, and the
-        /metrics gauge all read it."""
+        /metrics gauge all read it.
+
+        Class-aware: batch traffic saturates ``interactive_reserve`` waiting
+        slots early, so under sustained mixed-class overload every shed
+        lands on batch until only the reserved interactive slots remain —
+        batch can never starve interactive out of the queue."""
         if self.max_waiting_seqs <= 0:
             return False
         free_seats = max(0, self.max_num_seqs - len(self.running))
-        return len(self.waiting) >= self.max_waiting_seqs + free_seats
+        bound = self.max_waiting_seqs + free_seats
+        if priority == "batch":
+            bound = (
+                max(0, self.max_waiting_seqs - self.interactive_reserve)
+                + free_seats
+            )
+        return len(self.waiting) >= bound
+
+    def deadline_for(self, priority: str) -> float:
+        """Queue deadline for one SLO class: batch uses its own (typically
+        shorter) deadline when configured, else inherits the shared one."""
+        if priority == "batch" and self.batch_queue_deadline_s > 0:
+            return self.batch_queue_deadline_s
+        return self.queue_deadline_s
 
     def expired_waiting(self, now: Optional[float] = None) -> list[Sequence]:
-        """Waiting sequences past the queue deadline that can still shed
-        CLEANLY: never dispatched (no tokens streamed) and not preempted —
-        a preempted sequence already delivered output, so a 429 is no longer
-        an honest answer and it keeps its place instead."""
-        if self.queue_deadline_s <= 0:
+        """Waiting sequences past their class's queue deadline that can
+        still shed CLEANLY: never dispatched (no tokens streamed) and not
+        preempted — a preempted sequence already delivered output, so a 429
+        is no longer an honest answer and it keeps its place instead."""
+        if self.queue_deadline_s <= 0 and self.batch_queue_deadline_s <= 0:
             return []
         now = time.monotonic() if now is None else now
-        return [
-            s for s in self.waiting
-            if s.first_dispatch_time is None
-            and not getattr(s, "preempted", False)
-            and now - s.arrival_time > self.queue_deadline_s
-        ]
+        out = []
+        for s in self.waiting:
+            if s.first_dispatch_time is not None or getattr(
+                s, "preempted", False
+            ):
+                continue
+            deadline = self.deadline_for(getattr(s, "priority", "interactive"))
+            if deadline > 0 and now - s.arrival_time > deadline:
+                out.append(s)
+        return out
 
     def num_running(self) -> int:
         return len(self.running)
@@ -319,7 +358,21 @@ class Scheduler:
         from production_stack_tpu import tracing
 
         while self.waiting and len(self.running) < self.max_num_seqs:
-            seq = self.waiting[0]
+            # admission order: a preempted head keeps its place (it already
+            # streamed tokens — jumping it would stall a live stream), then
+            # interactive before batch (FIFO within each class), then FIFO.
+            head = self.waiting[0]
+            if getattr(head, "preempted", False):
+                seq = head
+            else:
+                seq = next(
+                    (
+                        s
+                        for s in self.waiting
+                        if getattr(s, "priority", "interactive") != "batch"
+                    ),
+                    head,
+                )
             # publish a phase-span context for the admission window: offload
             # spill/restore spans recorded inside match_prefix / allocate
             # (kv_manager) nest under the phase of the request that caused
@@ -367,7 +420,7 @@ class Scheduler:
             seq.pages_peak = max(seq.pages_peak, len(seq.pages))
             seq.num_cached = cached
             seq.num_computed = cached
-            self.waiting.pop(0)
+            self.waiting.remove(seq)
             self.running.append(seq)
 
     def _burst_budget(self, seq: Sequence, bursts: int = 1) -> int:
@@ -627,12 +680,43 @@ class Scheduler:
         return self._take_prefill(prefilling)
 
     def _take_prefill(self, prefilling: list[Sequence]) -> ScheduledBatch:
-        """Plan the next prefill dispatch: shortest remaining prompts first
-        (they finish and start decoding soonest)."""
+        """Plan the next prefill dispatch: interactive rows first (their
+        TTFT is the SLO under protection), then shortest remaining prompts
+        (they finish and start decoding soonest). While interactive prefill
+        work is waiting — resident rows that overflow this dispatch, or
+        arrivals still queued for a seat — batch's share of the chunk slots
+        is capped at ``batch_prefill_share`` so a wall of long batch
+        prompts cannot monopolize the prefill pipeline."""
         self._last_kind = "prefill"
         self._chain_streak = 0  # prefill work ends the quiescence streak
-        prefilling.sort(key=lambda s: len(s.prompt_ids) - s.num_computed)
-        return self._plan_prefill(prefilling[: self.prefill_batch])
+        prefilling.sort(
+            key=lambda s: (
+                getattr(s, "priority", "interactive") == "batch",
+                len(s.prompt_ids) - s.num_computed,
+            )
+        )
+        take = prefilling[: self.prefill_batch]
+        interactive_waiting = any(
+            getattr(s, "priority", "interactive") != "batch"
+            for s in prefilling[self.prefill_batch:]
+        ) or any(
+            getattr(s, "priority", "interactive") != "batch"
+            for s in self.waiting
+        )
+        if interactive_waiting and self.batch_prefill_share < 1.0:
+            cap = max(1, int(self.prefill_batch * self.batch_prefill_share))
+            inter = [
+                s for s in take
+                if getattr(s, "priority", "interactive") != "batch"
+            ]
+            batch_rows = [
+                s for s in take
+                if getattr(s, "priority", "interactive") == "batch"
+            ]
+            # always keep >= 1 row so the dispatch makes progress even when
+            # everything resident is batch
+            take = (inter + batch_rows[:cap]) or take[:1]
+        return self._plan_prefill(take)
 
     def _plan_prefill(self, seqs: list[Sequence]) -> ScheduledBatch:
         chunks = [
@@ -689,18 +773,33 @@ class Scheduler:
         self, seqs: list[Sequence], bursts: int = 1
     ) -> Optional[ScheduledBatch]:
         ready = []
+        # decode-dispatch priority: interactive rows claim their KV growth
+        # pages first (stable within class), so when the pool runs dry it is
+        # a batch row that fails to grow — and the preemption below evicts
+        # batch before any interactive stream is touched
+        seqs = sorted(
+            seqs,
+            key=lambda s: getattr(s, "priority", "interactive") == "batch",
+        )
         for s in list(seqs):
             if s not in self.running or s.finished:
                 continue  # preempted or finished earlier in this pass
             ok = self._ensure_decode_page(s, bursts)
             while not ok:
-                # out of KV pages: preempt the newest other running sequence;
-                # if there is none, preempt s itself
+                # out of KV pages: preempt the newest other running sequence,
+                # preferring batch victims over interactive ones; if there is
+                # none, preempt s itself
                 others = [x for x in self.running if x is not s]
                 if not others:
                     self._preempt(s)
                     break
-                victim = max(others, key=lambda x: x.arrival_time)
+                victim = max(
+                    others,
+                    key=lambda x: (
+                        getattr(x, "priority", "interactive") == "batch",
+                        x.arrival_time,
+                    ),
+                )
                 self._preempt(victim)
                 if victim in ready:
                     ready.remove(victim)

@@ -34,6 +34,12 @@ Decisions by kind:
   top warm prefixes itself via ``--warm-prefetch-on-boot`` before /ready;
   the decision records that scale-up completed so operators can alert on a
   scale-up that never warmed).
+- ``latency_protect`` — an engine whose *interactive* TTFT/ITL p99 (the
+  ``vllm:interactive_{ttft,itl}_p99_ms`` gauges) breached its watermark
+  sheds BATCH-class streams to the coolest peer before any interactive
+  stream is touched (docs/failure-handling.md priority classes). Per-URL
+  hysteresis: engages on breach, releases only when the p99 falls below
+  ``latency_release_ratio`` x watermark.
 """
 
 from __future__ import annotations
@@ -68,8 +74,23 @@ class BackendView:
     # device-to-device instead of through the shared tier, so equal-pressure
     # target picks prefer the higher-bandwidth backend (docs/kv-fabric.md)
     fabric_bandwidth: float = 0.0
-    # [{"request_id": ..., "output_tokens": ...}, ...] — migratable streams
+    # rolling interactive-class latency p99s the engine exports
+    # (vllm:interactive_ttft_p99_ms / vllm:interactive_itl_p99_ms); 0 until
+    # the first interactive request finishes — the latency_protect policy
+    # treats 0 as "no signal", never as "fast"
+    interactive_ttft_p99: float = 0.0
+    interactive_itl_p99: float = 0.0
+    # [{"request_id": ..., "output_tokens": ..., "priority": ...}, ...] —
+    # migratable streams (priority defaults interactive when absent)
     migratable: list = field(default_factory=list)
+
+    def batch_migratable(self) -> list:
+        """Migratable streams in the batch SLO class — the only legal
+        victims for latency_protect preemption."""
+        return [
+            r for r in self.migratable
+            if r.get("priority", "interactive") == "batch"
+        ]
 
     def rank_key(self, queue_ref: int) -> tuple:
         """Target-selection sort key: pressure first, probed fabric
@@ -92,7 +113,7 @@ class BackendView:
 
 @dataclass
 class Action:
-    kind: str                    # "rebalance" | "drain" | "warm_up"
+    kind: str   # "rebalance" | "drain" | "warm_up" | "latency_protect"
     source: Optional[str] = None
     target: Optional[str] = None
     request_ids: list = field(default_factory=list)
@@ -115,6 +136,15 @@ class ControllerPolicy:
     # queue-depth normalizer for the pressure score (the router's
     # --saturation-queue-ref twin)
     saturation_queue_ref: int = 8
+    # latency protection (0 disables): when an engine's interactive-class
+    # TTFT or ITL p99 exceeds its watermark, batch streams migrate off it
+    interactive_ttft_watermark_ms: float = 0.0
+    interactive_itl_watermark_ms: float = 0.0
+    # per-URL hysteresis release: disengage only when the breached p99
+    # falls below watermark * this ratio (not at the watermark itself)
+    latency_release_ratio: float = 0.7
+    # batch streams moved per latency_protect decision
+    latency_protect_k: int = 1
 
 
 class FleetDecider:
@@ -125,9 +155,12 @@ class FleetDecider:
     def __init__(self, policy: ControllerPolicy):
         self.policy = policy
         self._engaged = False            # rebalance hysteresis latch
+        self._latency_engaged: set = set()  # per-URL latency_protect latch
         self._last_action: dict = {}     # kind -> monotonic ts
         self._known_urls: set = set()    # for warm_up (new engine) detection
-        self.decisions_total: dict = {"rebalance": 0, "drain": 0, "warm_up": 0}
+        self.decisions_total: dict = {
+            "rebalance": 0, "drain": 0, "warm_up": 0, "latency_protect": 0,
+        }
 
     def _cooled(self, kind: str, now: float) -> bool:
         last = self._last_action.get(kind)
@@ -161,6 +194,53 @@ class FleetDecider:
             healthy, key=lambda v: v.rank_key(p.saturation_queue_ref)
         )
         cold, hot = scored[0], scored[-1]
+        # latency protection (docs/failure-handling.md priority classes):
+        # an engine failing its INTERACTIVE latency watermark sheds batch
+        # streams to the coolest peer — batch is always preempted before
+        # any interactive stream is considered, and an engine with no
+        # interactive signal yet (p99 == 0) never engages
+        for v in healthy:
+            breach = (
+                p.interactive_ttft_watermark_ms > 0
+                and v.interactive_ttft_p99 > p.interactive_ttft_watermark_ms
+            ) or (
+                p.interactive_itl_watermark_ms > 0
+                and v.interactive_itl_p99 > p.interactive_itl_watermark_ms
+            )
+            released = (
+                p.interactive_ttft_watermark_ms <= 0
+                or v.interactive_ttft_p99
+                < p.interactive_ttft_watermark_ms * p.latency_release_ratio
+            ) and (
+                p.interactive_itl_watermark_ms <= 0
+                or v.interactive_itl_p99
+                < p.interactive_itl_watermark_ms * p.latency_release_ratio
+            )
+            if v.url not in self._latency_engaged and breach:
+                self._latency_engaged.add(v.url)
+            elif v.url in self._latency_engaged and released:
+                self._latency_engaged.discard(v.url)
+            if not (
+                v.url in self._latency_engaged
+                and inflight_migrations < p.max_concurrent_migrations
+                and self._cooled("latency_protect", now)
+            ):
+                continue
+            budget = min(
+                p.latency_protect_k,
+                p.max_concurrent_migrations - inflight_migrations,
+            )
+            victims = sorted(
+                v.batch_migratable(),
+                key=lambda r: -int(r.get("output_tokens", 0)),
+            )[:budget]
+            targets = [t for t in scored if t.url != v.url]
+            if victims and targets:
+                actions.append(Action(
+                    "latency_protect", source=v.url, target=targets[0].url,
+                    request_ids=[r["request_id"] for r in victims],
+                ))
+                self._note("latency_protect", now)
         delta = hot.pressure(p.saturation_queue_ref) - cold.pressure(
             p.saturation_queue_ref
         )
@@ -306,6 +386,12 @@ class FleetController:
             running=int(vals.get("vllm:num_requests_running", 0)),
             fabric_bandwidth=float(
                 vals.get("vllm:kv_fabric_peer_bandwidth_bytes_per_sec", 0.0)
+            ),
+            interactive_ttft_p99=float(
+                vals.get("vllm:interactive_ttft_p99_ms", 0.0)
+            ),
+            interactive_itl_p99=float(
+                vals.get("vllm:interactive_itl_p99_ms", 0.0)
             ),
         )
         listing = await self._fetch_json(f"{url}/migratable")

@@ -123,6 +123,13 @@ class RouterApp:
             itl_ms=getattr(args, "slo_itl_ms", 200.0),
             saturation_queue_ref=getattr(args, "saturation_queue_ref", 8),
         )
+        from production_stack_tpu.router.request_service import (
+            set_batch_avoid_attainment,
+        )
+
+        set_batch_avoid_attainment(
+            getattr(args, "batch_avoid_attainment", 0.9)
+        )
         initialize_routing_logic(
             args.routing_logic,
             session_key=args.session_key,

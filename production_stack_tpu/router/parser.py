@@ -150,6 +150,11 @@ def parse_args(argv=None):
                    help="waiting-queue depth that counts one backend as "
                         "fully saturated in vllm_router:fleet_saturation "
                         "(the prometheus-adapter autoscaling gauge)")
+    p.add_argument("--batch-avoid-attainment", type=float, default=0.9,
+                   help="interactive-TTFT attainment ratio below which a "
+                        "backend stops receiving NEW batch-class traffic "
+                        "(X-Priority: batch); 0 disables class-aware "
+                        "placement (docs/failure-handling.md)")
     args = p.parse_args(argv)
     validate_args(args)
     return args
@@ -170,6 +175,8 @@ def validate_args(args) -> None:
             )
     if not 0.0 <= args.trace_sample_rate <= 1.0:
         raise ValueError("--trace-sample-rate must be in [0, 1]")
+    if not 0.0 <= args.batch_avoid_attainment <= 1.0:
+        raise ValueError("--batch-avoid-attainment must be in [0, 1]")
     if args.retry_max_attempts < 1:
         raise ValueError("--retry-max-attempts must be >= 1")
     if args.retry_backoff_base < 0 or args.retry_backoff_max < 0:

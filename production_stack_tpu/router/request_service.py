@@ -23,8 +23,10 @@ import aiohttp
 from aiohttp import web
 
 from production_stack_tpu.router.resilience import (
+    count_batch_deprioritized,
     count_deadline_abort,
     count_failover,
+    count_request_class,
     count_retry,
     count_shed,
     get_breaker_registry,
@@ -171,6 +173,31 @@ class _RetryableProxyError(Exception):
 # longest a single 429 may exclude a backend from routing: a malformed or
 # hostile Retry-After ('inf', '1e18') must never quarantine a healthy
 # backend until router restart
+# class-aware placement threshold (--batch-avoid-attainment; app.py sets it
+# at startup, 0 disables): batch requests avoid backends whose interactive
+# TTFT attainment fell below this ratio
+_batch_avoid_attainment = 0.9
+
+
+def set_batch_avoid_attainment(value: float) -> None:
+    global _batch_avoid_attainment
+    _batch_avoid_attainment = max(0.0, float(value))
+
+
+def request_priority(headers, body_json: Optional[dict]) -> str:
+    """Resolve a request's SLO class: ``X-Priority`` header wins, then a
+    ``priority`` body field; anything outside the closed {interactive,
+    batch} set (and the unlabeled default) degrades to interactive — the
+    protective class — so a typo never silently deprioritizes a tenant."""
+    raw = None
+    if headers is not None:
+        raw = headers.get("X-Priority")
+    if not raw and body_json:
+        raw = body_json.get("priority")
+    pri = str(raw or "interactive").strip().lower()
+    return pri if pri in ("interactive", "batch") else "interactive"
+
+
 MAX_RETRY_AFTER_S = 60.0
 
 
@@ -962,8 +989,22 @@ async def route_general_request(
             (headers.get(router.session_key) if headers is not None else None)
             or (request_json or {}).get(router.session_key)
         )
+    # SLO-class tagging (docs/failure-handling.md priority classes): resolve
+    # the class once here; _filter_headers forwards X-Priority to the engine
+    # untouched, so the engine's class-aware admission sees the same label.
+    priority = request_priority(getattr(request, "headers", None), request_json)
+    count_request_class(priority)
     if not sticky:
         endpoints = router.saturation_filtered(endpoints, engine_stats)
+        # batch avoids engines failing their interactive tenants (fail-static
+        # inside class_filtered — a fully-degraded fleet passes through and
+        # the engine's batch-first shed answers with the honest 429)
+        filtered = router.class_filtered(
+            endpoints, priority, _batch_avoid_attainment
+        )
+        if len(filtered) < len(endpoints):
+            count_batch_deprioritized()
+        endpoints = filtered
 
     request_stats = get_request_stats_monitor().get_request_stats()
     t_route0 = time.perf_counter()

@@ -324,6 +324,15 @@ session_repins_total = 0
 # handoffs that failed after the source committed (the client got the SSE
 # error-event contract instead of a silent truncation)
 migration_splice_failures_total = 0
+# per-SLO-class request tagging (docs/failure-handling.md priority classes):
+# closed label set, zero rows always rendered so dashboards see the split
+# from the first scrape
+requests_by_class_total: dict[str, int] = {"interactive": 0, "batch": 0}
+# batch requests steered away from at least one backend whose interactive
+# SLO attainment was degraded (RoutingInterface.class_filtered shrank the
+# candidate set) — a flat line under overload means the avoidance filter
+# never engaged
+batch_deprioritized_routes_total = 0
 
 
 def count_retry() -> None:
@@ -345,6 +354,16 @@ def count_deadline_abort(kind: str) -> None:
     deadline_aborts_total[kind] = deadline_aborts_total.get(kind, 0) + 1
 
 
+def count_request_class(priority: str) -> None:
+    key = priority if priority in requests_by_class_total else "interactive"
+    requests_by_class_total[key] += 1
+
+
+def count_batch_deprioritized() -> None:
+    global batch_deprioritized_routes_total
+    batch_deprioritized_routes_total += 1
+
+
 def count_session_repin() -> None:
     global session_repins_total
     session_repins_total += 1
@@ -360,13 +379,17 @@ def reset_counters() -> None:
     counters never reset outside a process restart."""
     global retries_total, failovers_total, sheds_total
     global session_repins_total, migration_splice_failures_total
+    global batch_deprioritized_routes_total
     retries_total = 0
     failovers_total = 0
     sheds_total = 0
     session_repins_total = 0
     migration_splice_failures_total = 0
+    batch_deprioritized_routes_total = 0
     for k in list(deadline_aborts_total):
         deadline_aborts_total[k] = 0
+    for k in list(requests_by_class_total):
+        requests_by_class_total[k] = 0
 
 
 def render_resilience_metrics() -> list[str]:
@@ -383,10 +406,18 @@ def render_resilience_metrics() -> list[str]:
         "# TYPE vllm_router:migration_splice_failures_total counter",
         f"vllm_router:migration_splice_failures_total "
         f"{migration_splice_failures_total}",
+        "# TYPE vllm_router:batch_deprioritized_routes_total counter",
+        f"vllm_router:batch_deprioritized_routes_total "
+        f"{batch_deprioritized_routes_total}",
         "# TYPE vllm_router:deadline_aborts_total counter",
     ]
     for kind, n in sorted(deadline_aborts_total.items()):
         lines.append(f'vllm_router:deadline_aborts_total{{kind="{kind}"}} {n}')
+    lines.append("# TYPE vllm_router:requests_by_class_total counter")
+    for pri, n in sorted(requests_by_class_total.items()):
+        lines.append(
+            f'vllm_router:requests_by_class_total{{priority="{pri}"}} {n}'
+        )
     reg = get_breaker_registry()
     states = reg.states()
     if states:

@@ -72,6 +72,34 @@ class RoutingInterface(metaclass=SingletonMeta):
             out.append(ep)
         return out if out else list(endpoints)
 
+    @staticmethod
+    def class_filtered(
+        endpoints: list[EndpointInfo],
+        priority: str,
+        min_attainment: float = 0.9,
+    ) -> list[EndpointInfo]:
+        """Class-aware placement (docs/failure-handling.md priority classes):
+        batch traffic avoids backends whose *interactive* TTFT SLO attainment
+        has degraded below ``min_attainment``, keeping bulk work off engines
+        that are already failing their latency-sensitive tenants. Interactive
+        traffic is never filtered here — it sees every candidate. Fail-static
+        like the saturation filter: if every backend is degraded (or none has
+        attainment data yet) the original set passes through unchanged, so
+        batch requests still land somewhere and the engine-side admission
+        control (which sheds batch first) gives the honest 429."""
+        if priority != "batch" or min_attainment <= 0.0:
+            return list(endpoints)
+        from production_stack_tpu.router.slo import get_slo_monitor
+
+        mon = get_slo_monitor()
+        out = []
+        for ep in endpoints:
+            att = mon.interactive_attainment(ep.url, "ttft")
+            if att is not None and att < min_attainment:
+                continue
+            out.append(ep)
+        return out if out else list(endpoints)
+
 
 def _qps_routing(endpoints: list[EndpointInfo], request_stats: dict[str, Any]) -> str:
     """Lowest-QPS endpoint (parity :59-81)."""

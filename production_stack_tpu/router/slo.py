@@ -8,8 +8,9 @@ served by ``GET /slo_records?since=<cursor>``; the stats scraper
 records here. This module applies the router's configured objectives and
 exports, on the router's ``/metrics``:
 
-- ``vllm_router:slo_attained_total{objective,model,server}`` /
-  ``vllm_router:slo_violated_total{...}`` — per-objective counters:
+- ``vllm_router:slo_attained_total{objective,model,priority,server}`` /
+  ``vllm_router:slo_violated_total{...}`` — per-objective counters, split
+  by the request's SLO class (``priority="interactive"|"batch"``):
   * ``objective="ttft"``        — TTFT <= --slo-ttft-ms (ok requests only)
   * ``objective="itl"``         — inter-token p99 <= --slo-itl-ms
   * ``objective="availability"``— the request finished ok at all (sheds,
@@ -47,6 +48,9 @@ logger = init_logger(__name__)
 
 OBJECTIVES = ("ttft", "itl", "availability")
 OUTCOMES = ("ok", "shed", "abort", "error", "migrated")
+# per-request SLO classes (docs/failure-handling.md priority classes): a
+# closed label set — records carrying anything else degrade to interactive
+PRIORITIES = ("interactive", "batch")
 
 
 class SLOMonitor(metaclass=SingletonMeta):
@@ -61,7 +65,9 @@ class SLOMonitor(metaclass=SingletonMeta):
         self.saturation_queue_ref = max(1, int(saturation_queue_ref))
         # per-backend /slo_records cursor (the scraper reads + advances it)
         self._cursors: dict[str, int] = {}
-        # (server, model, objective) -> [attained, violated]
+        # (server, model, objective, priority) -> [attained, violated] —
+        # same two families, one extra closed-set label, so per-class
+        # attainment is scrapeable without new metric names
         self._counters: dict[tuple, list] = {}
         # (server, outcome) -> count
         self._outcomes: dict[tuple, int] = {}
@@ -96,8 +102,15 @@ class SLOMonitor(metaclass=SingletonMeta):
         self._cursors[url] = max(since, int(payload.get("next", since)))
         return n
 
-    def _bump(self, server: str, model: str, objective: str, attained: bool):
-        key = (server, model, objective)
+    def _bump(
+        self,
+        server: str,
+        model: str,
+        objective: str,
+        attained: bool,
+        priority: str = "interactive",
+    ):
+        key = (server, model, objective, priority)
         cell = self._counters.get(key)
         if cell is None:
             cell = self._counters[key] = [0, 0]
@@ -108,6 +121,9 @@ class SLOMonitor(metaclass=SingletonMeta):
         outcome = str(rec.get("outcome") or "error")
         if outcome not in OUTCOMES:
             outcome = "error"
+        priority = str(rec.get("priority") or "interactive")
+        if priority not in PRIORITIES:
+            priority = "interactive"
         self._records_total[url] = self._records_total.get(url, 0) + 1
         self._outcomes[(url, outcome)] = self._outcomes.get((url, outcome), 0) + 1
         if outcome == "migrated":
@@ -117,7 +133,7 @@ class SLOMonitor(metaclass=SingletonMeta):
             # violation would charge every rebalance as an outage; counting
             # it attained would double-count the request.
             return
-        self._bump(url, model, "availability", outcome == "ok")
+        self._bump(url, model, "availability", outcome == "ok", priority)
         if outcome != "ok":
             # a shed/abort/error has no honest latency to judge: it violates
             # availability, and the latency objectives abstain (counting it
@@ -125,10 +141,28 @@ class SLOMonitor(metaclass=SingletonMeta):
             return
         ttft = rec.get("ttft_ms")
         if ttft is not None:
-            self._bump(url, model, "ttft", float(ttft) <= self.ttft_ms)
+            self._bump(url, model, "ttft", float(ttft) <= self.ttft_ms,
+                       priority)
         itl = rec.get("itl_p99_ms")
         if itl is not None:
-            self._bump(url, model, "itl", float(itl) <= self.itl_ms)
+            self._bump(url, model, "itl", float(itl) <= self.itl_ms,
+                       priority)
+
+    def interactive_attainment(
+        self, server: str, objective: str = "ttft"
+    ) -> Optional[float]:
+        """Interactive-class attainment ratio for one backend and objective
+        (all models summed), or None before any interactive record landed.
+        The router's class-aware placement reads this: batch traffic avoids
+        backends whose interactive attainment is degraded, and the fleet
+        controller corroborates its engine-side latency watermark with it."""
+        att = vio = 0
+        for (srv, _model, obj, pri), cell in self._counters.items():
+            if srv == server and obj == objective and pri == "interactive":
+                att += cell[0]
+                vio += cell[1]
+        total = att + vio
+        return (att / total) if total else None
 
     def forget(self, url: str) -> None:
         """Drop a backend's cursor. NOT called on discovery dropout — a
@@ -170,11 +204,12 @@ class SLOMonitor(metaclass=SingletonMeta):
             "# TYPE vllm_router:slo_attained_total counter",
             "# TYPE vllm_router:slo_violated_total counter",
         ]
-        for (server, model, objective), (att, vio) in sorted(
+        for (server, model, objective, priority), (att, vio) in sorted(
             self._counters.items()
         ):
             lab = (
-                f'objective="{objective}",model="{model}",server="{server}"'
+                f'objective="{objective}",model="{model}"'
+                f',priority="{priority}",server="{server}"'
             )
             lines.append(f"vllm_router:slo_attained_total{{{lab}}} {att}")
             lines.append(f"vllm_router:slo_violated_total{{{lab}}} {vio}")

@@ -102,8 +102,11 @@ fleet-wide sum proves an idempotent replay executed exactly once),
 ``fake:abort_requests_total`` (router-initiated reclaims received),
 ``fake:migrations_out_total`` / ``fake:migrations_in_total`` (live streams
 moved out of / resumed on this process), ``fake:warm_prefetch_chunks``
-(fleet-warm chunks pulled at boot), and ``fake:warm_prefix_hits_total``
-(requests whose prompt chain hit the prefetched set).
+(fleet-warm chunks pulled at boot), ``fake:warm_prefix_hits_total``
+(requests whose prompt chain hit the prefetched set), and the per-SLO-class
+split ``fake:served_by_class_total`` / ``fake:shed_by_class_total``
+(priority label, docs/failure-handling.md — the mixed-class-overload chaos
+scenario asserts every shed landed on batch).
 
 SIGTERM drains like the real engine (api_server graceful drain): /health
 flips to 503, new generation requests are refused, in-flight streams finish.
@@ -149,6 +152,16 @@ STATE = {  # owned-by: event-loop
     "completed": 0,         # generations that ran to the end (replay dedupe)
     "aborts": 0,            # POST /abort calls received (router reclaims)
     "shed": 0,              # 429s emitted (saturate-after-n / shed-rate)
+    # per-SLO-class accounting (docs/failure-handling.md priority classes):
+    # chaos mixed-class-overload asserts every shed lands on batch until the
+    # interactive reserve is exhausted, through these counters
+    "served_by_class": {"interactive": 0, "batch": 0},
+    "shed_by_class": {"interactive": 0, "batch": 0},
+    # rolling interactive-class latency windows backing the fake's
+    # vllm:interactive_{ttft,itl}_p99_ms gauges (same names as the real
+    # engine so the fleet controller's latency_protect scrapes identically)
+    "interactive_ttft_ms": collections.deque(maxlen=64),
+    "interactive_itl_ms": collections.deque(maxlen=64),
     "inflight": {},         # req_id -> handler asyncio.Task (for /abort)
     # per-request SLO terminal records (same wire shape as the real engine's
     # GET /slo_records) so router-side SLO aggregation is testable sans TPU
@@ -180,7 +193,8 @@ STATE = {  # owned-by: event-loop
 
 def _push_slo_record(model: str, req_id: str, outcome: str, *,
                      ttft_ms=None, itl_p99_ms=None, output_tokens=0,
-                     queue_ms=0.0, e2e_ms=None, trace_id=None) -> None:
+                     queue_ms=0.0, e2e_ms=None, trace_id=None,
+                     priority: str = "interactive") -> None:
     """Synthetic terminal record, same fields the real engine attributes
     (engine.LLMEngine._record_slo) so the router's scraper cannot tell the
     difference."""
@@ -206,6 +220,7 @@ def _push_slo_record(model: str, req_id: str, outcome: str, *,
         "cached_tokens": 0,
         "itl_p99_ms": None if itl_p99_ms is None else round(itl_p99_ms, 3),
         "kv_pages_peak": max(1, output_tokens // 16 + 1),
+        "priority": priority,
         "trace_id": trace_id,
         "t": time.time(),
     })
@@ -344,6 +359,17 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
     # --compile-stall-ms injects one compile stall + flight-recorder compile
     # event; --flight-dump-dir arms anomaly dumps (SIGTERM / shed burst)
     slo_itl_ms = faults.get("slo_itl_ms")
+    # class-aware admission (docs/failure-handling.md priority classes):
+    # batch sheds --interactive-reserve slots EARLIER than interactive, so
+    # the last slots under saturate-after-n stay reserved for interactive
+    interactive_reserve = int(faults.get("interactive_reserve") or 0)
+    # --interactive-slo-degrade-ms: inflate every interactive request's
+    # reported TTFT/ITL by this much — models an engine failing its
+    # interactive SLO, driving the controller's latency_protect policy and
+    # the router's batch-avoidance filter without real latency injection
+    interactive_slo_degrade_ms = float(
+        faults.get("interactive_slo_degrade_ms") or 0.0
+    )
     compile_stall_ms = float(faults.get("compile_stall_ms") or 0.0)
     flight_dump_dir = faults.get("flight_dump_dir")
     if flight_dump_dir:
@@ -618,6 +644,9 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                 "output_tokens": int(STATE["progress"].get(rid, 0)),
                 "prompt_tokens": 10,
                 "age_s": 0.0,
+                "priority": (STATE["meta"].get(rid) or {}).get(
+                    "priority", "interactive"
+                ),
                 "migratable": migration_enabled
                 and rid not in STATE["migrating"],
                 "reason": None if migration_enabled else "migration disabled",
@@ -830,11 +859,13 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             STATE["completed"] += 1
             _push_slo_record(
                 model, rid, "ok", output_tokens=completion,
+                priority=meta.get("priority", "interactive"),
             )
             await resp.write_eof()
             return resp
         except asyncio.CancelledError:
-            _push_slo_record(model, rid, "abort")
+            _push_slo_record(model, rid, "abort",
+                             priority=meta.get("priority", "interactive"))
             raise
         finally:
             STATE["running"] -= 1
@@ -854,8 +885,12 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         sys.stdout.flush()
         os._exit(9)
 
-    def shed_response(reason: str, req_id: str = ""):
+    def shed_response(reason: str, req_id: str = "",
+                      priority: str = "interactive"):
         STATE["shed"] += 1
+        STATE["shed_by_class"][
+            priority if priority in STATE["shed_by_class"] else "interactive"
+        ] += 1
         # flight-recorder shed event + burst-triggered anomaly dump, same
         # trigger shape as the real engine (_note_shed): the overload chaos
         # scenario asserts a parseable dump lands during the shed storm
@@ -868,7 +903,8 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         )
         if sum(1 for t in list(STATE["shed_times"]) if now - t <= 5.0) >= 5:
             fr.dump_async("shed_burst")  # keep the event loop serving
-        _push_slo_record(model, req_id or "unknown", "shed")
+        _push_slo_record(model, req_id or "unknown", "shed",
+                         priority=priority)
         return web.json_response(
             {"error": {"message": f"saturated (injected: {reason})",
                        "type": "overloaded_error", "code": 429}},
@@ -896,10 +932,21 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             }
         )
 
+    def _p99(window) -> float:
+        snap = sorted(window)
+        if not snap:
+            return 0.0
+        return round(snap[min(len(snap) - 1, int(len(snap) * 0.99))], 3)
+
     async def metrics(request):
         saturated = int(
             saturate_after_n is not None
             and STATE["running"] >= int(saturate_after_n)
+        )
+        saturated_batch = int(
+            saturate_after_n is not None
+            and STATE["running"]
+            >= max(0, int(saturate_after_n) - interactive_reserve)
         )
         text = (
             f'vllm:num_requests_running{{model_name="{model}"}} {STATE["running"]}\n'
@@ -908,6 +955,12 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             f'vllm:gpu_prefix_cache_hits_total{{model_name="{model}"}} 10\n'
             f'vllm:gpu_prefix_cache_queries_total{{model_name="{model}"}} 20\n'
             f'vllm:engine_saturated{{model_name="{model}"}} {saturated}\n'
+            # class-aware saturation + interactive latency surface, same
+            # names as the real engine: the fleet controller's
+            # latency_protect and the router's class routing scrape these
+            f'vllm:engine_saturated_batch{{model_name="{model}"}} {saturated_batch}\n'
+            f'vllm:interactive_ttft_p99_ms{{model_name="{model}"}} {_p99(STATE["interactive_ttft_ms"])}\n'
+            f'vllm:interactive_itl_p99_ms{{model_name="{model}"}} {_p99(STATE["interactive_itl_ms"])}\n'
             # serving-mesh advert (--tensor-parallel): the router's scraper
             # and the fleet controller read capacity shape through this
             f'vllm:tensor_parallel_degree{{model_name="{model}"}} {tensor_parallel}\n'
@@ -920,6 +973,13 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             f'fake:served_total{{model_name="{model}"}} {STATE["served"]}\n'
             f'fake:completed_total{{model_name="{model}"}} {STATE["completed"]}\n'
             f'fake:abort_requests_total{{model_name="{model}"}} {STATE["aborts"]}\n'
+            # per-class served/shed split: mixed-class-overload asserts the
+            # shed distribution (batch absorbs everything until the
+            # interactive reserve is exhausted) through these
+            f'fake:served_by_class_total{{model_name="{model}",priority="interactive"}} {STATE["served_by_class"]["interactive"]}\n'
+            f'fake:served_by_class_total{{model_name="{model}",priority="batch"}} {STATE["served_by_class"]["batch"]}\n'
+            f'fake:shed_by_class_total{{model_name="{model}",priority="interactive"}} {STATE["shed_by_class"]["interactive"]}\n'
+            f'fake:shed_by_class_total{{model_name="{model}",priority="batch"}} {STATE["shed_by_class"]["batch"]}\n'
             # live-migration + scale-up warm-up surface (chaos scale-cycle
             # assertions; real engines export vllm:migrations_*_total)
             f'fake:migrations_out_total{{model_name="{model}"}} {STATE["migrations_out"]}\n'
@@ -1008,6 +1068,14 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         stream = bool(body.get("stream", False))
         prompt_text = _prompt_text(body, chat)
         req_id = request.headers.get("X-Request-Id", uuid.uuid4().hex)
+        # SLO class, same resolution order as the real engine's api_server:
+        # X-Priority header wins, then a body field, unknown -> interactive
+        priority = str(
+            request.headers.get("X-Priority")
+            or body.get("priority") or "interactive"
+        ).strip().lower()
+        if priority not in ("interactive", "batch"):
+            priority = "interactive"
         uid = request.headers.get("x-user-id")
         if uid:
             # visible marker for tests asserting user-id header propagation
@@ -1015,6 +1083,7 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         # fault injection: 500s fire BEFORE a slot is held (connect-stage
         # failure from the router's point of view)
         STATE["served"] += 1
+        STATE["served_by_class"][priority] += 1
         # hard crash: request N+1 and later never answer — the process dies
         # abruptly (mid-stream when streaming, pre-response otherwise)
         crashing = (
@@ -1032,11 +1101,17 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
             )
         # admission control simulation: shed BEFORE taking a slot, so the
         # in-flight count is provably bounded by saturate_after_n (the
-        # overload chaos scenario asserts on running_peak)
-        if saturate_after_n is not None and STATE["running"] >= int(saturate_after_n):
-            return shed_response("saturate-after-n", req_id)
+        # overload chaos scenario asserts on running_peak). Class-aware:
+        # batch hits its bound --interactive-reserve slots early, so the
+        # reserved tail of capacity only ever admits interactive work
+        if saturate_after_n is not None:
+            bound = int(saturate_after_n)
+            if priority == "batch":
+                bound = max(0, bound - interactive_reserve)
+            if STATE["running"] >= bound:
+                return shed_response("saturate-after-n", req_id, priority)
         if shed_rate and random.random() < shed_rate:
-            return shed_response("shed-rate", req_id)
+            return shed_response("shed-rate", req_id, priority)
         # distributed tracing, same span model as the real engine
         # (engine.request > queue/prefill/decode) so router e2e tests can
         # assert full-stack trace propagation without a TPU
@@ -1073,6 +1148,9 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         STATE["meta"][req_id] = {
             "oid": oid, "chat": chat, "created": created, "model": model,
             "prompt_tokens": 10, "max_tokens": max_tokens,
+            # rides the migration snapshot so the target resumes the stream
+            # in the same SLO class (real api_server parity)
+            "priority": priority,
         }
         _prompt_warm_hit(prompt_text)
         if fabric_srv[0] is not None and dirpub is not None:
@@ -1102,17 +1180,29 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                 (t_done - t_first) * 1000 / max(1, max_tokens - 1)
                 if max_tokens > 1 else None
             )
+            rec_ttft = (t_first - t_accept) * 1000
+            rec_itl = (
+                float(slo_itl_ms) if slo_itl_ms is not None else measured_itl
+            )
+            if priority == "interactive" and interactive_slo_degrade_ms > 0:
+                # injected SLO degradation: the REPORTED interactive
+                # latencies inflate (records + p99 gauges) without slowing
+                # the stream — chaos drives latency_protect off this
+                rec_ttft += interactive_slo_degrade_ms
+                rec_itl = (rec_itl or 0.0) + interactive_slo_degrade_ms
+            if priority == "interactive":
+                STATE["interactive_ttft_ms"].append(rec_ttft)
+                if rec_itl is not None:
+                    STATE["interactive_itl_ms"].append(rec_itl)
             _push_slo_record(
                 model, req_id, "ok",
-                ttft_ms=(t_first - t_accept) * 1000,
-                itl_p99_ms=(
-                    float(slo_itl_ms) if slo_itl_ms is not None
-                    else measured_itl
-                ),
+                ttft_ms=rec_ttft,
+                itl_p99_ms=rec_itl,
                 output_tokens=max_tokens,
                 queue_ms=0.0,
                 e2e_ms=(t_done - t_accept) * 1000,
                 trace_id=fr_trace,
+                priority=priority,
             )
 
         try:
@@ -1165,10 +1255,13 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
                             "total_tokens": 10 + max_tokens,
                         },
                     },
-                    headers={"X-Request-Id": req_id},
+                    # X-Priority echo: e2e tests assert the class the engine
+                    # actually resolved, not just what the client sent
+                    headers={"X-Request-Id": req_id, "X-Priority": priority},
                 )
             resp = web.StreamResponse(
-                headers={"Content-Type": "text/event-stream", "X-Request-Id": req_id}
+                headers={"Content-Type": "text/event-stream",
+                         "X-Request-Id": req_id, "X-Priority": priority}
             )
             await resp.prepare(request)
             STATE["streams"].add(req_id)  # migratable from the first chunk on
@@ -1215,7 +1308,8 @@ def make_app(model: str, speed: float, ttft: float, model_label: str | None = No
         except asyncio.CancelledError:
             # router-initiated abort (POST /abort) or client disconnect: the
             # real engine attributes these a terminal 'abort' record too
-            _push_slo_record(model, req_id, "abort", trace_id=fr_trace)
+            _push_slo_record(model, req_id, "abort", trace_id=fr_trace,
+                             priority=priority)
             raise
         finally:
             STATE["running"] -= 1
@@ -1522,6 +1616,17 @@ def main():
     p.add_argument("--restart-restore-pages", type=int, default=None,
                    help="model a warm restart: advertise "
                         "vllm:warm_start_restored_pages N on /metrics")
+    p.add_argument("--interactive-reserve", type=int, default=0,
+                   help="slots under --saturate-after-n reserved for "
+                        "interactive requests: batch sheds this many slots "
+                        "early (class-aware admission, docs/failure-"
+                        "handling.md)")
+    p.add_argument("--interactive-slo-degrade-ms", type=float, default=0.0,
+                   help="inflate every interactive request's REPORTED "
+                        "TTFT/ITL by this many ms (SLO records + "
+                        "vllm:interactive_*_p99_ms gauges) — models an "
+                        "engine failing its interactive SLO for "
+                        "latency_protect / class-routing tests")
     p.add_argument("--slo-itl-ms", type=float, default=None,
                    help="inter-token p99 the synthetic SLO terminal records "
                         "report (default: the stream's real pacing) — set "
@@ -1582,6 +1687,8 @@ def main():
             "crash_after_n": args.crash_after_n,
             "restart_restore_pages": args.restart_restore_pages,
             "slo_itl_ms": args.slo_itl_ms,
+            "interactive_reserve": args.interactive_reserve,
+            "interactive_slo_degrade_ms": args.interactive_slo_degrade_ms,
             "compile_stall_ms": args.compile_stall_ms,
             "flight_dump_dir": args.flight_dump_dir,
             "kv_directory_url": args.kv_directory_url,

@@ -38,6 +38,18 @@ non-429 errors, resident-class routing actually happened, and the reborn
 engine re-registered under a higher generation (its stale claims expired)
 and republished.
 
+A seventh scenario, ``run_mixed_class_overload()``
+(``--scenario mixed-class-overload``), drives a mixed interactive/batch
+load past fleet capacity against class-aware admission (ISSUE 20,
+docs/failure-handling.md priority classes): two fakes with an interactive
+reserve, one injecting ``--interactive-slo-degrade-ms`` so its interactive
+TTFT p99 breaches the fleet controller's latency watermark. Asserts zero
+non-429 errors, every shed landed on the batch class (the reserve kept
+interactive whole), bounded interactive TTFT, at least one
+``latency_protect`` decision that migrated a batch stream off the degraded
+engine, and zero dropped streams (the preempted batch stream was spliced,
+not cut).
+
 A sixth scenario, ``run_fabric_outage()`` (``--scenario fabric-outage``),
 exercises the peer-to-peer KV fabric (ISSUE 16, docs/kv-fabric.md): three
 fabric-enabled fakes cross-pull published chains from each other; one
@@ -55,6 +67,7 @@ runnable standalone:
     python scripts/chaos_check.py --scenario rolling-restart
     python scripts/chaos_check.py --scenario directory-restart
     python scripts/chaos_check.py --scenario fabric-outage
+    python scripts/chaos_check.py --scenario mixed-class-overload
 """
 
 from __future__ import annotations
@@ -341,6 +354,314 @@ def run_overload(
     finally:
         for p in fakes:
             stop_proc(p)
+
+
+def run_mixed_class_overload(
+    seats: int = 5,
+    interactive_reserve: int = 3,
+    batch_workers: int = 6,
+    interactive_workers: int = 2,
+    batch_tokens: int = 40,
+    interactive_tokens: int = 4,
+    speed: float = 25.0,
+    load_s: float = 14.0,
+    degrade_ms: float = 400.0,
+    ttft_watermark_ms: float = 150.0,
+    interactive_ttft_p99_bound_s: float = 5.0,
+) -> dict:
+    """Mixed-class overload scenario (ISSUE 20, docs/failure-handling.md
+    priority classes): interactive + batch load past fleet capacity.
+
+    Two fake engines with class-aware bounded admission
+    (``--saturate-after-n`` + ``--interactive-reserve``: batch admission
+    stops ``interactive_reserve`` seats early) behind the router; one
+    engine additionally injects ``--interactive-slo-degrade-ms`` so its
+    *recorded* interactive TTFT/ITL p99 breaches the fleet controller's
+    ``interactive_ttft_watermark_ms`` and latency protection engages.
+    ``batch_workers`` long SSE streams (tagged ``X-Priority: batch``)
+    overload the fleet's batch share while ``interactive_workers`` short
+    streams ride the reserve. The in-process fleet controller runs its
+    latency_protect loop throughout (rebalance watermark parked out of
+    reach so every migration is attributable to the policy under test).
+
+    Caller-asserted: zero non-429 client errors, every engine-level shed
+    landed on the batch class (interactive sheds == 0 — the reserve held),
+    interactive TTFT p99 bounded, >= 1 latency_protect decision migrated a
+    batch stream off the degraded engine, and zero dropped streams (the
+    preempted batch stream spliced onto the peer, full token count)."""
+    import time
+
+    from production_stack_tpu.migration.controller import (
+        ControllerPolicy,
+        FleetController,
+    )
+
+    ports = [free_port() for _ in range(2)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    degraded_url, peer_url = urls[1], urls[0]
+    fakes: dict = {}
+    router = None
+    stop_load = threading.Event()
+    lock = threading.Lock()
+    statuses: collections.Counter = collections.Counter()
+    client_sheds: collections.Counter = collections.Counter()
+    errors: list = []
+    dropped_streams: list = []
+    ttfts: dict = {"interactive": [], "batch": []}
+
+    def start_fake(port: int, extra: list):
+        proc = start_proc([
+            "-m", "production_stack_tpu.testing.fake_engine",
+            "--port", str(port), "--model", "fake/model",
+            "--speed", str(speed),
+            "--saturate-after-n", str(seats),
+            "--interactive-reserve", str(interactive_reserve),
+            "--retry-after", "0.5",
+        ] + extra)
+        # drain stdout: sustained load + a full 64 KB pipe wedges the
+        # process's event loop (PR 5 lesson)
+        threading.Thread(
+            target=lambda: proc.stdout.read() if proc.stdout else None,
+            daemon=True,
+        ).start()
+        return proc
+
+    # only the controller's latency_protect policy may migrate in this
+    # scenario: the rebalance watermark is parked above any reachable
+    # pressure delta so every migration is attributable
+    policy = ControllerPolicy(
+        rebalance_high_delta=9.0, rebalance_low_delta=8.0,
+        cooldown_s=1.0, max_concurrent_migrations=1, rebalance_k=1,
+        saturation_queue_ref=seats,
+        interactive_ttft_watermark_ms=ttft_watermark_ms,
+        latency_release_ratio=0.7, latency_protect_k=1,
+    )
+    ctrl_box: dict = {}
+    ctrl_stop = threading.Event()
+
+    def controller_thread():
+        import asyncio
+
+        async def runner():
+            ctrl = FleetController(
+                engine_urls=urls, router_url=None, policy=policy,
+                tick_interval_s=0.5,
+            )
+            ctrl_box["ctrl"] = ctrl
+            try:
+                while not ctrl_stop.is_set():
+                    try:
+                        await ctrl.tick()
+                    except Exception:  # noqa: BLE001 - keep looping
+                        pass
+                    await asyncio.sleep(0.5)
+            finally:
+                await ctrl.close()
+
+        asyncio.run(runner())
+
+    try:
+        fakes[peer_url] = start_fake(ports[0], [])
+        fakes[degraded_url] = start_fake(
+            ports[1], ["--interactive-slo-degrade-ms", str(degrade_ms)]
+        )
+        router_port = free_port()
+        router = start_proc([
+            "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            "--static-backends", ",".join(urls),
+            "--static-models", ",".join(["fake/model"] * len(urls)),
+            "--engine-stats-interval", "1",
+            "--retry-max-attempts", "3",
+            "--retry-backoff-base", "0.01",
+            "--breaker-failure-threshold", "3",
+            "--breaker-cooldown", "300",
+        ])
+        base = f"http://127.0.0.1:{router_port}"
+        for u in urls:
+            wait_healthy(f"{u}/health", fakes[u], timeout=30)
+        wait_healthy(f"{base}/health", router, timeout=30)
+        threading.Thread(
+            target=lambda: router.stdout.read() if router.stdout else None,
+            daemon=True,
+        ).start()
+
+        def stream_worker(wid: int, priority: str, max_tokens: int):
+            sess = requests.Session()
+            i = 0
+            while not stop_load.is_set():
+                i += 1
+                t0 = time.monotonic()
+                try:
+                    r = sess.post(
+                        f"{base}/v1/completions",
+                        json={"model": "fake/model",
+                              "prompt": f"{priority}-{wid}-{i} " + "ctx " * 16,
+                              "max_tokens": max_tokens, "stream": True},
+                        headers={"X-Priority": priority},
+                        stream=True, timeout=60,
+                    )
+                    with lock:
+                        statuses[r.status_code] += 1
+                    if r.status_code == 200:
+                        first = None
+                        content = 0
+                        saw_done = saw_error = False
+                        for line in r.iter_lines():
+                            if not line.startswith(b"data: "):
+                                continue
+                            if first is None:
+                                first = time.monotonic() - t0
+                            if b"[DONE]" in line:
+                                saw_done = True
+                            elif b'"error"' in line and b'"choices"' not in line:
+                                saw_error = True
+                            elif b'"text"' in line:
+                                content += 1
+                        with lock:
+                            if first is not None:
+                                ttfts[priority].append(first)
+                            if saw_error:
+                                errors.append(("sse_error", priority, wid))
+                            elif not saw_done or content != max_tokens:
+                                dropped_streams.append(
+                                    (priority, wid, i, content, saw_done)
+                                )
+                    elif r.status_code == 429:
+                        with lock:
+                            client_sheds[priority] += 1
+                        time.sleep(0.2)
+                    else:
+                        with lock:
+                            errors.append((r.status_code, r.text[:200]))
+                except requests.RequestException as e:
+                    with lock:
+                        errors.append(("exception", repr(e)))
+                time.sleep(0.05)
+
+        threads = [
+            threading.Thread(
+                target=stream_worker, args=(w, "batch", batch_tokens)
+            )
+            for w in range(batch_workers)
+        ] + [
+            threading.Thread(
+                target=stream_worker,
+                args=(w, "interactive", interactive_tokens),
+            )
+            for w in range(interactive_workers)
+        ]
+        for t in threads:
+            t.start()
+        ctrl_thread = threading.Thread(target=controller_thread, daemon=True)
+        ctrl_thread.start()
+
+        # run until latency protection demonstrably fired (plus a minimum
+        # soak so the shed path is exercised), bounded by load_s
+        t0 = time.time()
+        while time.time() - t0 < load_s:
+            time.sleep(0.5)
+            ctrl = ctrl_box.get("ctrl")
+            if (
+                ctrl is not None
+                and ctrl.decider.decisions_total.get("latency_protect", 0) >= 1
+                and time.time() - t0 > 4.0
+            ):
+                break
+        time.sleep(1.0)  # let the spliced stream(s) finish cleanly
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=60)
+        ctrl_stop.set()
+        ctrl_thread.join(timeout=10)
+
+        by_class_re = re.compile(
+            r'^(fake:(?:served|shed)_by_class_total)\{[^}]*'
+            r'priority="([a-z]+)"[^}]*\} ([0-9.]+)$', re.M,
+        )
+        served_by_class: collections.Counter = collections.Counter()
+        shed_by_class: collections.Counter = collections.Counter()
+        gauges: dict = {}
+        for u in urls:
+            text = requests.get(f"{u}/metrics", timeout=10).text
+            for m in by_class_re.finditer(text):
+                tgt = (
+                    served_by_class if "served" in m.group(1)
+                    else shed_by_class
+                )
+                tgt[m.group(2)] += float(m.group(3))
+            vals = {}
+            for m in re.finditer(
+                r"^((?:vllm|fake):[a-z0-9_]+)(?:\{[^}]*\})? "
+                r"([0-9.eE+-]+)$", text, re.M,
+            ):
+                vals[m.group(1)] = vals.get(m.group(1), 0.0) + float(
+                    m.group(2)
+                )
+            gauges[u] = vals
+        router_text = requests.get(f"{base}/metrics", timeout=10).text
+
+        def _router_counter(name: str) -> float:
+            m = re.search(
+                rf"^{re.escape(name)} ([0-9.]+)$", router_text, re.M
+            )
+            return float(m.group(1)) if m else 0.0
+
+        router_by_class = {
+            m.group(1): float(m.group(2))
+            for m in re.finditer(
+                r'^vllm_router:requests_by_class_total\{priority="([a-z]+)"\}'
+                r" ([0-9.]+)$", router_text, re.M,
+            )
+        }
+        i_t = sorted(ttfts["interactive"])
+        i_p99 = (
+            i_t[min(len(i_t) - 1, int(len(i_t) * 0.99))] if i_t else None
+        )
+        ctrl = ctrl_box.get("ctrl")
+        decisions = dict(ctrl.decider.decisions_total) if ctrl else {}
+        return {
+            "statuses": dict(statuses),
+            "non_429_errors": len(errors),
+            "errors": errors[:10],
+            "dropped_streams": len(dropped_streams),
+            "dropped_examples": dropped_streams[:5],
+            "interactive_ttft_p99_s": i_p99,
+            "interactive_ttft_p99_bound_s": interactive_ttft_p99_bound_s,
+            "interactive_streams_ok": len(ttfts["interactive"]),
+            "batch_streams_ok": len(ttfts["batch"]),
+            "served_by_class": dict(served_by_class),
+            "shed_by_class": dict(shed_by_class),
+            "client_sheds_by_class": dict(client_sheds),
+            "router_requests_by_class": router_by_class,
+            "degraded_url": degraded_url,
+            "degraded_interactive_ttft_p99_ms": gauges.get(
+                degraded_url, {}
+            ).get("vllm:interactive_ttft_p99_ms", 0.0),
+            "latency_protect_decisions": decisions.get("latency_protect", 0),
+            "controller_decisions": decisions,
+            "degraded_migrations_out": gauges.get(degraded_url, {}).get(
+                "fake:migrations_out_total", 0.0
+            ),
+            "peer_migrations_in": gauges.get(peer_url, {}).get(
+                "fake:migrations_in_total", 0.0
+            ),
+            "session_repins_total": _router_counter(
+                "vllm_router:session_repins_total"
+            ),
+            "splice_failures_total": _router_counter(
+                "vllm_router:migration_splice_failures_total"
+            ),
+            "seats": seats,
+            "interactive_reserve": interactive_reserve,
+        }
+    finally:
+        stop_load.set()
+        ctrl_stop.set()
+        for p_ in fakes.values():
+            stop_proc(p_)
+        if router is not None:
+            stop_proc(router)
 
 
 def run_rolling_restart(
@@ -1288,7 +1609,7 @@ def main() -> int:
     p.add_argument("--scenario",
                    choices=["chaos", "overload", "rolling-restart",
                             "directory-restart", "scale-cycle",
-                            "fabric-outage"],
+                            "fabric-outage", "mixed-class-overload"],
                    default="chaos")
     p.add_argument("--num-requests", type=int, default=None)
     p.add_argument("--retry-budget", type=int, default=3)
@@ -1348,6 +1669,52 @@ def main() -> int:
             print("SCALE-CYCLE CHECK FAILED: " + "; ".join(failures))
             return 1
         print("SCALE-CYCLE CHECK PASSED")
+        return 0
+
+    if args.scenario == "mixed-class-overload":
+        s = run_mixed_class_overload()
+        print(json.dumps(s, indent=2))
+        failures = []
+        if s["non_429_errors"]:
+            failures.append(
+                f"{s['non_429_errors']} non-429 client errors: {s['errors']}"
+            )
+        if s["dropped_streams"]:
+            failures.append(
+                f"{s['dropped_streams']} dropped mid-flight streams: "
+                f"{s['dropped_examples']}"
+            )
+        if s["shed_by_class"].get("batch", 0) < 1:
+            failures.append("overload never shed a batch request")
+        if s["shed_by_class"].get("interactive", 0):
+            failures.append(
+                f"{s['shed_by_class']['interactive']} interactive sheds "
+                "(the reserve did not hold)"
+            )
+        if (
+            s["interactive_ttft_p99_s"] is None
+            or s["interactive_ttft_p99_s"] > s["interactive_ttft_p99_bound_s"]
+        ):
+            failures.append(
+                f"interactive TTFT p99 {s['interactive_ttft_p99_s']} above "
+                f"bound {s['interactive_ttft_p99_bound_s']}s"
+            )
+        if s["latency_protect_decisions"] < 1:
+            failures.append(
+                "latency protection never preempted a batch stream"
+            )
+        if s["degraded_migrations_out"] < 1:
+            failures.append(
+                "no batch stream migrated off the degraded engine"
+            )
+        if s["splice_failures_total"]:
+            failures.append(
+                f"{s['splice_failures_total']} migration splices failed"
+            )
+        if failures:
+            print("MIXED-CLASS-OVERLOAD CHECK FAILED: " + "; ".join(failures))
+            return 1
+        print("MIXED-CLASS-OVERLOAD CHECK PASSED")
         return 0
 
     if args.scenario == "fabric-outage":

@@ -138,6 +138,9 @@ DASHBOARD_ALLOWLIST = {
     "fake:migrations_in_total",
     "fake:warm_prefetch_chunks",
     "fake:warm_prefix_hits_total",
+    "fake:served_by_class_total",   # per-SLO-class split behind the chaos
+    "fake:shed_by_class_total",     # batch-first shed assertions
+
     # fleet-controller diagnostics: the dashboard charts decisions-by-kind
     # and the saturation signal; started/failed/inflight are the drill-down
     # behind a decisions anomaly, charted on demand

@@ -53,6 +53,10 @@ def build_controller(args) -> FleetController:
         max_concurrent_migrations=args.max_concurrent_migrations,
         rebalance_k=args.rebalance_k,
         saturation_queue_ref=args.saturation_queue_ref,
+        interactive_ttft_watermark_ms=args.interactive_ttft_watermark_ms,
+        interactive_itl_watermark_ms=args.interactive_itl_watermark_ms,
+        latency_release_ratio=args.latency_release_ratio,
+        latency_protect_k=args.latency_protect_k,
     )
     return FleetController(
         engine_urls=[u for u in args.engines.split(",") if u],
@@ -141,6 +145,18 @@ def main() -> int:
     p.add_argument("--saturation-queue-ref", type=int, default=8,
                    help="queue depth that scores a backend's pressure as "
                         "1.0 (the router's --saturation-queue-ref twin)")
+    p.add_argument("--interactive-ttft-watermark-ms", type=float, default=0.0,
+                   help="interactive-class TTFT p99 (vllm:interactive_"
+                        "ttft_p99_ms) above which batch streams migrate "
+                        "off the engine (latency_protect); 0 disables")
+    p.add_argument("--interactive-itl-watermark-ms", type=float, default=0.0,
+                   help="interactive-class inter-token p99 watermark for "
+                        "latency_protect; 0 disables")
+    p.add_argument("--latency-release-ratio", type=float, default=0.7,
+                   help="latency_protect disengages when the breached p99 "
+                        "falls below watermark * this ratio (hysteresis)")
+    p.add_argument("--latency-protect-k", type=int, default=1,
+                   help="batch streams moved per latency_protect decision")
     p.add_argument("--drain", default=None,
                    help="evacuate every migratable sequence off this engine "
                         "URL (zero-loss scale-down), print a report, exit")

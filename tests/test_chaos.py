@@ -244,6 +244,45 @@ def test_scale_cycle_zero_loss_with_migration_and_warm_prefetch():
         assert tp == 4, (url, tp)
 
 
+def test_mixed_class_overload_sheds_batch_first_and_preempts_batch():
+    """Acceptance (multi-tenant SLO classes, ISSUE 20): a mixed
+    interactive/batch load past fleet capacity against two class-aware
+    fakes (interactive admission reserve) — one injecting an interactive
+    SLO degradation so the fleet controller's latency protection engages.
+    Zero non-429 client errors; every engine-level shed landed on the
+    batch class (interactive sheds == 0 — the reserve held under
+    overload); interactive TTFT p99 stays bounded; the controller issued
+    at least one latency_protect decision that migrated a batch stream
+    off the degraded engine; and zero streams dropped — the preempted
+    batch stream was spliced onto the peer with its full token count,
+    never cut."""
+    s = chaos_check.run_mixed_class_overload()
+    assert s["non_429_errors"] == 0, s["errors"]
+    assert s["statuses"].get(200, 0) > 0, s["statuses"]
+    assert s["dropped_streams"] == 0, s["dropped_examples"]
+    # the overload was real, and class-aware: the fleet shed batch first
+    # and the interactive reserve kept the interactive class whole
+    assert s["shed_by_class"].get("batch", 0) >= 1, s["shed_by_class"]
+    assert s["shed_by_class"].get("interactive", 0) == 0, s["shed_by_class"]
+    # both classes actually served (the scenario is meaningless otherwise)
+    assert s["served_by_class"].get("interactive", 0) > 0, s["served_by_class"]
+    assert s["served_by_class"].get("batch", 0) > 0, s["served_by_class"]
+    # the router tagged and counted both classes end-to-end
+    assert s["router_requests_by_class"].get("interactive", 0) > 0, s
+    assert s["router_requests_by_class"].get("batch", 0) > 0, s
+    # interactive latency held while batch was shed/preempted around it
+    assert s["interactive_ttft_p99_s"] is not None, s
+    assert (
+        s["interactive_ttft_p99_s"] <= s["interactive_ttft_p99_bound_s"]
+    ), s["interactive_ttft_p99_s"]
+    # latency protection preempted >= 1 batch stream off the degraded
+    # engine, and the router spliced the handoff without loss
+    assert s["latency_protect_decisions"] >= 1, s["controller_decisions"]
+    assert s["degraded_migrations_out"] >= 1, s
+    assert s["peer_migrations_in"] >= 1, s
+    assert s["splice_failures_total"] == 0, s
+
+
 def test_inter_chunk_stall_aborts_engine_and_sends_sse_error():
     """Acceptance: a stream stalled past the inter-chunk timeout is aborted
     on the engine (scheduler slot freed, verified via /metrics running-count)

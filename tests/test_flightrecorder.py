@@ -148,13 +148,14 @@ def test_slo_objectives_and_outcomes(slo):
     ]})
     assert n == 4 and slo.cursor(url) == 4
     c = slo._counters
-    assert c[(url, "m", "ttft")] == [2, 1]
-    assert c[(url, "m", "itl")] == [2, 1]
-    assert c[(url, "m", "availability")] == [3, 1]
+    # records without a priority field land in the protective default class
+    assert c[(url, "m", "ttft", "interactive")] == [2, 1]
+    assert c[(url, "m", "itl", "interactive")] == [2, 1]
+    assert c[(url, "m", "availability", "interactive")] == [3, 1]
     # a shed abstains from the latency objectives (no double charge)
     lines = "\n".join(slo.render(fleet_saturation=0.25))
-    assert 'vllm_router:slo_attained_total{objective="ttft",model="m",server="http://e1"} 2' in lines
-    assert 'vllm_router:slo_violated_total{objective="availability",model="m",server="http://e1"} 1' in lines
+    assert 'vllm_router:slo_attained_total{objective="ttft",model="m",priority="interactive",server="http://e1"} 2' in lines
+    assert 'vllm_router:slo_violated_total{objective="availability",model="m",priority="interactive",server="http://e1"} 1' in lines
     assert 'outcome="shed"' in lines
     assert "vllm_router:fleet_saturation 0.25" in lines
 

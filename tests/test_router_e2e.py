@@ -109,10 +109,12 @@ class TestSLOAccounting:
                 time.sleep(0.5)
 
             def val(name, objective, server):
+                # untagged fake traffic lands in the default (interactive)
+                # SLO class — the priority label is part of the series key
                 return c.get(
                     f"vllm_router:{name}"
                     f'{{objective="{objective}",model="fake/model",'
-                    f'server="{server}"}}', 0.0
+                    f'priority="interactive",server="{server}"}}', 0.0
                 )
 
             fast, slow = urls

@@ -1,0 +1,500 @@
+"""Multi-tenant SLO classes (ISSUE 20, docs/failure-handling.md priority
+classes): class-aware admission/shed order in the scheduler, priority-labeled
+SLO attainment in the router monitor, batch-avoiding placement, the fleet
+controller's latency_protect policy, the deterministic trace generator, and
+an end-to-end class-tagging round trip through a real router + fake engine.
+The full overload choreography (batch-first sheds + migration-backed
+preemption under live load) is chaos-covered in
+tests/test_chaos.py::test_mixed_class_overload_sheds_batch_first_and_preempts_batch."""
+
+import numpy as np
+import pytest
+import requests
+
+from production_stack_tpu.engine.kv_manager import KVPageManager
+from production_stack_tpu.engine.scheduler import (
+    SamplingParams,
+    Scheduler,
+    Sequence,
+)
+from production_stack_tpu.migration.controller import (
+    BackendView,
+    ControllerPolicy,
+    FleetDecider,
+)
+from production_stack_tpu.router.slo import SLOMonitor
+from production_stack_tpu.router.utils import SingletonMeta
+from production_stack_tpu.testing.trace_gen import (
+    generate_trace,
+    trace_summary,
+)
+
+
+def _mk_scheduler(num_pages=256, **kw):
+    kv = KVPageManager(num_pages=num_pages, page_size=8)
+    base = dict(max_num_seqs=8, max_model_len=512, prefill_chunk=16,
+                prefill_batch=2, enable_prefix_caching=False, decode_steps=4,
+                decode_pipeline=3)
+    base.update(kw)
+    return Scheduler(kv, **base)
+
+
+def _seq(seq_id, priority="interactive", prompt=8, max_tokens=64, **kw):
+    return Sequence(
+        seq_id, prompt_ids=[1] * prompt,
+        params=SamplingParams(max_tokens=max_tokens, ignore_eos=True),
+        priority=priority, **kw,
+    )
+
+
+def _drive(sched, steps=64):
+    """schedule/apply loop with fake sampled tokens (test_scheduler_fairness
+    idiom); returns the batch kinds seen."""
+    kinds = []
+    for _ in range(steps):
+        batch = sched.schedule()
+        if batch is None:
+            break
+        kinds.append(batch.kind)
+        if batch.kind == "prefill":
+            toks = np.full((len(batch.kv_lens),), 7, np.int32)
+        else:
+            toks = np.full(
+                (len(batch.kv_lens), sched.decode_steps * batch.bursts),
+                7, np.int32,
+            )
+        sched.apply_step(batch, toks, eos_token_id=-1)
+    return kinds
+
+
+# ---------------------------------------------------------------------------
+# scheduler: class-aware admission, shed order, deadlines, prefill share
+# ---------------------------------------------------------------------------
+
+
+class TestClassAwareScheduler:
+    def test_batch_saturates_interactive_reserve_early(self):
+        sched = _mk_scheduler(
+            max_num_seqs=1, max_waiting_seqs=4, interactive_reserve=2,
+        )
+        sched.running.append(_seq("occupant"))  # no free seats to project
+        for i in range(2):
+            sched.waiting.append(_seq(f"b{i}", priority="batch"))
+        # two waiters: batch bound (4 - 2 = 2) is hit, interactive's is not
+        assert sched.saturated("batch")
+        assert not sched.saturated("interactive")
+        for i in range(2):
+            sched.waiting.append(_seq(f"i{i}"))
+        assert sched.saturated("interactive")
+
+    def test_free_seats_project_into_class_bounds(self):
+        sched = _mk_scheduler(
+            max_num_seqs=2, max_waiting_seqs=2, interactive_reserve=1,
+        )
+        # empty engine: 2 free seats project forward for both classes
+        sched.waiting.append(_seq("b0", priority="batch"))
+        sched.waiting.append(_seq("b1", priority="batch"))
+        assert not sched.saturated("batch")
+        sched.waiting.append(_seq("b2", priority="batch"))
+        assert sched.saturated("batch")        # 3 >= (2-1) + 2
+        assert not sched.saturated("interactive")
+
+    def test_interactive_admitted_before_earlier_batch(self):
+        sched = _mk_scheduler(max_num_seqs=1)
+        sched.add(_seq("bulk", priority="batch"))
+        sched.add(_seq("chat", priority="interactive"))
+        batch = sched.schedule()
+        assert batch is not None and batch.kind == "prefill"
+        # the single seat went to the LATER-arriving interactive sequence
+        assert [s.seq_id for s in batch.seqs] == ["chat"]
+        assert [s.seq_id for s in sched.waiting] == ["bulk"]
+
+    def test_preempted_head_keeps_its_place_over_interactive(self):
+        sched = _mk_scheduler(max_num_seqs=1)
+        pre = _seq("resumed", priority="batch")
+        pre.preempted = True
+        sched.waiting.append(pre)
+        sched.add(_seq("chat", priority="interactive"))
+        batch = sched.schedule()
+        # a preempted batch stream already delivered tokens: jumping it
+        # would stall a live stream, so it re-admits ahead of interactive
+        assert [s.seq_id for s in batch.seqs] == ["resumed"]
+
+    def test_batch_queue_deadline_expires_batch_only(self):
+        sched = _mk_scheduler(queue_deadline_s=100.0, batch_queue_deadline_s=1.0)
+        assert sched.deadline_for("batch") == 1.0
+        assert sched.deadline_for("interactive") == 100.0
+        sched.waiting.append(_seq("b", priority="batch", arrival_time=0.0))
+        sched.waiting.append(_seq("i", arrival_time=0.0))
+        expired = sched.expired_waiting(now=5.0)
+        assert [s.seq_id for s in expired] == ["b"]
+        # both classes expire past the shared deadline
+        assert {s.seq_id for s in sched.expired_waiting(now=200.0)} == {"b", "i"}
+
+    def test_prefill_share_caps_batch_while_interactive_waits(self):
+        sched = _mk_scheduler(
+            prefill_batch=4, batch_prefill_share=0.5, max_num_seqs=8,
+        )
+        rows = [_seq(f"b{i}", priority="batch", prompt=16) for i in range(4)]
+        for s in rows:
+            s.pages = sched.kv.allocate(sched._pages_needed(len(s.prompt_ids)))
+            sched.running.append(s)
+        # no interactive anywhere: batch fills every chunk slot
+        assert len(sched._take_prefill(list(rows)).seqs) == 4
+        # an interactive arrival still queued for a seat: batch's share of
+        # the dispatch is capped at 50% so the pipeline frees up for it
+        sched.waiting.append(_seq("chat"))
+        assert len(sched._take_prefill(list(rows)).seqs) == 2
+
+    def test_decode_page_pressure_preempts_batch_before_interactive(self):
+        # pool sized so both prompts prefill but decode growth runs dry
+        sched = _mk_scheduler(
+            num_pages=8, max_num_seqs=2, prefill_chunk=32, prefill_batch=2,
+        )
+        sched.add(_seq("chat", priority="interactive", prompt=16,
+                       max_tokens=256))
+        sched.add(_seq("bulk", priority="batch", prompt=16, max_tokens=256))
+        victims = []
+        orig = sched._preempt
+
+        def record(seq):
+            victims.append(seq.seq_id)
+            orig(seq)
+
+        sched._preempt = record
+        _drive(sched, steps=64)
+        assert sched.preemptions_total >= 1
+        # when the pool first ran dry it was the BATCH row that was evicted
+        # to keep the interactive stream decoding
+        assert victims[0] == "bulk", victims
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor: priority label + interactive attainment accessor
+# ---------------------------------------------------------------------------
+
+
+def _rec(seq, outcome="ok", ttft=100.0, itl=10.0, model="m", priority=None):
+    rec = {
+        "seq": seq, "request_id": f"r{seq}", "model": model,
+        "outcome": outcome, "ttft_ms": ttft, "itl_p99_ms": itl,
+    }
+    if priority is not None:
+        rec["priority"] = priority
+    return rec
+
+
+@pytest.fixture()
+def slo():
+    SingletonMeta._reset(SLOMonitor)
+    yield SLOMonitor(ttft_ms=200.0, itl_ms=50.0, saturation_queue_ref=4)
+    SingletonMeta._reset(SLOMonitor)
+
+
+class TestSLOPriorityLabel:
+    def test_counters_split_by_class_same_families(self, slo):
+        url = "http://e1"
+        slo.ingest(url, {"head": 3, "next": 3, "records": [
+            _rec(1, priority="interactive", ttft=100.0),
+            _rec(2, priority="batch", ttft=500.0),
+            _rec(3, ttft=100.0),  # missing field -> protective default
+        ]})
+        c = slo._counters
+        assert c[(url, "m", "ttft", "interactive")] == [2, 0]
+        assert c[(url, "m", "ttft", "batch")] == [0, 1]
+        lines = "\n".join(slo.render())
+        assert 'priority="interactive"' in lines
+        assert 'priority="batch"' in lines
+        # the label set is closed: an unknown class clamps to interactive
+        slo.ingest(url, {"head": 4, "next": 4, "records": [
+            _rec(4, priority="turbo", ttft=100.0),
+        ]})
+        assert c[(url, "m", "ttft", "interactive")] == [3, 0]
+        assert 'priority="turbo"' not in "\n".join(slo.render())
+
+    def test_interactive_attainment_ignores_batch_records(self, slo):
+        url = "http://e1"
+        assert slo.interactive_attainment(url) is None  # no data yet
+        slo.ingest(url, {"head": 4, "next": 4, "records": [
+            _rec(1, priority="interactive", ttft=100.0),
+            _rec(2, priority="interactive", ttft=100.0),
+            _rec(3, priority="interactive", ttft=900.0),   # violation
+            _rec(4, priority="batch", ttft=900.0),         # must not count
+        ]})
+        att = slo.interactive_attainment(url, "ttft")
+        assert att == pytest.approx(2 / 3)
+        # other backends stay independent
+        assert slo.interactive_attainment("http://e2") is None
+
+
+# ---------------------------------------------------------------------------
+# router placement: class_filtered
+# ---------------------------------------------------------------------------
+
+
+class TestClassFiltered:
+    def _endpoints(self):
+        import time as _time
+
+        from production_stack_tpu.router.service_discovery import EndpointInfo
+
+        return [
+            EndpointInfo(url=u, model_names=["m"],
+                         added_timestamp=_time.time())
+            for u in ("http://good", "http://bad")
+        ]
+
+    def test_batch_avoids_degraded_interactive_backend(self, slo):
+        from production_stack_tpu.router.routing_logic import RoutingInterface
+
+        slo.ingest("http://good", {"head": 2, "next": 2, "records": [
+            _rec(1, priority="interactive", ttft=100.0),
+            _rec(2, priority="interactive", ttft=100.0),
+        ]})
+        slo.ingest("http://bad", {"head": 2, "next": 2, "records": [
+            _rec(1, priority="interactive", ttft=900.0),
+            _rec(2, priority="interactive", ttft=900.0),
+        ]})
+        eps = self._endpoints()
+        out = RoutingInterface.class_filtered(eps, "batch", 0.9)
+        assert [e.url for e in out] == ["http://good"]
+        # interactive is never filtered here
+        out = RoutingInterface.class_filtered(eps, "interactive", 0.9)
+        assert [e.url for e in out] == ["http://good", "http://bad"]
+        # threshold 0 disables the filter entirely
+        assert len(RoutingInterface.class_filtered(eps, "batch", 0.0)) == 2
+
+    def test_fail_static_when_all_degraded_or_no_data(self, slo):
+        from production_stack_tpu.router.routing_logic import RoutingInterface
+
+        eps = self._endpoints()
+        # no attainment data anywhere: pass through unchanged
+        assert len(RoutingInterface.class_filtered(eps, "batch", 0.9)) == 2
+        for u in ("http://good", "http://bad"):
+            slo.ingest(u, {"head": 1, "next": 1, "records": [
+                _rec(1, priority="interactive", ttft=900.0),
+            ]})
+        # every backend degraded: fail static, the engines' own batch-first
+        # admission gives the honest 429
+        assert len(RoutingInterface.class_filtered(eps, "batch", 0.9)) == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet controller: latency_protect policy
+# ---------------------------------------------------------------------------
+
+
+def _lat_policy(**over):
+    kw = dict(
+        rebalance_high_delta=9.0, rebalance_low_delta=8.0, cooldown_s=0.0,
+        max_concurrent_migrations=2, rebalance_k=1, saturation_queue_ref=8,
+        interactive_ttft_watermark_ms=200.0, latency_release_ratio=0.7,
+        latency_protect_k=1,
+    )
+    kw.update(over)
+    return ControllerPolicy(**kw)
+
+
+def _lat_views(p99=500.0, migratable=None):
+    hot = BackendView(
+        url="http://hot", interactive_ttft_p99=p99,
+        migratable=migratable if migratable is not None else [
+            {"request_id": "bulk-long", "output_tokens": 40,
+             "priority": "batch"},
+            {"request_id": "bulk-short", "output_tokens": 2,
+             "priority": "batch"},
+            {"request_id": "chat", "output_tokens": 90,
+             "priority": "interactive"},
+        ],
+    )
+    return [hot, BackendView(url="http://cold")]
+
+
+class TestLatencyProtect:
+    def test_breach_migrates_longest_batch_stream_only(self):
+        d = FleetDecider(_lat_policy())
+        actions = d.decide(_lat_views(), now=0.0)
+        lat = [a for a in actions if a.kind == "latency_protect"]
+        assert len(lat) == 1
+        assert lat[0].source == "http://hot"
+        assert lat[0].target == "http://cold"
+        # batch victims only, longest first — the interactive stream with
+        # MORE output tokens is never picked
+        assert lat[0].request_ids == ["bulk-long"]
+        assert d.decisions_total["latency_protect"] == 1
+
+    def test_no_interactive_signal_never_engages(self):
+        d = FleetDecider(_lat_policy())
+        # p99 == 0 means no interactive request finished yet — not a breach
+        assert d.decide(_lat_views(p99=0.0), now=0.0) == []
+        # watermark 0 disables the policy outright
+        d2 = FleetDecider(_lat_policy(interactive_ttft_watermark_ms=0.0))
+        assert d2.decide(_lat_views(p99=500.0), now=0.0) == []
+
+    def test_hysteresis_release_below_ratio(self):
+        d = FleetDecider(_lat_policy())
+        assert d.decide(_lat_views(p99=500.0), now=0.0)
+        assert "http://hot" in d._latency_engaged
+        # between release (140) and watermark (200): stays engaged
+        assert d.decide(_lat_views(p99=180.0), now=1.0)
+        # below watermark * ratio: disengages, no further action
+        assert d.decide(_lat_views(p99=100.0), now=2.0) == []
+        assert "http://hot" not in d._latency_engaged
+        assert d.decide(_lat_views(p99=180.0), now=3.0) == []  # no re-engage
+
+    def test_cooldown_and_inflight_cap(self):
+        d = FleetDecider(_lat_policy(cooldown_s=10.0))
+        assert d.decide(_lat_views(), now=100.0)
+        assert d.decide(_lat_views(), now=105.0) == []   # inside cooldown
+        assert d.decide(_lat_views(), now=111.0)         # past it
+        d2 = FleetDecider(_lat_policy(max_concurrent_migrations=1))
+        assert d2.decide(_lat_views(), inflight_migrations=1, now=0.0) == []
+
+    def test_batch_only_victims_no_batch_no_action(self):
+        d = FleetDecider(_lat_policy())
+        only_interactive = [
+            {"request_id": "chat", "output_tokens": 90,
+             "priority": "interactive"},
+        ]
+        # breached, but every migratable stream is interactive: latency
+        # protection NEVER touches interactive — no action at all
+        assert d.decide(
+            _lat_views(migratable=only_interactive), now=0.0
+        ) == []
+
+    def test_itl_watermark_is_an_independent_trigger(self):
+        d = FleetDecider(_lat_policy(
+            interactive_ttft_watermark_ms=0.0,
+            interactive_itl_watermark_ms=50.0,
+        ))
+        views = _lat_views(p99=0.0)
+        views[0].interactive_itl_p99 = 80.0
+        actions = d.decide(views, now=0.0)
+        assert [a.kind for a in actions] == ["latency_protect"]
+
+
+# ---------------------------------------------------------------------------
+# trace generator determinism
+# ---------------------------------------------------------------------------
+
+
+class TestTraceGen:
+    def test_same_seed_same_trace(self):
+        kw = dict(seed=7, duration_s=30.0, base_qps=4.0, batch_fraction=0.4)
+        a, b = generate_trace(**kw), generate_trace(**kw)
+        assert a == b
+        assert a != generate_trace(**{**kw, "seed": 8})
+
+    def test_shape_and_bounds(self):
+        trace = generate_trace(
+            seed=3, duration_s=60.0, base_qps=5.0, batch_fraction=0.3,
+            min_context=1024, max_context=32768,
+        )
+        assert trace, "empty trace"
+        assert all(0.0 <= r.t < 60.0 for r in trace)
+        assert [r.t for r in trace] == sorted(r.t for r in trace)
+        assert all(1024 <= r.prompt_tokens <= 32768 for r in trace)
+        assert {r.priority for r in trace} == {"interactive", "batch"}
+        s = trace_summary(trace)
+        assert s["n"] == len(trace)
+        assert s["by_class"]["interactive"] > s["by_class"]["batch"]
+        # thinning respects the mean rate envelope (generous bounds: the
+        # burst windows push the realized mean above base_qps)
+        assert 2.0 <= s["mean_qps"] <= 25.0
+
+    def test_bursts_raise_arrival_density(self):
+        trace = generate_trace(
+            seed=11, duration_s=40.0, base_qps=6.0, burst_factor=4.0,
+            burst_period_s=10.0, burst_duration_s=2.0, diurnal_amplitude=0.0,
+        )
+        in_burst = sum(1 for r in trace if (r.t % 10.0) < 2.0)
+        out_burst = len(trace) - in_burst
+        # burst windows are 20% of the time at 4x rate: their arrival
+        # density must clearly beat the quiet windows'
+        assert in_burst / 2.0 > out_burst / 8.0
+
+    def test_degenerate_inputs(self):
+        assert generate_trace(seed=1, duration_s=0.0, base_qps=5.0) == []
+        assert generate_trace(seed=1, duration_s=10.0, base_qps=0.0) == []
+        assert trace_summary([]) == {"n": 0}
+
+
+# ---------------------------------------------------------------------------
+# e2e: class tagging through a real router + fake engine
+# ---------------------------------------------------------------------------
+
+
+def test_router_forwards_class_and_both_sides_count_it():
+    """X-Priority round trip: the router tags the request, the fake engine
+    echoes the class and counts it per class, and both /metrics surfaces
+    export the closed-set priority label."""
+    from production_stack_tpu.testing.procs import (
+        free_port,
+        start_proc,
+        stop_proc,
+        wait_healthy,
+    )
+
+    fake = router = None
+    try:
+        fake_port = free_port()
+        fake = start_proc([
+            "-m", "production_stack_tpu.testing.fake_engine",
+            "--port", str(fake_port), "--model", "fake/model",
+            "--speed", "500",
+        ])
+        fake_url = f"http://127.0.0.1:{fake_port}"
+        router_port = free_port()
+        router = start_proc([
+            "-m", "production_stack_tpu.router.app",
+            "--port", str(router_port),
+            "--static-backends", fake_url,
+            "--static-models", "fake/model",
+            "--engine-stats-interval", "1",
+        ])
+        base = f"http://127.0.0.1:{router_port}"
+        wait_healthy(f"{fake_url}/health", fake, timeout=30)
+        wait_healthy(f"{base}/health", router, timeout=30)
+
+        # header tagging (the canonical path)
+        r = requests.post(
+            f"{base}/v1/completions",
+            json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+            headers={"X-Priority": "batch"}, timeout=30,
+        )
+        assert r.status_code == 200, r.text
+        assert r.headers.get("X-Priority") == "batch"
+        # body-field tagging
+        r = requests.post(
+            f"{base}/v1/completions",
+            json={"model": "fake/model", "prompt": "x", "max_tokens": 2,
+                  "priority": "batch"},
+            timeout=30,
+        )
+        assert r.status_code == 200, r.text
+        assert r.headers.get("X-Priority") == "batch"
+        # untagged and unknown both clamp to the protective default
+        r = requests.post(
+            f"{base}/v1/completions",
+            json={"model": "fake/model", "prompt": "x", "max_tokens": 2},
+            headers={"X-Priority": "turbo"}, timeout=30,
+        )
+        assert r.status_code == 200, r.text
+        assert r.headers.get("X-Priority") == "interactive"
+
+        fake_m = requests.get(f"{fake_url}/metrics", timeout=10).text
+        assert ('fake:served_by_class_total{model_name="fake/model",'
+                'priority="batch"} 2') in fake_m
+        assert ('fake:served_by_class_total{model_name="fake/model",'
+                'priority="interactive"} 1') in fake_m
+        router_m = requests.get(f"{base}/metrics", timeout=10).text
+        assert ('vllm_router:requests_by_class_total{priority="batch"} 2'
+                in router_m)
+        assert ('vllm_router:requests_by_class_total{priority="interactive"}'
+                " 1") in router_m
+        assert "vllm_router:batch_deprioritized_routes_total 0" in router_m
+    finally:
+        if router is not None:
+            stop_proc(router)
+        if fake is not None:
+            stop_proc(fake)

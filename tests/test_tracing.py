@@ -492,6 +492,8 @@ def test_metric_label_cardinality_bounded(stack):
     allowed = {
         "model_name", "server", "backend", "quantile", "le", "kind",
         "source", "device", "reason", "objective", "model", "outcome",
+        # SLO class (docs/failure-handling.md): closed two-value set
+        "priority",
     }
     forbidden = {"request_id", "seq_id", "trace_id", "x_request_id"}
     for url in (router_url, engine_url):
